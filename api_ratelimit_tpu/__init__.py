@@ -1,0 +1,27 @@
+"""api-ratelimit-tpu: a TPU-native rate-limiting framework.
+
+A ground-up re-design of kentik/api-ratelimit (Envoy RateLimitService, Kentik
+fork) for TPU: instead of shipping INCRBY/EXPIRE commands to Redis, descriptor
+decisions are micro-batched onto TPU where a single jitted program (with Pallas
+kernels for the fused decision math) performs fixed-window increment,
+expiry-reset, and over-limit comparison against an HBM-resident
+fingerprint -> (count, window, expiry) slab, hash-sharded across chips with
+per-window counts combined over ICI collectives for globally correct limits.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  cmd/       entry points (server, test client, config linter)
+  runner     composition root (server/runner.py)
+  server/    gRPC + HTTP + debug transport, health, runtime watcher
+  service/   request orchestration (validation, aggregation, headers)
+  config/    YAML rule tree (strict validation, trie GetLimit)
+  limiter/   backend-agnostic fixed-window algorithm + key codec
+  backends/  cache backends: tpu (slab), memory (oracle), redis, memcached
+  ops/       device programs: slab engine, Pallas kernels, hashing
+  parallel/  device mesh / shard_map sharded slab
+  models/    wire-level and internal data models
+  stats/     statsd metrics pipeline
+  utils/     time source, samplers
+  tracing/   span API (no-op default)
+"""
+
+__version__ = "0.1.0"

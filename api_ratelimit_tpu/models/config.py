@@ -1,0 +1,62 @@
+"""Config-side data models.
+
+Reference parity: src/config/config.go:11-32 (RateLimit, RateLimitStats,
+RateLimitConfigError) and the per-rule stats paths created at
+src/config/config_impl.go:64-71.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .response import RateLimitValue
+from .units import Unit
+
+
+class ConfigError(Exception):
+    """A rate limit configuration error (RateLimitConfigError in the
+    reference). Raised during load; callers keep the last good config."""
+
+
+@dataclass(slots=True)
+class RateLimitStats:
+    """Per-rule counters: total_hits / over_limit / near_limit /
+    over_limit_with_local_cache (src/config/config_impl.go:64-71)."""
+
+    total_hits: "Counter"
+    over_limit: "Counter"
+    near_limit: "Counter"
+    over_limit_with_local_cache: "Counter"
+
+
+def new_rate_limit_stats(scope, key: str) -> RateLimitStats:
+    return RateLimitStats(
+        total_hits=scope.counter(key + ".total_hits"),
+        over_limit=scope.counter(key + ".over_limit"),
+        near_limit=scope.counter(key + ".near_limit"),
+        over_limit_with_local_cache=scope.counter(key + ".over_limit_with_local_cache"),
+    )
+
+
+@dataclass(slots=True)
+class RateLimit:
+    """A resolved rate limit rule.
+
+    full_key is the dotted composite path (e.g. "domain.key_value.key2"),
+    used both for stats attribution and debugging. sleep_on_throttle and
+    report_details are Kentik fork extras (src/config/config.go:26-32).
+    """
+
+    full_key: str
+    stats: RateLimitStats
+    limit: RateLimitValue
+    sleep_on_throttle: bool = False
+    report_details: bool = False
+
+    @property
+    def requests_per_unit(self) -> int:
+        return self.limit.requests_per_unit
+
+    @property
+    def unit(self) -> Unit:
+        return self.limit.unit

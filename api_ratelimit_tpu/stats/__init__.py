@@ -1,0 +1,15 @@
+from .store import Store, Scope, Counter, Gauge, StatGenerator, new_null_store
+from .sinks import Sink, NullSink, TestSink, StatsdSink
+
+__all__ = [
+    "Store",
+    "Scope",
+    "Counter",
+    "Gauge",
+    "StatGenerator",
+    "new_null_store",
+    "Sink",
+    "NullSink",
+    "TestSink",
+    "StatsdSink",
+]

@@ -1,0 +1,221 @@
+"""Statsd-style metrics pipeline.
+
+A fresh implementation of the slice of lyft/gostats the reference uses
+(SURVEY.md section 2.3): Store with scoped Counter/Gauge creation, periodic
+flush to a sink, and StatGenerator hooks evaluated at flush time
+(reference usage: src/server/server_impl.go:176-181,
+src/limiter/local_cache_stats.go:20-43).
+
+Counters flush deltas (statsd "|c"), gauges flush absolute values ("|g").
+Stat objects are cached per name so repeated counter(name) calls return the
+same instance — per-rule stats in the config tree rely on this across hot
+reloads so counts survive a config swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Protocol
+
+
+class Counter:
+    """Monotonic counter. add/inc are thread-safe."""
+
+    __slots__ = ("name", "_value", "_flushed", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._flushed = 0
+        self._lock = threading.Lock()
+
+    def inc(self) -> None:
+        self.add(1)
+
+    def add(self, delta: int) -> None:
+        with self._lock:
+            self._value += int(delta)
+
+    def value(self) -> int:
+        return self._value
+
+    def latch_delta(self) -> int:
+        """Value accumulated since the previous flush."""
+        with self._lock:
+            delta = self._value - self._flushed
+            self._flushed = self._value
+            return delta
+
+
+class Gauge:
+    """Instantaneous value. set/add/sub are thread-safe enough for stats."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, value: int) -> None:
+        with self._lock:
+            self._value = int(value)
+
+    def add(self, delta: int) -> None:
+        with self._lock:
+            self._value += int(delta)
+
+    def sub(self, delta: int) -> None:
+        self.add(-delta)
+
+    def value(self) -> int:
+        return self._value
+
+
+class Timer:
+    """Millisecond timing observations, flushed individually ("|ms")."""
+
+    __slots__ = ("name", "_samples", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._samples: list[float] = []
+        self._lock = threading.Lock()
+
+    def add_value_ms(self, ms: float) -> None:
+        with self._lock:
+            self._samples.append(ms)
+
+    def latch(self) -> list[float]:
+        with self._lock:
+            out = self._samples
+            self._samples = []
+            return out
+
+
+class StatGenerator(Protocol):
+    """Evaluated at each flush to populate computed gauges
+    (gostats StatGenerator equivalent)."""
+
+    def generate_stats(self) -> None: ...
+
+
+class Scope:
+    """A dotted-name namespace over a Store."""
+
+    __slots__ = ("_store", "_prefix")
+
+    def __init__(self, store: "Store", prefix: str):
+        self._store = store
+        self._prefix = prefix
+
+    def _full(self, name: str) -> str:
+        return f"{self._prefix}.{name}" if self._prefix else name
+
+    def scope(self, name: str) -> "Scope":
+        return Scope(self._store, self._full(name))
+
+    def counter(self, name: str) -> Counter:
+        return self._store._counter(self._full(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._store._gauge(self._full(name))
+
+    def timer(self, name: str) -> Timer:
+        return self._store._timer(self._full(name))
+
+
+class Store(Scope):
+    """Root scope + flush loop. start_flushing spawns a daemon thread that
+    flushes every interval to the sink; flush() can also be called manually
+    (tests use a TestSink + manual flush)."""
+
+    def __init__(self, sink=None):
+        from .sinks import NullSink
+
+        self._sink = sink if sink is not None else NullSink()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._timers: dict[str, Timer] = {}
+        self._generators: list[StatGenerator] = []
+        self._reg_lock = threading.Lock()
+        self._flush_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        super().__init__(self, "")
+
+    # -- stat registration (cached by full name) --
+
+    def _counter(self, name: str) -> Counter:
+        with self._reg_lock:
+            stat = self._counters.get(name)
+            if stat is None:
+                stat = self._counters[name] = Counter(name)
+            return stat
+
+    def _gauge(self, name: str) -> Gauge:
+        with self._reg_lock:
+            stat = self._gauges.get(name)
+            if stat is None:
+                stat = self._gauges[name] = Gauge(name)
+            return stat
+
+    def _timer(self, name: str) -> Timer:
+        with self._reg_lock:
+            stat = self._timers.get(name)
+            if stat is None:
+                stat = self._timers[name] = Timer(name)
+            return stat
+
+    def add_stat_generator(self, generator: StatGenerator) -> None:
+        with self._reg_lock:
+            self._generators.append(generator)
+
+    # -- flushing --
+
+    def flush(self) -> None:
+        with self._reg_lock:
+            generators = list(self._generators)
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            timers = list(self._timers.values())
+        for gen in generators:
+            try:
+                gen.generate_stats()
+            except Exception:  # stats must never take the service down
+                pass
+        for c in counters:
+            delta = c.latch_delta()
+            if delta:
+                self._sink.flush_counter(c.name, delta)
+        for g in gauges:
+            self._sink.flush_gauge(g.name, g.value())
+        for t in timers:
+            for ms in t.latch():
+                self._sink.flush_timer(t.name, ms)
+        self._sink.flush()
+
+    def start_flushing(self, interval_seconds: float = 5.0) -> None:
+        if self._flush_thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(interval_seconds):
+                self.flush()
+
+        self._flush_thread = threading.Thread(
+            target=loop, name="stats-flush", daemon=True
+        )
+        self._flush_thread.start()
+
+    def stop_flushing(self) -> None:
+        self._stop.set()
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=1.0)
+            self._flush_thread = None
+
+
+def new_null_store() -> Store:
+    """A store that drops everything — the stats.NewStore(NullSink) idiom the
+    reference tests use (test/common/common.go:15-20)."""
+    return Store()

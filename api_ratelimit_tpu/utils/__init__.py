@@ -1,0 +1,16 @@
+from .timeutil import TimeSource, RealTimeSource, FakeTimeSource, calculate_reset
+from .sampler import Sampler, RandomSampler, BasicSampler, BurstSampler, SOMETIMES, OFTEN, RARELY
+
+__all__ = [
+    "TimeSource",
+    "RealTimeSource",
+    "FakeTimeSource",
+    "calculate_reset",
+    "Sampler",
+    "RandomSampler",
+    "BasicSampler",
+    "BurstSampler",
+    "SOMETIMES",
+    "OFTEN",
+    "RARELY",
+]

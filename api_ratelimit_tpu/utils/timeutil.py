@@ -1,0 +1,53 @@
+"""Time source abstraction + window math.
+
+Reference parity: src/utils/utilities.go:10-14 (TimeSource iface),
+src/utils/time.go:17-29 (real impl), src/utils/utilities.go:34-38
+(CalculateReset).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Protocol
+
+from ..models.units import Unit, unit_to_divider
+
+
+class TimeSource(Protocol):
+    def unix_now(self) -> int:
+        """Current unix time in whole seconds."""
+        ...
+
+    def sleep(self, seconds: float) -> None: ...
+
+
+class RealTimeSource:
+    def unix_now(self) -> int:
+        return int(time.time())
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeTimeSource:
+    """Deterministic time source for tests; sleeps advance virtual time."""
+
+    def __init__(self, now: int = 0):
+        self.now = int(now)
+        self.sleeps: list[float] = []
+
+    def unix_now(self) -> int:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += int(seconds)
+
+    def advance(self, seconds: int) -> None:
+        self.now += int(seconds)
+
+
+def calculate_reset(unit: Unit, now: int) -> int:
+    """Seconds until the current fixed window for `unit` resets."""
+    sec = unit_to_divider(unit)
+    return sec - now % sec

@@ -1,0 +1,33 @@
+"""Test harness setup.
+
+Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere, so
+sharding tests exercise real multi-device SPMD paths without TPU hardware
+(the driver separately dry-runs the multi-chip path; see __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def test_store():
+    from api_ratelimit_tpu.stats import Store, TestSink
+
+    sink = TestSink()
+    store = Store(sink)
+    return store, sink
+
+
+@pytest.fixture
+def fake_time():
+    from api_ratelimit_tpu.utils import FakeTimeSource
+
+    return FakeTimeSource(now=1234)

@@ -1,0 +1,53 @@
+"""Unit tests for the stats pipeline."""
+
+from api_ratelimit_tpu.stats import Store, TestSink, StatsdSink
+
+
+def test_counter_flush_delta(test_store):
+    store, sink = test_store
+    c = store.scope("ratelimit").counter("hits")
+    c.add(5)
+    c.inc()
+    store.flush()
+    assert sink.counters == {"ratelimit.hits": 6}
+    # second flush with no activity emits nothing new
+    store.flush()
+    assert sink.counters == {"ratelimit.hits": 6}
+    c.inc()
+    store.flush()
+    assert sink.counters == {"ratelimit.hits": 7}
+
+
+def test_scope_nesting_and_caching(test_store):
+    store, sink = test_store
+    a = store.scope("a").scope("b").counter("c")
+    b = store.scope("a.b").counter("c")
+    assert a is b  # same full name -> same stat (per-rule stats rely on this)
+    a.inc()
+    store.flush()
+    assert sink.counters == {"a.b.c": 1}
+
+
+def test_gauge_and_generator(test_store):
+    store, sink = test_store
+    g = store.gauge("pool.cx_active")
+
+    class Gen:
+        def generate_stats(self):
+            g.set(42)
+
+    store.add_stat_generator(Gen())
+    store.flush()
+    assert sink.gauges["pool.cx_active"] == 42
+
+
+def test_statsd_sink_format():
+    sent = []
+
+    sink = StatsdSink("localhost", 0, prefix="ratelimit")
+    sink._send = sent.append  # type: ignore
+    sink.flush_counter("x.y", 3)
+    sink.flush_gauge("g", 7)
+    sink.flush_timer("t", 1.5)
+    sink.flush()
+    assert sent == [b"ratelimit.x.y:3|c\nratelimit.g:7|g\nratelimit.t:1.5|ms"]
