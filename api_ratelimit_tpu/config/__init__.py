@@ -1,0 +1,13 @@
+from .loader import (
+    ConfigFile,
+    RateLimitConfig,
+    RateLimitConfigLoader,
+    load_config,
+)
+
+__all__ = [
+    "ConfigFile",
+    "RateLimitConfig",
+    "RateLimitConfigLoader",
+    "load_config",
+]

@@ -1,4 +1,4 @@
-from .store import Store, Scope, Counter, Gauge, StatGenerator, new_null_store
+from .store import Store, Scope, Counter, Gauge, Timer, StatGenerator, new_null_store
 from .sinks import Sink, NullSink, TestSink, StatsdSink
 
 __all__ = [
@@ -6,6 +6,7 @@ __all__ = [
     "Scope",
     "Counter",
     "Gauge",
+    "Timer",
     "StatGenerator",
     "new_null_store",
     "Sink",

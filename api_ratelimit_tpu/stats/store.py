@@ -184,20 +184,24 @@ class Store(Scope):
                 gen.generate_stats()
             except Exception:  # stats must never take the service down
                 pass
-        for c in counters:
-            delta = c.latch_delta()
-            if delta:
-                self._sink.flush_counter(c.name, delta)
-        for g in gauges:
-            self._sink.flush_gauge(g.name, g.value())
-        for t in timers:
-            for ms in t.latch():
-                self._sink.flush_timer(t.name, ms)
-        self._sink.flush()
+        try:
+            for c in counters:
+                delta = c.latch_delta()
+                if delta:
+                    self._sink.flush_counter(c.name, delta)
+            for g in gauges:
+                self._sink.flush_gauge(g.name, g.value())
+            for t in timers:
+                for ms in t.latch():
+                    self._sink.flush_timer(t.name, ms)
+            self._sink.flush()
+        except Exception:  # a failing sink must not kill the flush loop
+            pass
 
     def start_flushing(self, interval_seconds: float = 5.0) -> None:
         if self._flush_thread is not None:
             return
+        self._stop.clear()
 
         def loop() -> None:
             while not self._stop.wait(interval_seconds):

@@ -40,6 +40,8 @@ class BasicSampler:
         self._lock = threading.Lock()
 
     def sample(self) -> bool:
+        if self.n <= 0:
+            return False
         if self.n == 1:
             return True
         with self._lock:

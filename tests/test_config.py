@@ -1,0 +1,242 @@
+"""Config loader tests — scenario coverage mirrors the reference suite
+(test/config/config_test.go): tree matching with depth rules, default
+key buckets, whitelisting, per-descriptor overrides, and one case per
+validation error."""
+
+import pytest
+
+from api_ratelimit_tpu.config import ConfigFile, load_config
+from api_ratelimit_tpu.models import (
+    ConfigError,
+    Descriptor,
+    LimitOverride,
+    Unit,
+)
+from api_ratelimit_tpu.stats import Store, TestSink
+
+BASIC = """
+domain: test-domain
+descriptors:
+  - key: key1
+    value: value1
+    descriptors:
+      - key: subkey1
+        rate_limit:
+          unit: second
+          requests_per_unit: 5
+      - key: subkey1
+        value: subvalue1
+        rate_limit:
+          unit: second
+          requests_per_unit: 10
+  - key: key2
+    rate_limit:
+      unit: minute
+      requests_per_unit: 20
+  - key: key2
+    value: value2
+    rate_limit:
+      unit: minute
+      requests_per_unit: 30
+  - key: key2
+    value: value3
+  - key: key3
+    rate_limit:
+      unit: hour
+      requests_per_unit: 1
+  - key: key4
+    rate_limit:
+      unit: day
+      requests_per_unit: 1
+  - key: key5
+    value: value5
+    rate_limit:
+      unit: day
+      requests_per_unit: 15
+    descriptors:
+      - key: subkey5
+        value: subvalue5
+        rate_limit:
+          unit: day
+          requests_per_unit: 25
+"""
+
+
+def make_config(contents=BASIC, name="basic.yaml"):
+    store = Store(TestSink())
+    return load_config([ConfigFile(name, contents)], store), store
+
+
+def test_basic_tree_matching():
+    cfg, store = make_config()
+
+    assert cfg.get_limit("foo-domain", Descriptor.of()) is None
+    assert cfg.get_limit("test-domain", Descriptor.of()) is None
+    # key1 with unknown value: no default bucket for bare key1
+    assert cfg.get_limit("test-domain", Descriptor.of(("key1", "something"))) is None
+    # key1_value1 exists but carries no limit itself
+    assert cfg.get_limit("test-domain", Descriptor.of(("key1", "value1"))) is None
+    # request deeper than config depth: no limit
+    assert (
+        cfg.get_limit(
+            "test-domain", Descriptor.of(("key2", "value2"), ("subkey", "subvalue"))
+        )
+        is None
+    )
+    assert (
+        cfg.get_limit(
+            "test-domain", Descriptor.of(("key5", "value5"), ("subkey5", "subvalue"))
+        )
+        is None
+    )
+
+    # second level default bucket
+    rl = cfg.get_limit(
+        "test-domain", Descriptor.of(("key1", "value1"), ("subkey1", "something"))
+    )
+    assert rl.requests_per_unit == 5
+    assert rl.unit == Unit.SECOND
+    assert rl.full_key == "test-domain.key1_value1.subkey1"
+
+    # second level specific override
+    rl = cfg.get_limit(
+        "test-domain", Descriptor.of(("key1", "value1"), ("subkey1", "subvalue1"))
+    )
+    assert rl.requests_per_unit == 10
+    assert rl.full_key == "test-domain.key1_value1.subkey1_subvalue1"
+
+    # first level default bucket
+    rl = cfg.get_limit("test-domain", Descriptor.of(("key2", "something")))
+    assert (rl.requests_per_unit, rl.unit) == (20, Unit.MINUTE)
+
+    # first level specific override
+    rl = cfg.get_limit("test-domain", Descriptor.of(("key2", "value2")))
+    assert (rl.requests_per_unit, rl.unit) == (30, Unit.MINUTE)
+
+    # whitelisted value: node exists, no limit
+    assert cfg.get_limit("test-domain", Descriptor.of(("key2", "value3"))) is None
+
+    rl = cfg.get_limit("test-domain", Descriptor.of(("key3", "foo")))
+    assert (rl.requests_per_unit, rl.unit) == (1, Unit.HOUR)
+    rl = cfg.get_limit("test-domain", Descriptor.of(("key4", "foo")))
+    assert (rl.requests_per_unit, rl.unit) == (1, Unit.DAY)
+
+
+def test_per_rule_stats_paths():
+    cfg, store = make_config()
+    rl = cfg.get_limit(
+        "test-domain", Descriptor.of(("key1", "value1"), ("subkey1", "something"))
+    )
+    rl.stats.total_hits.inc()
+    rl.stats.over_limit.inc()
+    rl.stats.near_limit.inc()
+    assert store.counter("test-domain.key1_value1.subkey1.total_hits").value() == 1
+    assert store.counter("test-domain.key1_value1.subkey1.over_limit").value() == 1
+    assert store.counter("test-domain.key1_value1.subkey1.near_limit").value() == 1
+
+
+def test_limit_override():
+    cfg, store = make_config()
+    override = LimitOverride(requests_per_unit=10, unit=Unit.DAY)
+
+    # no matching domain: override does not apply
+    assert cfg.get_limit("foo-domain", Descriptor(limit=override)) is None
+
+    rl = cfg.get_limit(
+        "test-domain",
+        Descriptor(
+            entries=Descriptor.of(("key1", "value1"), ("subkey1", "something")).entries,
+            limit=override,
+        ),
+    )
+    assert rl.full_key == "test-domain.key1_value1.subkey1_something"
+    assert (rl.requests_per_unit, rl.unit) == (10, Unit.DAY)
+    rl.stats.total_hits.inc()
+
+    # same descriptor, different override value -> same stats (cached by name)
+    rl2 = cfg.get_limit(
+        "test-domain",
+        Descriptor(
+            entries=rl and Descriptor.of(("key1", "value1"), ("subkey1", "something")).entries,
+            limit=LimitOverride(requests_per_unit=42, unit=Unit.HOUR),
+        ),
+    )
+    assert (rl2.requests_per_unit, rl2.unit) == (42, Unit.HOUR)
+    rl2.stats.total_hits.inc()
+    assert (
+        store.counter("test-domain.key1_value1.subkey1_something.total_hits").value()
+        == 2
+    )
+
+
+def test_dump():
+    cfg, _ = make_config()
+    dump = cfg.dump()
+    assert "test-domain.key1_value1.subkey1: unit=SECOND requests_per_unit=5\n" in dump
+    assert "test-domain.key2: unit=MINUTE requests_per_unit=20\n" in dump
+
+
+def test_fork_extras_flags():
+    cfg, _ = make_config(
+        """
+domain: d
+descriptors:
+  - key: k
+    rate_limit:
+      unit: second
+      requests_per_unit: 1
+    sleep_on_throttle: true
+    report_details: true
+"""
+    )
+    rl = cfg.get_limit("d", Descriptor.of(("k", "v")))
+    assert rl.sleep_on_throttle is True
+    assert rl.report_details is True
+
+
+@pytest.mark.parametrize(
+    "contents,match",
+    [
+        ("descriptors:", "empty domain"),
+        ("domain: d\ndescriptors:\n  - value: v1\n", "empty key"),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    value: v\n  - key: k\n    value: v\n",
+            "duplicate descriptor composite key 'd.k_v'",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: foo\n      requests_per_unit: 5\n",
+            "invalid rate limit unit 'foo'",
+        ),
+        ("'''", "error loading config file"),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    ratelimit:\n      unit: day\n",
+            "unknown key 'ratelimit'",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requestsperunit: 5\n",
+            "unknown key 'requestsperunit'",
+        ),
+        ("0.25: d\ndescriptors:\n", "key is not of type string"),
+        ("domain: d\ndescriptors:\n  - a\n  - b\n", "list of type other than map"),
+    ],
+)
+def test_config_errors(contents, match):
+    with pytest.raises(ConfigError, match=match):
+        make_config(contents, name="error.yaml")
+
+
+def test_duplicate_domain_across_files():
+    store = Store(TestSink())
+    with pytest.raises(ConfigError, match="duplicate domain 'd'"):
+        load_config(
+            [
+                ConfigFile("one.yaml", "domain: d\ndescriptors:\n"),
+                ConfigFile("two.yaml", "domain: d\ndescriptors:\n"),
+            ],
+            store,
+        )
+
+
+def test_error_message_includes_file_name():
+    with pytest.raises(ConfigError, match="error.yaml:"):
+        make_config("descriptors:", name="error.yaml")
