@@ -1,0 +1,3 @@
+from .memory import MemoryRateLimitCache
+
+__all__ = ["MemoryRateLimitCache"]

@@ -1,0 +1,31 @@
+"""Backend seam: the cache interface every backend implements.
+
+Reference parity: src/limiter/cache.go:15-33. A nil/None limit means the
+descriptor is unchecked. flush() joins asynchronous work (used by tests and
+by backends that settle asynchronously, like the reference memcache backend
+and this framework's micro-batched TPU backend).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+from ..models.config import RateLimit
+from ..models.descriptors import RateLimitRequest
+from ..models.response import DoLimitResponse
+
+
+class CacheError(Exception):
+    """Backend failure (RedisError equivalent) — surfaced at the service
+    boundary as a typed gRPC error + redis_error counter
+    (src/redis/driver_impl.go:50-54, src/service/ratelimit.go:276-281)."""
+
+
+class RateLimitCache(Protocol):
+    def do_limit(
+        self,
+        request: RateLimitRequest,
+        limits: Sequence[RateLimit | None],
+    ) -> DoLimitResponse: ...
+
+    def flush(self) -> None: ...

@@ -1,0 +1,229 @@
+"""Limiter core + memory-oracle backend tests.
+
+Scenario coverage mirrors the reference suites
+(test/limiter/base_limiter_test.go, test/redis/fixed_cache_impl_test.go):
+window key math pinned at a fixed timestamp, per-second flagging, local-cache
+short-circuit with zero backend traffic, near/over-limit stats attribution,
+and the ThrottleMillis pacing expectation (400000 in the canonical scenario).
+"""
+
+import random
+
+import pytest
+
+from api_ratelimit_tpu.backends import MemoryRateLimitCache
+from api_ratelimit_tpu.limiter import BaseRateLimiter, LocalCache, generate_cache_key
+from api_ratelimit_tpu.limiter.local_cache import LocalCacheStats
+from api_ratelimit_tpu.models import (
+    Code,
+    Descriptor,
+    RateLimitRequest,
+    Unit,
+)
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+def make_limit(store, rpu, unit, key="key_value", **kw):
+    # Build a rule directly through the models factory — no YAML plumbing.
+    from api_ratelimit_tpu.models.config import RateLimit, new_rate_limit_stats
+    from api_ratelimit_tpu.models.response import RateLimitValue
+
+    return RateLimit(
+        full_key=key,
+        stats=new_rate_limit_stats(store, key),
+        limit=RateLimitValue(requests_per_unit=rpu, unit=unit),
+        **kw,
+    )
+
+
+@pytest.fixture
+def store():
+    return Store(TestSink())
+
+
+def req(*pairs, hits=1, domain="domain"):
+    return RateLimitRequest(
+        domain=domain,
+        descriptors=tuple(Descriptor.of(p) for p in pairs),
+        hits_addend=hits,
+    )
+
+
+class TestCacheKey:
+    def test_window_snapping(self, store):
+        limit = make_limit(store, 10, Unit.SECOND)
+        key = generate_cache_key("domain", Descriptor.of(("key", "value")), limit, 1234)
+        assert key.key == "domain_key_value_1234"
+        assert key.per_second is True
+
+        limit_m = make_limit(store, 10, Unit.MINUTE)
+        key = generate_cache_key("domain", Descriptor.of(("key", "value")), limit_m, 1234)
+        assert key.key == "domain_key_value_1200"
+        assert key.per_second is False
+
+        limit_h = make_limit(store, 10, Unit.HOUR)
+        assert (
+            generate_cache_key("domain", Descriptor.of(("k", "v")), limit_h, 1000000).key
+            == "domain_k_v_997200"
+        )
+
+    def test_multi_entry_and_nil_limit(self, store):
+        limit = make_limit(store, 10, Unit.DAY)
+        key = generate_cache_key(
+            "domain",
+            Descriptor.of(("a", "b"), ("c", "d")),
+            limit,
+            1234,
+        )
+        assert key.key == "domain_a_b_c_d_0"
+        assert generate_cache_key("domain", Descriptor.of(("a", "b")), None, 1234).key == ""
+
+
+def make_cache(store, now=1_000_000, local_cache_size=0, near_ratio=0.8, jitter_max=0):
+    ts = FakeTimeSource(now)
+    local = LocalCache(local_cache_size, ts) if local_cache_size else None
+    base = BaseRateLimiter(
+        ts,
+        jitter_rand=random.Random(1),
+        expiration_jitter_max_seconds=jitter_max,
+        local_cache=local,
+        near_limit_ratio=near_ratio,
+    )
+    return MemoryRateLimitCache(base), ts, local
+
+
+class TestMemoryCacheDecisions:
+    def test_under_near_at_near_over_and_local_cache(self, store):
+        cache, ts, local = make_cache(store, local_cache_size=100)
+        limit = make_limit(store, 15, Unit.HOUR, key="key4_value4")
+        request = req(("key4", "value4"))
+
+        # Counter 1..11: under near limit (floor(15*0.8)=12).
+        for _ in range(11):
+            resp = cache.do_limit(request, [limit])
+        status = resp.descriptor_statuses[0]
+        assert status.code == Code.OK
+        assert status.limit_remaining == 4
+        assert status.duration_until_reset == 800  # window ends at 1000800
+        assert resp.throttle_millis == 0
+        assert limit.stats.near_limit.value() == 0
+
+        # 12th: at the near threshold, still no near-limit accounting.
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert limit.stats.near_limit.value() == 0
+
+        # 13th: near limit; pacing = 800000ms remaining / 2 calls = 400000.
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].limit_remaining == 2
+        assert resp.throttle_millis == 400_000
+        assert limit.stats.near_limit.value() == 1
+
+        # 14th, 15th: still OK.
+        cache.do_limit(request, [limit])
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].limit_remaining == 0
+
+        # 16th: over limit; near=3 (counts 13,14,15), over=1.
+        resp = cache.do_limit(request, [limit])
+        status = resp.descriptor_statuses[0]
+        assert status.code == Code.OVER_LIMIT
+        assert status.limit_remaining == 0
+        assert limit.stats.over_limit.value() == 1
+        assert limit.stats.near_limit.value() == 3
+        assert limit.stats.over_limit_with_local_cache.value() == 0
+
+        # 17th: served from the local over-limit cache — no backend touch.
+        count_before = cache.peek("domain_key4_value4_997200")
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        assert cache.peek("domain_key4_value4_997200") == count_before
+        assert limit.stats.over_limit.value() == 2
+        assert limit.stats.over_limit_with_local_cache.value() == 1
+        assert limit.stats.total_hits.value() == 17
+
+    def test_hits_addend_attribution_split(self, store):
+        # Call 1: hits=11 -> after=11 > near threshold 9: near += 11-9 = 2.
+        # Call 2: before=11, addend=3 -> after=14 vs limit 12:
+        # over += 14-12 = 2, near += 12 - max(9, 11) = 1 -> near total 3.
+        cache, ts, _ = make_cache(store)
+        limit = make_limit(store, 12, Unit.HOUR, key="k_v")
+        request = req(("k", "v"), hits=11)
+        cache.do_limit(request, [limit])
+        assert limit.stats.near_limit.value() == 2
+        resp = cache.do_limit(req(("k", "v"), hits=3), [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        assert limit.stats.over_limit.value() == 2
+        assert limit.stats.near_limit.value() == 3
+
+        # Entirely-over addend: before=14 >= 12 -> all hits over.
+        resp = cache.do_limit(req(("k", "v"), hits=5), [limit])
+        assert limit.stats.over_limit.value() == 7
+
+    def test_nil_limit_descriptor_unchecked(self, store):
+        cache, _, _ = make_cache(store)
+        limit = make_limit(store, 10, Unit.SECOND)
+        resp = cache.do_limit(req(("a", "a"), ("b", "b")), [None, limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].current_limit is None
+        assert resp.descriptor_statuses[0].duration_until_reset is None
+        assert resp.descriptor_statuses[1].code == Code.OK
+        assert resp.descriptor_statuses[1].current_limit is not None
+
+    def test_window_rollover_resets_counts(self, store):
+        cache, ts, _ = make_cache(store)
+        limit = make_limit(store, 2, Unit.SECOND, key="s")
+        request = req(("s", "1"))
+        cache.do_limit(request, [limit])
+        cache.do_limit(request, [limit])
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        ts.advance(1)  # next second window -> new key -> fresh counter
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].limit_remaining == 1
+
+    def test_expiration_jitter(self, store):
+        cache, ts, _ = make_cache(store, jitter_max=300)
+        base = cache._base
+        rng = random.Random(1)
+        expected = 3600 + rng.randrange(300)
+        assert base.expiration_seconds(3600) == expected
+
+    def test_overall_multi_descriptor(self, store):
+        cache, _, _ = make_cache(store)
+        l1 = make_limit(store, 10, Unit.SECOND, key="l1")
+        l2 = make_limit(store, 1, Unit.MINUTE, key="l2")
+        request = req(("a", "1"), ("b", "2"), hits=2)
+        resp = cache.do_limit(request, [l1, l2])
+        codes = [s.code for s in resp.descriptor_statuses]
+        assert codes == [Code.OK, Code.OVER_LIMIT]
+
+
+class TestLocalCache:
+    def test_ttl_and_stats(self, store):
+        ts = FakeTimeSource(100)
+        cache = LocalCache(max_entries=2, time_source=ts)
+        stats = LocalCacheStats(cache, store.scope("localcache"))
+
+        assert cache.contains("a") is False
+        cache.set("a", ttl_seconds=10)
+        assert cache.contains("a") is True
+        ts.advance(10)
+        assert cache.contains("a") is False  # expired exactly at ttl
+
+        cache.set("x", 100)
+        cache.set("y", 100)
+        cache.set("z", 100)  # evicts oldest
+        assert cache.entry_count() == 2
+
+        stats.generate_stats()
+        store.flush()
+        sink = store._sink
+        assert sink.gauges["localcache.hitCount"] == 1
+        assert sink.gauges["localcache.missCount"] == 2
+        assert sink.gauges["localcache.lookupCount"] == 3
+        assert sink.gauges["localcache.expiredCount"] == 1
+        assert sink.gauges["localcache.evacuateCount"] == 1
