@@ -1,0 +1,10 @@
+from .hashing import fingerprint64, rule_fingerprint
+from .slab import SlabState, make_slab, slab_update_and_decide
+
+__all__ = [
+    "fingerprint64",
+    "rule_fingerprint",
+    "SlabState",
+    "make_slab",
+    "slab_update_and_decide",
+]

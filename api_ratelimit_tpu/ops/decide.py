@@ -1,0 +1,107 @@
+"""Vectorized fixed-window decision math (device-side).
+
+The batched twin of limiter/base_limiter.py's scalar oracle — one fused
+elementwise block over the batch, mirroring src/limiter/base_limiter.go:
+  * near threshold = floor(float32(limit) * near_ratio)      (:83-86)
+  * OVER_LIMIT when after > limit                            (:88)
+  * limit_remaining = limit - after on the OK branch         (:107-109)
+  * stats attribution split across near/over by before/after (:129-145)
+  * throttle pacing = millis-remaining-in-window / max(calls_remaining, 1)
+    whenever after > near threshold on the OK branch         (:154-165)
+  * duration_until_reset = divider - now % divider           (utilities.go:34-38)
+
+All counters are uint32; subtractions are guarded by `where` so the selected
+branch never underflows (the unselected branch may wrap — it is discarded).
+
+This module is pure jnp (XLA fuses it into the surrounding program); the
+Pallas kernel in pallas_decide.py computes the identical function as a single
+VPU kernel and is used on TPU when enabled.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# Codes match envoy RateLimitResponse.Code (models/response.py).
+CODE_OK = 1
+CODE_OVER_LIMIT = 2
+
+
+class DecideResult(NamedTuple):
+    code: jnp.ndarray  # int32: 1=OK, 2=OVER_LIMIT
+    limit_remaining: jnp.ndarray  # uint32
+    duration_until_reset: jnp.ndarray  # int32 seconds
+    throttle_millis: jnp.ndarray  # uint32 per item (caller max-reduces)
+    near_delta: jnp.ndarray  # uint32: near_limit stats contribution
+    over_delta: jnp.ndarray  # uint32: over_limit stats contribution
+
+
+def decide(
+    before: jnp.ndarray,  # uint32 counter value before this addend
+    after: jnp.ndarray,  # uint32 counter value after this addend
+    hits: jnp.ndarray,  # uint32 hits addend (0 => padding/unchecked item)
+    limit: jnp.ndarray,  # uint32 requests_per_unit
+    divider: jnp.ndarray,  # int32 seconds per window
+    now: jnp.ndarray,  # int32 scalar unix seconds
+    near_ratio: jnp.ndarray,  # float32 scalar
+) -> DecideResult:
+    u32 = jnp.uint32
+    before = before.astype(u32)
+    after = after.astype(u32)
+    hits = hits.astype(u32)
+    limit = limit.astype(u32)
+    divider = divider.astype(jnp.int32)
+    now = now.astype(jnp.int32)
+
+    over_threshold = limit
+    near_threshold = jnp.floor(
+        limit.astype(jnp.float32) * near_ratio.astype(jnp.float32)
+    ).astype(u32)
+
+    is_over = after > over_threshold
+    near_exceeded = after > near_threshold
+
+    # OVER branch stats split (base_limiter.go:129-145)
+    all_over = before >= over_threshold
+    over_delta_over = jnp.where(all_over, hits, after - over_threshold)
+    near_delta_over = jnp.where(
+        all_over, jnp.zeros_like(hits), over_threshold - jnp.maximum(near_threshold, before)
+    )
+
+    # OK branch near accounting (base_limiter.go:154-177)
+    near_delta_ok = jnp.where(
+        near_exceeded,
+        jnp.where(before >= near_threshold, hits, after - near_threshold),
+        jnp.zeros_like(hits),
+    )
+
+    # Pacing (OK branch only, when past the near threshold). Padding rows may
+    # carry divider 0; clamp so device integer division is always defined.
+    divider = jnp.maximum(divider, 1)
+    window_end = (now // divider) * divider + divider
+    millis_remaining = ((window_end - now) * 1000).astype(u32)
+    calls_remaining = jnp.maximum(over_threshold - after, jnp.uint32(1))
+    throttle = jnp.where(
+        jnp.logical_and(near_exceeded, jnp.logical_not(is_over)),
+        millis_remaining // calls_remaining,
+        jnp.uint32(0),
+    )
+
+    code = jnp.where(is_over, jnp.int32(CODE_OVER_LIMIT), jnp.int32(CODE_OK))
+    remaining = jnp.where(is_over, jnp.uint32(0), over_threshold - after)
+    duration = divider - now % divider
+
+    # Padding/unchecked items (hits == 0) are forced to a plain OK with no
+    # stats contribution; the host assembles their statuses separately.
+    valid = hits > 0
+    zero = jnp.uint32(0)
+    return DecideResult(
+        code=jnp.where(valid, code, jnp.int32(CODE_OK)),
+        limit_remaining=jnp.where(valid, remaining, zero),
+        duration_until_reset=jnp.where(valid, duration, jnp.int32(0)),
+        throttle_millis=jnp.where(valid, throttle, zero),
+        near_delta=jnp.where(valid, jnp.where(is_over, near_delta_over, near_delta_ok), zero),
+        over_delta=jnp.where(valid, jnp.where(is_over, over_delta_over, zero), zero),
+    )

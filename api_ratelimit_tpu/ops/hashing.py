@@ -1,0 +1,47 @@
+"""Descriptor fingerprinting for the slab.
+
+The TPU slab never sees strings: a rule-resolved descriptor is identified by
+a 64-bit xxhash fingerprint of (domain, entry key/value path, window divider).
+The window timestamp deliberately stays OUT of the fingerprint — the slab
+stores the window start per slot and resets in place at rollover, which is
+the TPU-native equivalent of the reference's "window baked into the Redis key
++ TTL" scheme (src/limiter/cache_key.go:67-68). Including the divider also
+removes the reference's window-boundary key-aliasing quirk (a SECOND key and
+a MINUTE key for the same descriptor collide at exact minute boundaries).
+
+Fingerprints are split into (lo, hi) uint32 halves — TPUs run with 32-bit
+lanes; 64-bit integer arrays are avoided on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import xxhash
+
+_SEP = b"\x1f"  # unit separator: cannot appear in descriptor text keys
+
+
+def fingerprint64(domain: str, entries, divider: int) -> int:
+    """64-bit fingerprint of a resolved (domain, descriptor, window-unit)."""
+    h = xxhash.xxh64(seed=divider)
+    h.update(domain.encode())
+    for entry in entries:
+        h.update(_SEP)
+        h.update(entry.key.encode())
+        h.update(_SEP)
+        h.update(entry.value.encode())
+    return h.intdigest()
+
+
+def rule_fingerprint(domain: str, descriptor, divider: int) -> tuple[int, int]:
+    """(lo, hi) uint32 halves for device transfer."""
+    fp = fingerprint64(domain, descriptor.entries, divider)
+    return fp & 0xFFFFFFFF, fp >> 32
+
+
+def split_fingerprints(fps: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized split of uint64 fingerprints into (lo, hi) uint32 arrays."""
+    fps = np.asarray(fps, dtype=np.uint64)
+    lo = (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (fps >> np.uint64(32)).astype(np.uint32)
+    return lo, hi

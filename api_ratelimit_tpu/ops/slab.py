@@ -1,0 +1,214 @@
+"""The HBM key slab: TPU-native replacement for Redis's INCRBY/EXPIRE engine.
+
+The reference delegates its hot mutation path to an external Redis process
+(src/redis/fixed_cache_impl.go:26-29: INCRBY + EXPIRE per key, one RTT per
+pipeline). Here the counter store lives in device HBM and a whole micro-batch
+of decisions executes as ONE jitted device program:
+
+    probe -> window-reset -> duplicate-serialized increment -> decide
+
+Slab layout (structure-of-arrays, n_slots a power of two):
+    fp_lo, fp_hi : uint32  64-bit key fingerprint halves
+    count        : uint32  fixed-window counter
+    window       : int32   window start (unix s) the counter belongs to
+    expire_at    : int32   slot reclaim time (window TTL + jitter)
+
+A slot is LIVE while expire_at > now; expired slots are reusable in place —
+the TPU equivalent of Redis TTL eviction (SURVEY.md section 5.4: restart ==
+flushed slab == refilled windows; no checkpoint needed by design).
+
+Algorithm per batch (all vectorized, no data-dependent Python control flow):
+  1. K-way double-hash probe: candidate j = (fp_lo + j * (fp_hi | 1)) mod n.
+     First candidate whose live fingerprint matches wins; otherwise the first
+     dead candidate; otherwise candidate 0 is stolen (bounded displacement —
+     with load < ~50% and K=8 the steal probability is negligible; a steal
+     fails open for the victim key, matching the reference's
+     fail-open-on-backend-loss posture, README.md:567-568).
+  2. Duplicate keys within a batch must serialize (the reference serializes
+     via per-command Redis execution): sort items by chosen slot, take
+     segment-exclusive cumulative sums of hits so item i sees
+     before_i = stored_base + hits of earlier same-key items in the batch.
+  3. Window rollover: stored window != item's current window => base 0.
+  4. One scatter per segment (last item writes count/window/fp/expire).
+  5. Fused decision math (ops/decide.py) gives code/remaining/throttle and
+     the near/over stats deltas the host adds to per-rule counters.
+
+The batch dimension is padded to fixed bucket sizes by the backend so XLA
+compiles a handful of shapes once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .decide import DecideResult, decide
+
+
+class SlabState(NamedTuple):
+    fp_lo: jnp.ndarray  # uint32[n]
+    fp_hi: jnp.ndarray  # uint32[n]
+    count: jnp.ndarray  # uint32[n]
+    window: jnp.ndarray  # int32[n]
+    expire_at: jnp.ndarray  # int32[n]
+
+    @property
+    def n_slots(self) -> int:
+        return self.fp_lo.shape[0]
+
+
+class SlabBatch(NamedTuple):
+    """One micro-batch of decisions. hits == 0 marks padding."""
+
+    fp_lo: jnp.ndarray  # uint32[b]
+    fp_hi: jnp.ndarray  # uint32[b]
+    hits: jnp.ndarray  # uint32[b]
+    limit: jnp.ndarray  # uint32[b] requests_per_unit
+    divider: jnp.ndarray  # int32[b] seconds per window
+    jitter: jnp.ndarray  # int32[b] expiry jitter seconds
+
+
+class SlabResult(NamedTuple):
+    before: jnp.ndarray  # uint32[b]
+    after: jnp.ndarray  # uint32[b]
+    decision: DecideResult
+
+
+def make_slab(n_slots: int, device=None) -> SlabState:
+    if n_slots & (n_slots - 1):
+        raise ValueError(f"n_slots must be a power of two, got {n_slots}")
+    def mk(dtype):
+        arr = jnp.zeros((n_slots,), dtype=dtype)
+        return jax.device_put(arr, device) if device is not None else arr
+
+    return SlabState(
+        fp_lo=mk(jnp.uint32),
+        fp_hi=mk(jnp.uint32),
+        count=mk(jnp.uint32),
+        window=mk(jnp.int32),
+        expire_at=mk(jnp.int32),
+    )
+
+
+def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
+    """K-way probe; returns int32[b] chosen slot (n_slots for padding)."""
+    n = state.n_slots
+    mask = jnp.uint32(n - 1)
+    b = batch.fp_lo.shape[0]
+
+    step = batch.fp_hi | jnp.uint32(1)  # odd => full cycle on power-of-two table
+    j = jnp.arange(n_probes, dtype=jnp.uint32)
+    cand = ((batch.fp_lo[:, None] + j[None, :] * step[:, None]) & mask).astype(jnp.int32)
+
+    live = state.expire_at[cand] > now
+    match = live & (state.fp_lo[cand] == batch.fp_lo[:, None]) & (
+        state.fp_hi[cand] == batch.fp_hi[:, None]
+    )
+    avail = ~live
+
+    match_any = match.any(axis=1)
+    avail_any = avail.any(axis=1)
+    match_first = jnp.argmax(match, axis=1)
+    avail_first = jnp.argmax(avail, axis=1)
+    pick = jnp.where(match_any, match_first, jnp.where(avail_any, avail_first, 0))
+    chosen = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]
+
+    valid = batch.hits > 0
+    return jnp.where(valid, chosen, jnp.int32(n))
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes",), donate_argnames=("state",))
+def slab_update_and_decide(
+    state: SlabState,
+    batch: SlabBatch,
+    now: jnp.ndarray,  # int32 scalar
+    near_ratio: jnp.ndarray,  # float32 scalar
+    n_probes: int = 8,
+) -> tuple[SlabState, SlabResult]:
+    n = state.n_slots
+    now = now.astype(jnp.int32)
+
+    chosen = _choose_slots(state, batch, now, n_probes)
+
+    # --- serialize duplicates: lexicographic stable sort by (slot, fp) so
+    # each key's items are contiguous. Distinct keys can land on the same
+    # slot in one batch (both probed pre-batch state); they become separate
+    # segments and only one of them persists (see write rule below).
+    b = chosen.shape[0]
+    (s_slot, s_fp_hi, s_fp_lo, order) = jax.lax.sort(
+        (chosen, batch.fp_hi, batch.fp_lo, jnp.arange(b, dtype=jnp.int32)),
+        num_keys=3,
+        is_stable=True,
+    )
+    s_hits = batch.hits[order]
+    s_div = batch.divider[order]
+    s_jit = batch.jitter[order]
+
+    same_prev = (
+        (s_slot[1:] == s_slot[:-1])
+        & (s_fp_lo[1:] == s_fp_lo[:-1])
+        & (s_fp_hi[1:] == s_fp_hi[:-1])
+    )
+    seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
+    incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+    excl = incl - s_hits
+    # forward-fill each segment's starting exclusive-sum (excl is
+    # nondecreasing, so a running max of masked values is a forward fill)
+    seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+    prior_in_batch = excl - seg_base_excl
+
+    # --- stored slot state (clamped gather; padding reads are discarded) ---
+    g_slot = jnp.minimum(s_slot, n - 1)
+    st_count = state.count[g_slot]
+    st_window = state.window[g_slot]
+    st_expire = state.expire_at[g_slot]
+    st_fp_lo = state.fp_lo[g_slot]
+    st_fp_hi = state.fp_hi[g_slot]
+
+    safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
+    cur_window = (now // safe_div) * safe_div
+    slot_live = st_expire > now
+    fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
+    same_window = st_window == cur_window
+    base = jnp.where(fp_match & same_window, st_count, jnp.uint32(0))
+
+    s_before = base + prior_in_batch
+    s_after = s_before + s_hits
+
+    # --- one writer per SLOT: the final item in the slot's run. When two
+    # distinct keys contend for one slot in the same batch, the last segment
+    # wins the slot and the loser's count simply is not persisted (it decides
+    # on its own in-batch hits and re-probes next batch) — a one-batch
+    # undercount that fails open, like the reference under backend loss.
+    is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
+    s_valid = s_hits > 0
+    write_idx = jnp.where(is_last & s_valid, s_slot, jnp.int32(n))
+
+    new_state = SlabState(
+        fp_lo=state.fp_lo.at[write_idx].set(s_fp_lo, mode="drop"),
+        fp_hi=state.fp_hi.at[write_idx].set(s_fp_hi, mode="drop"),
+        count=state.count.at[write_idx].set(s_after, mode="drop"),
+        window=state.window.at[write_idx].set(cur_window, mode="drop"),
+        expire_at=state.expire_at.at[write_idx].set(
+            now + s_div + s_jit, mode="drop"
+        ),
+    )
+
+    # --- unsort + decide ---
+    inv = jnp.argsort(order, stable=True)
+    before = s_before[inv]
+    after = s_after[inv]
+
+    decision = decide(
+        before=before,
+        after=after,
+        hits=batch.hits,
+        limit=batch.limit,
+        divider=batch.divider,
+        now=now,
+        near_ratio=near_ratio,
+    )
+    return new_state, SlabResult(before=before, after=after, decision=decision)

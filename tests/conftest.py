@@ -7,12 +7,19 @@ sharding tests exercise real multi-device SPMD paths without TPU hardware
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon site package (PYTHONPATH=/root/.axon_site) force-sets
+# jax_platforms=axon,cpu at jax import, overriding the env var — tests must
+# run on the virtual 8-device CPU mesh, so override it back post-import.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,233 @@
+"""Slab engine tests: probe/update semantics + differential parity between the
+device decision math and the scalar host oracle (base_limiter)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.ops.decide import decide
+from api_ratelimit_tpu.ops.slab import (
+    SlabBatch,
+    make_slab,
+    slab_update_and_decide,
+)
+
+N_SLOTS = 1 << 12
+
+
+def make_batch(items, pad_to=None):
+    """items: list of (fp, hits, limit, divider)."""
+    b = len(items)
+    size = pad_to or b
+    fp = np.zeros(size, dtype=np.uint64)
+    hits = np.zeros(size, dtype=np.uint32)
+    limit = np.zeros(size, dtype=np.uint32)
+    divider = np.ones(size, dtype=np.int32)
+    for i, (f, h, l, d) in enumerate(items):
+        fp[i], hits[i], limit[i], divider[i] = f, h, l, d
+    return SlabBatch(
+        fp_lo=jnp.asarray((fp & 0xFFFFFFFF).astype(np.uint32)),
+        fp_hi=jnp.asarray((fp >> 32).astype(np.uint32)),
+        hits=jnp.asarray(hits),
+        limit=jnp.asarray(limit),
+        divider=jnp.asarray(divider),
+        jitter=jnp.zeros(size, dtype=jnp.int32),
+    )
+
+
+def run(state, items, now, pad_to=None, near_ratio=0.8):
+    state, res = slab_update_and_decide(
+        state,
+        make_batch(items, pad_to),
+        jnp.int32(now),
+        jnp.float32(near_ratio),
+    )
+    return state, res
+
+
+KEY_A = 0xDEADBEEFCAFEF00D
+KEY_B = 0x1234567890ABCDEF
+
+
+class TestSlabBasics:
+    def test_increment_and_over_limit(self):
+        state = make_slab(N_SLOTS)
+        # limit 3/second at now=1000
+        for i, want_code in enumerate([1, 1, 1, 2, 2]):
+            state, res = run(state, [(KEY_A, 1, 3, 1)], now=1000)
+            assert int(res.after[0]) == i + 1
+            assert int(res.decision.code[0]) == want_code
+
+    def test_window_rollover_resets(self):
+        state = make_slab(N_SLOTS)
+        state, res = run(state, [(KEY_A, 3, 3, 1)], now=1000)
+        assert int(res.decision.code[0]) == 1  # 3 <= 3: still OK
+        state, res = run(state, [(KEY_A, 1, 3, 1)], now=1000)
+        assert int(res.decision.code[0]) == 2
+        state, res = run(state, [(KEY_A, 1, 3, 1)], now=1001)  # next window
+        assert int(res.decision.code[0]) == 1
+        assert int(res.before[0]) == 0 and int(res.after[0]) == 1
+
+    def test_distinct_keys_do_not_share_counters(self):
+        state = make_slab(N_SLOTS)
+        state, res = run(state, [(KEY_A, 5, 10, 60)], now=1000)
+        state, res = run(state, [(KEY_B, 1, 10, 60)], now=1000)
+        assert int(res.before[0]) == 0
+        assert int(res.after[0]) == 1
+
+    def test_duplicates_in_one_batch_serialize(self):
+        state = make_slab(N_SLOTS)
+        items = [(KEY_A, 2, 5, 60), (KEY_B, 1, 5, 60), (KEY_A, 3, 5, 60)]
+        state, res = run(state, items, now=1000)
+        # KEY_A first sees before=0/after=2, second sees before=2/after=5.
+        assert [int(x) for x in res.before] == [0, 0, 2]
+        assert [int(x) for x in res.after] == [2, 1, 5]
+        # A later batch sees the settled count.
+        state, res = run(state, [(KEY_A, 1, 5, 60)], now=1000)
+        assert int(res.before[0]) == 5
+        assert int(res.decision.code[0]) == 2
+
+    def test_padding_items_are_inert(self):
+        state = make_slab(N_SLOTS)
+        state, res = run(state, [(KEY_A, 1, 5, 60)], now=1000, pad_to=8)
+        assert int(res.after[0]) == 1
+        assert [int(c) for c in res.decision.code] == [1] * 8
+        assert int(res.decision.near_delta.sum()) == 0
+        # padding wrote nothing: a fresh key still starts at 0
+        state, res = run(state, [(KEY_B, 1, 5, 60)], now=1000)
+        assert int(res.before[0]) == 0
+
+    def test_expired_slot_reused_by_new_key(self):
+        state = make_slab(N_SLOTS)
+        state, _ = run(state, [(KEY_A, 1, 5, 1)], now=1000)
+        # KEY_A's slot expires after its 1s window (+0 jitter)
+        state, res = run(state, [(KEY_B, 1, 5, 60)], now=2000)
+        assert int(res.before[0]) == 0
+        # KEY_A comes back later: fresh counter (old entry was reclaimed or stale)
+        state, res = run(state, [(KEY_A, 1, 5, 1)], now=2000)
+        assert int(res.before[0]) == 0
+
+    def test_same_slot_distinct_keys_in_one_batch(self):
+        # Two DIFFERENT keys whose first probe candidate coincides must not
+        # merge into one counter: each decides on its own hits; one of them
+        # wins the slot (the loser's count is not persisted — fails open).
+        state = make_slab(N_SLOTS)
+        k1 = 5  # fp_lo=5, fp_hi=0
+        k2 = 5 + (N_SLOTS << 32)  # same candidate-0 slot, different fp_hi
+        state, res = run(state, [(k1, 3, 4, 60), (k2, 2, 4, 60)], now=1000)
+        assert [int(x) for x in res.before] == [0, 0]
+        assert [int(x) for x in res.after] == [3, 2]
+        assert [int(c) for c in res.decision.code] == [1, 1]
+        # next batch: both keys again; whichever lost the slot re-probes and
+        # may restart from 0, but neither may see the other's count.
+        state, res = run(state, [(k1, 1, 100, 60), (k2, 1, 100, 60)], now=1000)
+        assert int(res.before[0]) in (0, 3)
+        assert int(res.before[1]) in (0, 2)
+
+    def test_dual_window_same_descriptor(self):
+        # per-second + per-hour limits on the same descriptor path must use
+        # distinct slab entries (divider is part of the fingerprint upstream;
+        # here we emulate with distinct fps).
+        state = make_slab(N_SLOTS)
+        sec_key, hour_key = KEY_A, KEY_A ^ 0x1
+        state, res = run(
+            state, [(sec_key, 1, 2, 1), (hour_key, 1, 100, 3600)], now=1000
+        )
+        assert [int(x) for x in res.after] == [1, 1]
+        state, res = run(
+            state, [(sec_key, 1, 2, 1), (hour_key, 1, 100, 3600)], now=1001
+        )
+        # second window rolled; hour window did not
+        assert [int(x) for x in res.after] == [1, 2]
+
+
+class TestDecideParityWithOracle:
+    """decide() must agree with the scalar BaseRateLimiter math on every
+    branch: randomized differential test."""
+
+    def test_randomized_parity(self):
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter, LimitInfo
+        from api_ratelimit_tpu.models.config import RateLimit, new_rate_limit_stats
+        from api_ratelimit_tpu.models.response import DoLimitResponse, RateLimitValue
+        from api_ratelimit_tpu.models.units import Unit
+        from api_ratelimit_tpu.stats import Store, TestSink
+        from api_ratelimit_tpu.utils import FakeTimeSource
+
+        rng = random.Random(42)
+        unit_by_div = {1: Unit.SECOND, 60: Unit.MINUTE, 3600: Unit.HOUR, 86400: Unit.DAY}
+        cases = []
+        for _ in range(500):
+            divider = rng.choice([1, 60, 3600, 86400])
+            limit = rng.randrange(1, 50)
+            hits = rng.randrange(1, 8)
+            before = rng.randrange(0, limit + 10)
+            now = rng.randrange(1, 2_000_000)
+            cases.append((before, before + hits, hits, limit, divider, now))
+
+        store = Store(TestSink())
+        for i, (before, after, hits, limit, divider, now) in enumerate(cases):
+            res = decide(
+                jnp.uint32(before),
+                jnp.uint32(after),
+                jnp.uint32(hits),
+                jnp.uint32(limit),
+                jnp.int32(divider),
+                jnp.int32(now),
+                jnp.float32(0.8),
+            )
+
+            ts = FakeTimeSource(now)
+            rl = RateLimit(
+                full_key=f"case{i}",
+                stats=new_rate_limit_stats(store, f"case{i}"),
+                limit=RateLimitValue(limit, unit_by_div[divider]),
+            )
+            base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+            info = LimitInfo(rl, before, after)
+            resp = DoLimitResponse()
+            status = base.get_response_descriptor_status("key", info, False, hits, resp)
+
+            ctx = f"case {i}: before={before} after={after} hits={hits} limit={limit} div={divider} now={now}"
+            assert int(res.code) == int(status.code), ctx
+            assert int(res.limit_remaining) == status.limit_remaining, ctx
+            assert int(res.duration_until_reset) == status.duration_until_reset, ctx
+            assert int(res.throttle_millis) == resp.throttle_millis, ctx
+            assert int(res.near_delta) == rl.stats.near_limit.value(), ctx
+            assert int(res.over_delta) == rl.stats.over_limit.value(), ctx
+
+
+class TestSlabDifferentialVsDict:
+    """Randomized stream of batches vs a plain-Python fixed-window model."""
+
+    def test_random_stream(self):
+        rng = random.Random(7)
+        state = make_slab(1 << 10)
+        model: dict[int, tuple[int, int]] = {}  # fp -> (count, window)
+        keys = [rng.getrandbits(64) for _ in range(40)]
+        now = 10_000
+
+        for step in range(60):
+            now += rng.randrange(0, 3)
+            items = []
+            for _ in range(rng.randrange(1, 12)):
+                fp = rng.choice(keys)
+                # the real fingerprint embeds the divider (ops/hashing.py), so
+                # a given fp always carries one divider — mirror that here
+                divider = 1 if fp % 2 == 0 else 60
+                items.append((fp, rng.randrange(1, 4), 100, divider))
+            state, res = run(state, items, now=now, pad_to=16)
+
+            for i, (fp, hits, limit, divider) in enumerate(items):
+                window = (now // divider) * divider
+                count, stored_window = model.get(fp, (0, -1))
+                if stored_window != window:
+                    count = 0
+                expect_before = count
+                count += hits
+                model[fp] = (count, window)
+                assert int(res.before[i]) == expect_before, (
+                    f"step {step} item {i} fp={fp:x} div={divider} now={now}"
+                )
+                assert int(res.after[i]) == count
