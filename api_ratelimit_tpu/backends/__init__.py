@@ -1,3 +1,17 @@
 from .memory import MemoryRateLimitCache
 
-__all__ = ["MemoryRateLimitCache"]
+__all__ = ["MemoryRateLimitCache", "TpuRateLimitCache", "MicroBatcher"]
+
+
+def __getattr__(name):
+    # TpuRateLimitCache pulls in jax; import lazily so pure-host users
+    # (config linter, client CLI) stay light.
+    if name == "TpuRateLimitCache":
+        from .tpu import TpuRateLimitCache
+
+        return TpuRateLimitCache
+    if name == "MicroBatcher":
+        from .batcher import MicroBatcher
+
+        return MicroBatcher
+    raise AttributeError(name)

@@ -1,0 +1,131 @@
+"""Micro-batcher: coalesce concurrent do_limit calls into one device launch.
+
+The TPU-native descendant of the reference's implicit Redis pipelining
+(src/redis/driver_impl.go:84-90: commands from concurrent goroutines are
+coalesced into one flush when REDIS_PIPELINE_WINDOW / REDIS_PIPELINE_LIMIT
+are set). Here the coalesced unit is a slab kernel launch instead of a Redis
+RTT: requests enqueue their items and block on a future; a single dispatcher
+thread drains the queue, waits up to `window` for stragglers (batch limit
+caps the wait), executes the batch callback once, and distributes results.
+
+window=0 degenerates to direct mode: the caller executes its own items
+immediately under the dispatch lock — lowest latency, no cross-request
+amortization (exactly like an unset pipeline window in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Callable, Sequence
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        execute: Callable[[list], list],
+        window_seconds: float = 0.0,
+        max_batch: int = 8192,
+    ):
+        self._execute = execute
+        self._window = float(window_seconds)
+        self._max_batch = int(max_batch)
+        self._lock = threading.Lock()
+        self._items: list = []
+        self._futures: list[tuple[Future, int, int]] = []  # (future, start, count)
+        self._inflight = 0
+        self._wakeup = threading.Condition(self._lock)
+        self._direct_lock = threading.Lock()
+        self._closed = False
+        self._idle = threading.Condition(self._lock)
+        self._thread: threading.Thread | None = None
+        if self._window > 0:
+            self._thread = threading.Thread(
+                target=self._loop, name="tpu-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- client side --
+
+    def submit(self, items: Sequence) -> list:
+        """Run `items` through the batch executor; returns their results in
+        order. Blocks until results are available."""
+        if not items:
+            return []
+        if self._window <= 0:
+            # direct mode: caller thread executes (single-flight via lock)
+            with self._direct_lock:
+                return self._execute(list(items))
+
+        future: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            start = len(self._items)
+            self._items.extend(items)
+            self._futures.append((future, start, len(items)))
+            self._wakeup.notify()
+        return future.result()
+
+    def flush(self) -> None:
+        """Block until everything enqueued so far has executed (including a
+        batch already taken by the dispatcher and mid-execution)."""
+        if self._window <= 0:
+            with self._direct_lock:
+                return
+        with self._lock:
+            while self._items or self._futures or self._inflight:
+                self._idle.wait(timeout=0.05)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._wakeup.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    # -- dispatcher --
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._items and not self._closed:
+                    self._wakeup.wait()
+                if self._closed and not self._items:
+                    self._idle.notify_all()
+                    return
+                # linger up to `window` for stragglers unless already full
+                if len(self._items) < self._max_batch:
+                    self._wakeup.wait(timeout=self._window)
+                # Take whole requests only — a request's items never split
+                # across launches (its future completes from one result set).
+                # A single oversized request is taken alone; the executor
+                # loops over buckets internally.
+                futures = []
+                taken = 0
+                for future, _start, count in self._futures:
+                    if futures and taken + count > self._max_batch:
+                        break
+                    futures.append((future, taken, count))
+                    taken += count
+                items = self._items[:taken]
+                self._items = self._items[taken:]
+                self._futures = [
+                    (f, start - taken, count)
+                    for f, start, count in self._futures[len(futures) :]
+                ]
+                self._inflight += 1
+
+            try:
+                results = self._execute(items)
+                for future, start, count in futures:
+                    future.set_result(results[start : start + count])
+            except BaseException as e:  # noqa: BLE001 - propagate to callers
+                for future, _, _ in futures:
+                    if not future.done():
+                        future.set_exception(e)
+
+            with self._lock:
+                self._inflight -= 1
+                if not self._items and not self._futures and not self._inflight:
+                    self._idle.notify_all()

@@ -1,0 +1,239 @@
+"""BACKEND_TYPE=tpu — the flagship cache backend.
+
+Replaces the reference's Redis hot path (src/redis/fixed_cache_impl.go) with
+an in-process TPU device program: descriptors are fingerprinted on the host
+(ops/hashing.py, xxhash), concurrent requests coalesce in the micro-batcher
+(backends/batcher.py — the TPU analog of implicit Redis pipelining), and one
+jitted launch executes probe + window-reset + increment + decide against the
+HBM slab (ops/slab.py). Near/over-limit stats deltas come back from the
+device and are added to the same per-rule counters the reference maintains.
+
+The local over-limit cache stays host-side in front of the device exactly
+like the reference's freecache sits in front of Redis
+(src/limiter/base_limiter.go:57-66): items already known to be over limit
+never reach the batcher.
+
+Single-chip by default; parallel/sharded_slab.py provides the multi-chip
+variant (hash-sharded slab, decisions combined over ICI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from ..assertx import assert_
+from ..limiter.base_limiter import BaseRateLimiter
+from ..limiter.cache import CacheError
+from ..limiter.cache_key import generate_cache_key
+from ..models.config import RateLimit
+from ..models.descriptors import RateLimitRequest
+from ..models.response import Code, DescriptorStatus, DoLimitResponse
+from ..models.units import unit_to_divider
+from ..ops.hashing import fingerprint64, split_fingerprints
+from ..ops.slab import make_slab, slab_step_packed
+from .batcher import MicroBatcher
+
+
+@dataclasses.dataclass(slots=True)
+class _Item:
+    fp: int
+    hits: int
+    limit: int
+    divider: int
+    jitter: int
+
+
+@dataclasses.dataclass(slots=True)
+class _ItemResult:
+    code: int
+    limit_remaining: int
+    duration_until_reset: int
+    throttle_millis: int
+    near_delta: int
+    over_delta: int
+
+
+class TpuRateLimitCache:
+    """limiter.RateLimitCache implementation backed by the TPU slab."""
+
+    def __init__(
+        self,
+        base_limiter: BaseRateLimiter,
+        n_slots: int = 1 << 22,
+        batch_window_seconds: float = 0.0,
+        max_batch: int = 65536,
+        buckets: Sequence[int] = (1024, 8192, 65536),
+        device=None,
+        use_pallas: bool | None = None,
+    ):
+        self._base = base_limiter
+        if device is None:
+            device = jax.devices()[0]
+        self._device = device
+        if use_pallas is None:
+            use_pallas = device.platform == "tpu"
+        self._use_pallas = bool(use_pallas)
+        self._state = jax.device_put(make_slab(n_slots), device)
+        self._buckets = tuple(sorted(buckets))
+        self._max_bucket = self._buckets[-1]
+        self._batcher = MicroBatcher(
+            self._execute_batch,
+            window_seconds=batch_window_seconds,
+            max_batch=max_batch,
+        )
+
+    # -- device execution (dispatcher thread / direct-mode caller only) --
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._max_bucket
+
+    def _execute_batch(self, items: list[_Item]) -> list[_ItemResult]:
+        try:
+            out: list[_ItemResult] = []
+            for off in range(0, len(items), self._max_bucket):
+                out.extend(self._launch(items[off : off + self._max_bucket]))
+            return out
+        except Exception as e:  # surfaced as redis_error-equivalent
+            raise CacheError(f"tpu backend failure: {e}") from e
+
+    def _launch(self, items: list[_Item]) -> list[_ItemResult]:
+        out = self._launch_packed(self._pack(items))
+        n = len(items)
+        code, remaining, duration, throttle, near_d, over_d = (
+            out[ROW] for ROW in range(6)
+        )
+        return [
+            _ItemResult(
+                code=int(code[i]),
+                limit_remaining=int(remaining[i]),
+                duration_until_reset=int(duration[i]),
+                throttle_millis=int(throttle[i]),
+                near_delta=int(near_d[i]),
+                over_delta=int(over_d[i]),
+            )
+            for i in range(n)
+        ]
+
+    def _pack(self, items: list[_Item]) -> np.ndarray:
+        """uint32[7, bucket] input block (one H2D transfer per launch)."""
+        n = len(items)
+        size = self._bucket_for(n)
+        packed = np.zeros((7, size), dtype=np.uint32)
+        fp = np.fromiter((it.fp for it in items), dtype=np.uint64, count=n)
+        packed[0, :n], packed[1, :n] = split_fingerprints(fp)
+        packed[2, :n] = np.fromiter((it.hits for it in items), np.uint32, n)
+        packed[3, :n] = np.fromiter((it.limit for it in items), np.uint32, n)
+        packed[4, :n] = np.fromiter((it.divider for it in items), np.uint32, n)
+        packed[5, :n] = np.fromiter((it.jitter for it in items), np.uint32, n)
+        packed[6, 0] = np.uint32(self._base.time_source.unix_now())
+        packed[6, 1] = np.float32(self._base.near_limit_ratio).view(np.uint32)
+        return packed
+
+    def _launch_packed(self, packed: np.ndarray) -> np.ndarray:
+        """One device launch; returns the uint32[8, size] result block in
+        arrival order (device returns sort order + permutation; the host
+        unsorts with one fancy-index, cheaper than a device-side unsort)."""
+        self._state, out_dev = slab_step_packed(
+            self._state,
+            jax.device_put(packed, self._device),
+            use_pallas=self._use_pallas,
+        )
+        out = np.asarray(out_dev)  # one D2H transfer
+        order = out[8]
+        unsorted = np.empty_like(out[:8])
+        unsorted[:, order] = out[:8]
+        return unsorted
+
+    # -- RateLimitCache interface --
+
+    def do_limit(
+        self,
+        request: RateLimitRequest,
+        limits: Sequence[RateLimit | None],
+    ) -> DoLimitResponse:
+        assert_(len(request.descriptors) == len(limits))
+        hits_addend = max(1, request.hits_addend)
+        now = self._base.time_source.unix_now()
+        local_cache = self._base.local_cache
+
+        n = len(request.descriptors)
+        statuses: list[DescriptorStatus | None] = [None] * n
+        response = DoLimitResponse()
+
+        items: list[_Item] = []
+        item_slots: list[int] = []  # descriptor index per item
+        keys: list[str] = [""] * n  # string keys only when local cache is on
+
+        for i, (descriptor, limit) in enumerate(zip(request.descriptors, limits)):
+            if limit is None:
+                statuses[i] = DescriptorStatus(code=Code.OK)
+                continue
+            limit.stats.total_hits.add(hits_addend)
+            divider = unit_to_divider(limit.unit)
+
+            if local_cache is not None:
+                keys[i] = generate_cache_key(
+                    request.domain, descriptor, limit, now
+                ).key
+                if local_cache.contains(keys[i]):
+                    limit.stats.over_limit.add(hits_addend)
+                    limit.stats.over_limit_with_local_cache.add(hits_addend)
+                    statuses[i] = DescriptorStatus(
+                        code=Code.OVER_LIMIT,
+                        current_limit=limit.limit,
+                        limit_remaining=0,
+                        duration_until_reset=divider - now % divider,
+                    )
+                    continue
+
+            jitter = 0
+            if self._base.expiration_jitter_max_seconds > 0:
+                jitter = self._base.jitter_rand.randrange(
+                    self._base.expiration_jitter_max_seconds
+                )
+            items.append(
+                _Item(
+                    fp=fingerprint64(request.domain, descriptor.entries, divider),
+                    hits=hits_addend,
+                    limit=limit.requests_per_unit,
+                    divider=divider,
+                    jitter=jitter,
+                )
+            )
+            item_slots.append(i)
+
+        results = self._batcher.submit(items)
+
+        for res, i in zip(results, item_slots):
+            limit = limits[i]
+            statuses[i] = DescriptorStatus(
+                code=Code(res.code),
+                current_limit=limit.limit,
+                limit_remaining=res.limit_remaining,
+                duration_until_reset=res.duration_until_reset,
+            )
+            if res.near_delta:
+                limit.stats.near_limit.add(res.near_delta)
+            if res.over_delta:
+                limit.stats.over_limit.add(res.over_delta)
+            if res.code == Code.OVER_LIMIT and local_cache is not None:
+                local_cache.set(keys[i], unit_to_divider(limit.unit))
+            if res.throttle_millis > response.throttle_millis:
+                response.throttle_millis = res.throttle_millis
+
+        response.descriptor_statuses = statuses  # type: ignore[assignment]
+        assert_(all(s is not None for s in statuses))
+        return response
+
+    def flush(self) -> None:
+        self._batcher.flush()
+
+    def close(self) -> None:
+        self._batcher.close()

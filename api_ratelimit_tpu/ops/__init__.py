@@ -1,9 +1,9 @@
-from .hashing import fingerprint64, rule_fingerprint
+from .hashing import fingerprint64, split_fingerprints
 from .slab import SlabState, make_slab, slab_update_and_decide
 
 __all__ = [
     "fingerprint64",
-    "rule_fingerprint",
+    "split_fingerprints",
     "SlabState",
     "make_slab",
     "slab_update_and_decide",

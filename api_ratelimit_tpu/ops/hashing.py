@@ -15,28 +15,32 @@ lanes; 64-bit integer arrays are avoided on device.
 
 from __future__ import annotations
 
+import struct
+
 import numpy as np
 import xxhash
 
-_SEP = b"\x1f"  # unit separator: cannot appear in descriptor text keys
+_LEN = struct.Struct("<I").pack
 
 
 def fingerprint64(domain: str, entries, divider: int) -> int:
-    """64-bit fingerprint of a resolved (domain, descriptor, window-unit)."""
+    """64-bit fingerprint of a resolved (domain, descriptor, window-unit).
+
+    Every field is length-prefixed before hashing so request-controlled
+    strings cannot alias across field boundaries (e.g. a value embedding a
+    separator can never hash like two separate entries)."""
     h = xxhash.xxh64(seed=divider)
-    h.update(domain.encode())
+    d = domain.encode()
+    h.update(_LEN(len(d)))
+    h.update(d)
     for entry in entries:
-        h.update(_SEP)
-        h.update(entry.key.encode())
-        h.update(_SEP)
-        h.update(entry.value.encode())
+        k = entry.key.encode()
+        v = entry.value.encode()
+        h.update(_LEN(len(k)))
+        h.update(k)
+        h.update(_LEN(len(v)))
+        h.update(v)
     return h.intdigest()
-
-
-def rule_fingerprint(domain: str, descriptor, divider: int) -> tuple[int, int]:
-    """(lo, hi) uint32 halves for device transfer."""
-    fp = fingerprint64(domain, descriptor.entries, divider)
-    return fp & 0xFFFFFFFF, fp >> 32
 
 
 def split_fingerprints(fps: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -7,31 +7,39 @@ of decisions executes as ONE jitted device program:
 
     probe -> window-reset -> duplicate-serialized increment -> decide
 
-Slab layout (structure-of-arrays, n_slots a power of two):
-    fp_lo, fp_hi : uint32  64-bit key fingerprint halves
-    count        : uint32  fixed-window counter
-    window       : int32   window start (unix s) the counter belongs to
-    expire_at    : int32   slot reclaim time (window TTL + jitter)
+Slab layout — a single fused row table, `uint32[n_slots, ROW_WIDTH]`:
 
-A slot is LIVE while expire_at > now; expired slots are reusable in place —
-the TPU equivalent of Redis TTL eviction (SURVEY.md section 5.4: restart ==
-flushed slab == refilled windows; no checkpoint needed by design).
+    col 0: fp_lo      64-bit key fingerprint, low half
+    col 1: fp_hi      high half
+    col 2: count      fixed-window counter
+    col 3: window     window start (unix s) the counter belongs to
+    col 4: expire_at  slot reclaim time (window TTL + jitter)
+    col 5-7: reserved
 
-Algorithm per batch (all vectorized, no data-dependent Python control flow):
+One row per key keeps the hot path at ONE gather and ONE scatter per batch
+(structure-of-arrays costs 5 of each: TPU gather/scatter cost is dominated by
+per-element overhead, not bytes). ROW_WIDTH=8 keeps rows 32-byte aligned.
+
+A slot is LIVE while expire_at > now; expired slots are reused in place — the
+TPU equivalent of Redis TTL eviction (SURVEY.md section 5.4: restart ==
+flushed slab == windows refill; no checkpoint needed by design).
+
+Algorithm per batch (vectorized; no data-dependent Python control flow):
   1. K-way double-hash probe: candidate j = (fp_lo + j * (fp_hi | 1)) mod n.
-     First candidate whose live fingerprint matches wins; otherwise the first
-     dead candidate; otherwise candidate 0 is stolen (bounded displacement —
-     with load < ~50% and K=8 the steal probability is negligible; a steal
-     fails open for the victim key, matching the reference's
-     fail-open-on-backend-loss posture, README.md:567-568).
+     First live fingerprint match wins, else first dead candidate, else
+     candidate 0 is stolen (bounded displacement; a steal fails open for the
+     victim, matching the reference's fail-open posture, README.md:567-568).
   2. Duplicate keys within a batch must serialize (the reference serializes
-     via per-command Redis execution): sort items by chosen slot, take
-     segment-exclusive cumulative sums of hits so item i sees
-     before_i = stored_base + hits of earlier same-key items in the batch.
+     via per-command Redis execution): lexicographic stable sort by
+     (slot, fp) groups each key; segment-exclusive prefix sums of hits give
+     item i's in-batch predecessor total.
   3. Window rollover: stored window != item's current window => base 0.
-  4. One scatter per segment (last item writes count/window/fp/expire).
-  5. Fused decision math (ops/decide.py) gives code/remaining/throttle and
-     the near/over stats deltas the host adds to per-rule counters.
+  4. One row-scatter per slot (the slot's final segment writes; when two
+     distinct keys contend for one slot in a batch the loser's count is not
+     persisted — it re-probes next batch; one-batch undercount, fails open).
+  5. Fused decision math (ops/decide.py or the Pallas kernel) yields
+     code/remaining/throttle and the near/over stats deltas the host adds to
+     per-rule counters.
 
 The batch dimension is padded to fixed bucket sizes by the backend so XLA
 compiles a handful of shapes once.
@@ -47,17 +55,25 @@ import jax.numpy as jnp
 
 from .decide import DecideResult, decide
 
+ROW_WIDTH = 8
+COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE = range(5)
+
 
 class SlabState(NamedTuple):
-    fp_lo: jnp.ndarray  # uint32[n]
-    fp_hi: jnp.ndarray  # uint32[n]
-    count: jnp.ndarray  # uint32[n]
-    window: jnp.ndarray  # int32[n]
-    expire_at: jnp.ndarray  # int32[n]
+    table: jnp.ndarray  # uint32[n_slots, ROW_WIDTH]
 
     @property
     def n_slots(self) -> int:
-        return self.fp_lo.shape[0]
+        return self.table.shape[0]
+
+    # debug/test views
+    @property
+    def count(self) -> jnp.ndarray:
+        return self.table[:, COL_COUNT]
+
+    @property
+    def expire_at(self) -> jnp.ndarray:
+        return self.table[:, COL_EXPIRE].astype(jnp.int32)
 
 
 class SlabBatch(NamedTuple):
@@ -80,32 +96,27 @@ class SlabResult(NamedTuple):
 def make_slab(n_slots: int, device=None) -> SlabState:
     if n_slots & (n_slots - 1):
         raise ValueError(f"n_slots must be a power of two, got {n_slots}")
-    def mk(dtype):
-        arr = jnp.zeros((n_slots,), dtype=dtype)
-        return jax.device_put(arr, device) if device is not None else arr
-
-    return SlabState(
-        fp_lo=mk(jnp.uint32),
-        fp_hi=mk(jnp.uint32),
-        count=mk(jnp.uint32),
-        window=mk(jnp.int32),
-        expire_at=mk(jnp.int32),
-    )
+    table = jnp.zeros((n_slots, ROW_WIDTH), dtype=jnp.uint32)
+    if device is not None:
+        table = jax.device_put(table, device)
+    return SlabState(table=table)
 
 
 def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     """K-way probe; returns int32[b] chosen slot (n_slots for padding)."""
     n = state.n_slots
     mask = jnp.uint32(n - 1)
-    b = batch.fp_lo.shape[0]
 
     step = batch.fp_hi | jnp.uint32(1)  # odd => full cycle on power-of-two table
     j = jnp.arange(n_probes, dtype=jnp.uint32)
     cand = ((batch.fp_lo[:, None] + j[None, :] * step[:, None]) & mask).astype(jnp.int32)
 
-    live = state.expire_at[cand] > now
-    match = live & (state.fp_lo[cand] == batch.fp_lo[:, None]) & (
-        state.fp_hi[cand] == batch.fp_hi[:, None]
+    rows = state.table[cand]  # (b, K, ROW_WIDTH) — one gather
+    live = rows[:, :, COL_EXPIRE].astype(jnp.int32) > now
+    match = (
+        live
+        & (rows[:, :, COL_FP_LO] == batch.fp_lo[:, None])
+        & (rows[:, :, COL_FP_HI] == batch.fp_hi[:, None])
     )
     avail = ~live
 
@@ -120,23 +131,21 @@ def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     return jnp.where(valid, chosen, jnp.int32(n))
 
 
-@functools.partial(jax.jit, static_argnames=("n_probes",), donate_argnames=("state",))
-def slab_update_and_decide(
+def _slab_step_sorted(
     state: SlabState,
     batch: SlabBatch,
     now: jnp.ndarray,  # int32 scalar
     near_ratio: jnp.ndarray,  # float32 scalar
-    n_probes: int = 8,
-) -> tuple[SlabState, SlabResult]:
+    n_probes: int,
+    use_pallas: bool,
+):
+    """Core step; returns results in slot-sorted order plus the permutation
+    (callers unsort on device or on the host)."""
     n = state.n_slots
     now = now.astype(jnp.int32)
 
     chosen = _choose_slots(state, batch, now, n_probes)
 
-    # --- serialize duplicates: lexicographic stable sort by (slot, fp) so
-    # each key's items are contiguous. Distinct keys can land on the same
-    # slot in one batch (both probed pre-batch state); they become separate
-    # segments and only one of them persists (see write rule below).
     b = chosen.shape[0]
     (s_slot, s_fp_hi, s_fp_lo, order) = jax.lax.sort(
         (chosen, batch.fp_hi, batch.fp_lo, jnp.arange(b, dtype=jnp.int32)),
@@ -146,6 +155,7 @@ def slab_update_and_decide(
     s_hits = batch.hits[order]
     s_div = batch.divider[order]
     s_jit = batch.jitter[order]
+    s_limit = batch.limit[order]
 
     same_prev = (
         (s_slot[1:] == s_slot[:-1])
@@ -160,13 +170,14 @@ def slab_update_and_decide(
     seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
     prior_in_batch = excl - seg_base_excl
 
-    # --- stored slot state (clamped gather; padding reads are discarded) ---
+    # --- stored slot rows (clamped gather; padding reads are discarded) ---
     g_slot = jnp.minimum(s_slot, n - 1)
-    st_count = state.count[g_slot]
-    st_window = state.window[g_slot]
-    st_expire = state.expire_at[g_slot]
-    st_fp_lo = state.fp_lo[g_slot]
-    st_fp_hi = state.fp_hi[g_slot]
+    st_rows = state.table[g_slot]  # (b, ROW_WIDTH) — one gather
+    st_count = st_rows[:, COL_COUNT]
+    st_window = st_rows[:, COL_WINDOW].astype(jnp.int32)
+    st_expire = st_rows[:, COL_EXPIRE].astype(jnp.int32)
+    st_fp_lo = st_rows[:, COL_FP_LO]
+    st_fp_hi = st_rows[:, COL_FP_HI]
 
     safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
     cur_window = (now // safe_div) * safe_div
@@ -178,37 +189,128 @@ def slab_update_and_decide(
     s_before = base + prior_in_batch
     s_after = s_before + s_hits
 
-    # --- one writer per SLOT: the final item in the slot's run. When two
-    # distinct keys contend for one slot in the same batch, the last segment
-    # wins the slot and the loser's count simply is not persisted (it decides
-    # on its own in-batch hits and re-probes next batch) — a one-batch
-    # undercount that fails open, like the reference under backend loss.
+    # --- one row write per SLOT: the final item in the slot's run ---
     is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
     s_valid = s_hits > 0
     write_idx = jnp.where(is_last & s_valid, s_slot, jnp.int32(n))
 
-    new_state = SlabState(
-        fp_lo=state.fp_lo.at[write_idx].set(s_fp_lo, mode="drop"),
-        fp_hi=state.fp_hi.at[write_idx].set(s_fp_hi, mode="drop"),
-        count=state.count.at[write_idx].set(s_after, mode="drop"),
-        window=state.window.at[write_idx].set(cur_window, mode="drop"),
-        expire_at=state.expire_at.at[write_idx].set(
-            now + s_div + s_jit, mode="drop"
-        ),
+    new_rows = jnp.stack(
+        [
+            s_fp_lo,
+            s_fp_hi,
+            s_after,
+            cur_window.astype(jnp.uint32),
+            (now + s_div + s_jit).astype(jnp.uint32),
+            jnp.zeros_like(s_fp_lo),
+            jnp.zeros_like(s_fp_lo),
+            jnp.zeros_like(s_fp_lo),
+        ],
+        axis=1,
+    )
+    # unique_indices: one writer per slot by construction; dropped rows use
+    # the out-of-bounds index n. Without the flag XLA serializes the scatter.
+    table = state.table.at[write_idx].set(
+        new_rows, mode="drop", unique_indices=True
     )
 
-    # --- unsort + decide ---
-    inv = jnp.argsort(order, stable=True)
-    before = s_before[inv]
-    after = s_after[inv]
+    if use_pallas:
+        from .pallas_decide import pallas_decide
 
-    decision = decide(
-        before=before,
-        after=after,
-        hits=batch.hits,
-        limit=batch.limit,
-        divider=batch.divider,
-        now=now,
-        near_ratio=near_ratio,
+        decision = pallas_decide(
+            s_before, s_after, s_hits, s_limit, s_div, now, near_ratio
+        )
+    else:
+        decision = decide(
+            before=s_before,
+            after=s_after,
+            hits=s_hits,
+            limit=s_limit,
+            divider=s_div,
+            now=now,
+            near_ratio=near_ratio,
+        )
+    return SlabState(table=table), s_before, s_after, decision, order
+
+
+def _slab_step(
+    state: SlabState,
+    batch: SlabBatch,
+    now: jnp.ndarray,
+    near_ratio: jnp.ndarray,
+    n_probes: int = 4,
+    use_pallas: bool = False,
+) -> tuple[SlabState, SlabResult]:
+    state, s_before, s_after, s_dec, order = _slab_step_sorted(
+        state, batch, now, near_ratio, n_probes, use_pallas
     )
-    return new_state, SlabResult(before=before, after=after, decision=decision)
+    # inverse permutation via scatter (cheaper than a second sort on TPU)
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
+    )
+    decision = DecideResult(*(field[inv] for field in s_dec))
+    return state, SlabResult(
+        before=s_before[inv], after=s_after[inv], decision=decision
+    )
+
+
+slab_update_and_decide = functools.partial(
+    jax.jit, static_argnames=("n_probes", "use_pallas"), donate_argnames=("state",)
+)(_slab_step)
+
+
+# --- packed single-transfer step -------------------------------------------
+#
+# The host <-> device boundary matters as much as the kernel: a naive step
+# ships 6 input arrays and reads back 8 outputs, i.e. ~14 transfer round
+# trips per launch. The packed step moves exactly ONE uint32[7, b] array in
+# and ONE uint32[9, b] array out per launch (scalars ride in input row 6).
+# Results come back in device sort order with the permutation as the last
+# output row — the host unsorts with one numpy fancy-index, which is cheaper
+# than an extra device-side scatter + gathers. This is the TPU-native
+# equivalent of the reference writing all pipeline commands in one Redis
+# flush (src/redis/driver_impl.go:153-164: one write + one read RTT per
+# batch).
+
+ROW_FP_LO, ROW_FP_HI, ROW_HITS, ROW_LIMIT, ROW_DIVIDER, ROW_JITTER, ROW_SCALARS = range(7)
+PACKED_IN_ROWS = 7
+# out rows: code, remaining, duration, throttle, near, over, before, after, order
+OUT_CODE, OUT_REMAINING, OUT_DURATION, OUT_THROTTLE, OUT_NEAR, OUT_OVER, OUT_BEFORE, OUT_AFTER, OUT_ORDER = range(9)
+PACKED_OUT_ROWS = 9
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "use_pallas"), donate_argnames=("state",)
+)
+def slab_step_packed(
+    state: SlabState,
+    packed: jnp.ndarray,  # uint32[7, b]; row 6: [now, bitcast(near_ratio), ...]
+    n_probes: int = 4,
+    use_pallas: bool = False,
+) -> tuple[SlabState, jnp.ndarray]:
+    batch = SlabBatch(
+        fp_lo=packed[ROW_FP_LO],
+        fp_hi=packed[ROW_FP_HI],
+        hits=packed[ROW_HITS],
+        limit=packed[ROW_LIMIT],
+        divider=packed[ROW_DIVIDER].astype(jnp.int32),
+        jitter=packed[ROW_JITTER].astype(jnp.int32),
+    )
+    now = packed[ROW_SCALARS, 0].astype(jnp.int32)
+    near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
+    state, s_before, s_after, d, order = _slab_step_sorted(
+        state, batch, now, near_ratio, n_probes, use_pallas
+    )
+    out = jnp.stack(
+        [
+            d.code.astype(jnp.uint32),
+            d.limit_remaining,
+            d.duration_until_reset.astype(jnp.uint32),
+            d.throttle_millis,
+            d.near_delta,
+            d.over_delta,
+            s_before,
+            s_after,
+            order.astype(jnp.uint32),
+        ]
+    )
+    return state, out

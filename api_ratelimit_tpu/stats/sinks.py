@@ -37,6 +37,8 @@ class TestSink:
     """Records the latest flushed values by stat name
     (test/common/common.go:22-42 equivalent)."""
 
+    __test__ = False  # not a pytest class
+
     def __init__(self):
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, int] = {}

@@ -1,0 +1,36 @@
+"""Fingerprint framing tests."""
+
+from api_ratelimit_tpu.models import Entry
+from api_ratelimit_tpu.ops.hashing import fingerprint64, split_fingerprints
+
+
+def E(*pairs):
+    return tuple(Entry(k, v) for k, v in pairs)
+
+
+def test_no_field_boundary_aliasing():
+    # request-controlled strings must not alias across field boundaries
+    assert fingerprint64("d", E(("a", "b\x1fc\x1fd")), 1) != fingerprint64(
+        "d", E(("a", "b"), ("c", "d")), 1
+    )
+    assert fingerprint64("d\x1fa", E(), 1) != fingerprint64("d", E(("a", "")), 1)
+    assert fingerprint64("d", E(("ab", "")), 1) != fingerprint64("d", E(("a", "b")), 1)
+    assert fingerprint64("da", E(), 1) != fingerprint64("d", E(("a", "")), 1)
+
+
+def test_divider_in_identity():
+    assert fingerprint64("d", E(("a", "b")), 1) != fingerprint64("d", E(("a", "b")), 60)
+
+
+def test_deterministic():
+    assert fingerprint64("d", E(("a", "b")), 60) == fingerprint64("d", E(("a", "b")), 60)
+
+
+def test_split_roundtrip():
+    import numpy as np
+
+    fps = np.array([0, 1, 0xFFFFFFFF, 0x123456789ABCDEF0], dtype=np.uint64)
+    lo, hi = split_fingerprints(fps)
+    assert lo.dtype == np.uint32 and hi.dtype == np.uint32
+    back = lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
+    assert (back == fps).all()

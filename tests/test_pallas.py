@@ -1,0 +1,41 @@
+"""Pallas decide kernel parity vs the jnp reference implementation
+(interpret mode on CPU; the same comparison runs on real TPU hardware via
+scripts in bench/verify flows)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from api_ratelimit_tpu.ops.decide import decide
+from api_ratelimit_tpu.ops.pallas_decide import pallas_decide
+
+
+def test_pallas_decide_matches_jnp():
+    rng = np.random.default_rng(3)
+    b = 2048
+    limit = rng.integers(1, 100, size=b).astype(np.uint32)
+    hits = rng.integers(0, 5, size=b).astype(np.uint32)  # zeros = padding
+    before = rng.integers(0, 120, size=b).astype(np.uint32)
+    after = before + hits
+    divider = rng.choice([1, 60, 3600, 86400], size=b).astype(np.int32)
+    divider[hits == 0] = 0  # padding rows carry zeroed metadata
+    now = 1_722_300_000
+
+    args = (
+        jnp.asarray(before),
+        jnp.asarray(after),
+        jnp.asarray(hits),
+        jnp.asarray(limit),
+        jnp.asarray(divider),
+        jnp.int32(now),
+        jnp.float32(0.8),
+    )
+    ref = decide(*args)
+    got = pallas_decide(*args, interpret=True)
+
+    for name in ref._fields:
+        r = np.asarray(getattr(ref, name))
+        g = np.asarray(getattr(got, name))
+        mismatch = np.nonzero(r != g)[0]
+        assert mismatch.size == 0, (
+            f"{name} mismatch at {mismatch[:5]}: ref={r[mismatch[:5]]} got={g[mismatch[:5]]}"
+        )

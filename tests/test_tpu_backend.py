@@ -1,0 +1,212 @@
+"""TPU backend differential tests vs the memory oracle, plus micro-batcher
+behavior. Runs on the virtual CPU mesh; the same flows execute on real TPU
+via bench.py / verify scripts."""
+
+import random
+import threading
+
+import pytest
+
+from api_ratelimit_tpu.backends import MemoryRateLimitCache
+from api_ratelimit_tpu.backends.batcher import MicroBatcher
+from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+from api_ratelimit_tpu.limiter import BaseRateLimiter, LocalCache
+from api_ratelimit_tpu.models import Code, Descriptor, RateLimitRequest, Unit
+from api_ratelimit_tpu.models.config import RateLimit, new_rate_limit_stats
+from api_ratelimit_tpu.models.response import RateLimitValue
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+def make_limit(store, rpu, unit, key):
+    return RateLimit(
+        full_key=key,
+        stats=new_rate_limit_stats(store, key),
+        limit=RateLimitValue(requests_per_unit=rpu, unit=unit),
+    )
+
+
+def req(*pairs, hits=1, domain="domain"):
+    return RateLimitRequest(
+        domain=domain,
+        descriptors=tuple(Descriptor.of(p) for p in pairs),
+        hits_addend=hits,
+    )
+
+
+def make_tpu_cache(ts, local_cache_size=0, window=0.0, n_slots=1 << 12):
+    local = LocalCache(local_cache_size, ts) if local_cache_size else None
+    base = BaseRateLimiter(ts, local_cache=local, near_limit_ratio=0.8)
+    return TpuRateLimitCache(
+        base,
+        n_slots=n_slots,
+        batch_window_seconds=window,
+        buckets=(128, 1024),
+        max_batch=1024,
+        use_pallas=False,
+    )
+
+
+class TestTpuBackend:
+    def test_basic_over_limit_sequence(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        cache = make_tpu_cache(ts)
+        limit = make_limit(store, 3, Unit.MINUTE, "k_v")
+        for want in [Code.OK, Code.OK, Code.OK, Code.OVER_LIMIT]:
+            resp = cache.do_limit(req(("k", "v")), [limit])
+            assert resp.descriptor_statuses[0].code == want
+        status = resp.descriptor_statuses[0]
+        assert status.limit_remaining == 0
+        assert status.duration_until_reset == 60 - 1_000_000 % 60
+        assert limit.stats.total_hits.value() == 4
+        assert limit.stats.over_limit.value() == 1
+
+    def test_differential_vs_memory_oracle(self):
+        """Randomized request stream: codes, remaining, throttle, and stats
+        must match the Redis-semantics oracle exactly (no collisions at this
+        scale)."""
+        rng = random.Random(11)
+        ts_a, ts_b = FakeTimeSource(500_000), FakeTimeSource(500_000)
+        store_a, store_b = Store(TestSink()), Store(TestSink())
+        tpu = make_tpu_cache(ts_a)
+        mem = MemoryRateLimitCache(BaseRateLimiter(ts_b, near_limit_ratio=0.8))
+
+        descriptors = [("api", str(i)) for i in range(12)]
+        units = [Unit.SECOND, Unit.MINUTE, Unit.HOUR]
+        limits_a = {}
+        limits_b = {}
+        for i, d in enumerate(descriptors):
+            unit = units[i % 3]
+            rpu = rng.randrange(2, 12)
+            limits_a[d] = make_limit(store_a, rpu, unit, f"api_{i}")
+            limits_b[d] = make_limit(store_b, rpu, unit, f"api_{i}")
+
+        for step in range(300):
+            if rng.random() < 0.2:
+                ts_a.advance(1)
+                ts_b.advance(1)
+            chosen = rng.sample(descriptors, k=rng.randrange(1, 4))
+            hits = rng.randrange(1, 3)
+            request = req(*chosen, hits=hits)
+            ra = tpu.do_limit(request, [limits_a[d] for d in chosen])
+            rb = mem.do_limit(request, [limits_b[d] for d in chosen])
+            assert ra.throttle_millis == rb.throttle_millis, f"step {step}"
+            for i, (sa, sb) in enumerate(
+                zip(ra.descriptor_statuses, rb.descriptor_statuses)
+            ):
+                assert sa.code == sb.code, f"step {step} desc {i}"
+                assert sa.limit_remaining == sb.limit_remaining, f"step {step} desc {i}"
+                assert sa.duration_until_reset == sb.duration_until_reset
+
+        for i, d in enumerate(descriptors):
+            la, lb = limits_a[d], limits_b[d]
+            assert la.stats.total_hits.value() == lb.stats.total_hits.value()
+            assert la.stats.over_limit.value() == lb.stats.over_limit.value(), i
+            assert la.stats.near_limit.value() == lb.stats.near_limit.value(), i
+
+    def test_local_cache_short_circuits_device(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        cache = make_tpu_cache(ts, local_cache_size=64)
+        limit = make_limit(store, 2, Unit.HOUR, "k_v")
+        request = req(("k", "v"))
+        for _ in range(3):
+            resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        launches_before = cache._state.count is not None  # state handle
+
+        # next over-limit request must come from the local cache: the slab
+        # count stays at 3
+        import numpy as np
+
+        count_sum_before = int(np.asarray(cache._state.count).sum())
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        assert int(np.asarray(cache._state.count).sum()) == count_sum_before
+        assert limit.stats.over_limit_with_local_cache.value() == 1
+
+    def test_unchecked_descriptor(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        cache = make_tpu_cache(ts)
+        limit = make_limit(store, 5, Unit.SECOND, "k_v")
+        resp = cache.do_limit(req(("nolimit", "x"), ("k", "v")), [None, limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].current_limit is None
+        assert resp.descriptor_statuses[1].current_limit is not None
+
+    def test_windowed_batching_coalesces_concurrent_requests(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        cache = make_tpu_cache(ts, window=0.02)
+        limit = make_limit(store, 100, Unit.MINUTE, "k_v")
+
+        results = []
+        def worker():
+            resp = cache.do_limit(req(("k", "v")), [limit])
+            results.append(resp.descriptor_statuses[0])
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cache.flush()
+        assert len(results) == 8
+        # all 8 hits serialized against one counter
+        remainings = sorted(s.limit_remaining for s in results)
+        assert remainings == [92, 93, 94, 95, 96, 97, 98, 99]
+        cache.close()
+
+
+class TestMicroBatcher:
+    def test_direct_mode(self):
+        calls = []
+        b = MicroBatcher(lambda items: (calls.append(len(items)), items)[1])
+        assert b.submit([1, 2, 3]) == [1, 2, 3]
+        assert calls == [3]
+
+    def test_windowed_coalescing_and_order(self):
+        batches = []
+
+        def execute(items):
+            batches.append(list(items))
+            return [x * 10 for x in items]
+
+        b = MicroBatcher(execute, window_seconds=0.05, max_batch=100)
+        out = []
+        threads = [
+            threading.Thread(target=lambda i=i: out.append((i, b.submit([i]))))
+            for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b.close()
+        assert sorted(x for _, [x] in out) == [0, 10, 20, 30, 40]
+        # coalesced into fewer launches than submissions
+        assert len(batches) < 5
+
+    def test_oversized_request_taken_alone(self):
+        sizes = []
+
+        def execute(items):
+            sizes.append(len(items))
+            return items
+
+        b = MicroBatcher(execute, window_seconds=0.01, max_batch=4)
+        res = b.submit(list(range(10)))
+        assert res == list(range(10))
+        assert sizes == [10]
+        b.close()
+
+    def test_error_propagates_to_callers(self):
+        def execute(items):
+            raise RuntimeError("device on fire")
+
+        b = MicroBatcher(execute, window_seconds=0.01, max_batch=4)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            b.submit([1])
+        b.close()
