@@ -16,6 +16,7 @@ amortization (exactly like an unset pipeline window in the reference).
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Callable, Sequence
 
@@ -55,6 +56,8 @@ class MicroBatcher:
         if self._window <= 0:
             # direct mode: caller thread executes (single-flight via lock)
             with self._direct_lock:
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
                 return self._execute(list(items))
 
         future: Future = Future()
@@ -78,6 +81,10 @@ class MicroBatcher:
                 self._idle.wait(timeout=0.05)
 
     def close(self) -> None:
+        if self._window <= 0:
+            with self._direct_lock:
+                self._closed = True
+            return
         with self._lock:
             self._closed = True
             self._wakeup.notify_all()
@@ -94,9 +101,16 @@ class MicroBatcher:
                 if self._closed and not self._items:
                     self._idle.notify_all()
                     return
-                # linger up to `window` for stragglers unless already full
+                # linger up to `window` for stragglers unless already full;
+                # submit() notifies on every enqueue, so wait on a deadline
+                # loop or the first straggler would end the window early
                 if len(self._items) < self._max_batch:
-                    self._wakeup.wait(timeout=self._window)
+                    deadline = time.monotonic() + self._window
+                    while len(self._items) < self._max_batch and not self._closed:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._wakeup.wait(timeout=remaining)
                 # Take whole requests only — a request's items never split
                 # across launches (its future completes from one result set).
                 # A single oversized request is taken alone; the executor

@@ -33,6 +33,7 @@ from ..models.config import RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import Code, DescriptorStatus, DoLimitResponse
 from ..models.units import unit_to_divider
+from ..utils.timeutil import calculate_reset
 from ..ops.hashing import fingerprint64, split_fingerprints
 from ..ops.slab import make_slab, slab_step_packed
 from .batcher import MicroBatcher
@@ -69,6 +70,7 @@ class TpuRateLimitCache:
         buckets: Sequence[int] = (1024, 8192, 65536),
         device=None,
         use_pallas: bool | None = None,
+        mesh=None,
     ):
         self._base = base_limiter
         if device is None:
@@ -77,7 +79,18 @@ class TpuRateLimitCache:
         if use_pallas is None:
             use_pallas = device.platform == "tpu"
         self._use_pallas = bool(use_pallas)
-        self._state = jax.device_put(make_slab(n_slots), device)
+        # mesh set => multi-chip: hash-sharded slab combined over ICI
+        # (parallel/sharded_slab.py), same packed-block protocol.
+        self._engine = None
+        if mesh is not None:
+            from ..parallel.sharded_slab import ShardedSlabEngine
+
+            self._engine = ShardedSlabEngine(
+                mesh=mesh, n_slots_global=n_slots, use_pallas=self._use_pallas
+            )
+            self._state = None
+        else:
+            self._state = jax.device_put(make_slab(n_slots), device)
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
         self._batcher = MicroBatcher(
@@ -106,17 +119,18 @@ class TpuRateLimitCache:
     def _launch(self, items: list[_Item]) -> list[_ItemResult]:
         out = self._launch_packed(self._pack(items))
         n = len(items)
+        # one bulk tolist per row, not 6*n numpy scalar reads
         code, remaining, duration, throttle, near_d, over_d = (
-            out[ROW] for ROW in range(6)
+            out[ROW, :n].tolist() for ROW in range(6)
         )
         return [
             _ItemResult(
-                code=int(code[i]),
-                limit_remaining=int(remaining[i]),
-                duration_until_reset=int(duration[i]),
-                throttle_millis=int(throttle[i]),
-                near_delta=int(near_d[i]),
-                over_delta=int(over_d[i]),
+                code=code[i],
+                limit_remaining=remaining[i],
+                duration_until_reset=duration[i],
+                throttle_millis=throttle[i],
+                near_delta=near_d[i],
+                over_delta=over_d[i],
             )
             for i in range(n)
         ]
@@ -140,6 +154,8 @@ class TpuRateLimitCache:
         """One device launch; returns the uint32[8, size] result block in
         arrival order (device returns sort order + permutation; the host
         unsorts with one fancy-index, cheaper than a device-side unsort)."""
+        if self._engine is not None:
+            return self._engine.step_packed(packed)
         self._state, out_dev = slab_step_packed(
             self._state,
             jax.device_put(packed, self._device),
@@ -189,15 +205,11 @@ class TpuRateLimitCache:
                         code=Code.OVER_LIMIT,
                         current_limit=limit.limit,
                         limit_remaining=0,
-                        duration_until_reset=divider - now % divider,
+                        duration_until_reset=calculate_reset(limit.unit, now),
                     )
                     continue
 
-            jitter = 0
-            if self._base.expiration_jitter_max_seconds > 0:
-                jitter = self._base.jitter_rand.randrange(
-                    self._base.expiration_jitter_max_seconds
-                )
+            jitter = self._base.expiration_seconds(divider) - divider
             items.append(
                 _Item(
                     fp=fingerprint64(request.domain, descriptor.entries, divider),
@@ -224,7 +236,17 @@ class TpuRateLimitCache:
             if res.over_delta:
                 limit.stats.over_limit.add(res.over_delta)
             if res.code == Code.OVER_LIMIT and local_cache is not None:
-                local_cache.set(keys[i], unit_to_divider(limit.unit))
+                # Re-stamp the key at set time: with a batch window > 0 the
+                # device may have decided in a LATER fixed window than the
+                # one `keys[i]` was generated in (caller's now), and a stale
+                # window stamp would never be looked up again.
+                set_key = generate_cache_key(
+                    request.domain,
+                    request.descriptors[i],
+                    limit,
+                    self._base.time_source.unix_now(),
+                ).key
+                local_cache.set(set_key, unit_to_divider(limit.unit))
             if res.throttle_millis > response.throttle_millis:
                 response.throttle_millis = res.throttle_millis
 

@@ -1,0 +1,13 @@
+"""Multi-chip scale-out: hash-sharded slab over a jax.sharding.Mesh.
+
+The reference scales horizontal state with Redis Cluster — the client hashes
+each key to a cluster slot and routes commands to the owning node
+(src/redis/driver_impl.go:104-110). The TPU equivalent lives here: the HBM
+key slab is sharded across the devices of a Mesh, each device owns the keys
+that hash to it, and per-lane decision outputs are combined with one ICI
+`psum` so every host sees the full batch's results.
+"""
+
+from .sharded_slab import ShardedSlabEngine, make_mesh, sharded_slab_step
+
+__all__ = ["ShardedSlabEngine", "make_mesh", "sharded_slab_step"]

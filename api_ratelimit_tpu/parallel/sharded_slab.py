@@ -1,0 +1,187 @@
+"""Hash-sharded slab: the multi-chip decision engine.
+
+TPU-native analog of Redis Cluster mode (src/redis/driver_impl.go:104-110).
+There, radix hashes each key to a cluster slot and sends the command to the
+owning Redis node over TCP. Here:
+
+  * The slab table `uint32[n_global, ROW_WIDTH]` is sharded along the slot
+    axis over a 1-D `Mesh` axis ("shard"); each device holds an independent
+    open-addressed sub-table (`n_global / n_devices` rows).
+  * Each micro-batch (the packed uint32[7, b] block of ops/slab.py) is
+    replicated to all devices — batches are a few KB while ICI all-to-all
+    routing would need dynamic per-shard item counts, which XLA can't shape
+    statically. Every device computes `owner = (fp_lo ^ fp_hi) mod n_dev`
+    per lane and masks hits to 0 for lanes it does not own, so the existing
+    padding machinery (hits == 0 => no probe, no write) skips them.
+  * Each device runs the SAME single-device program (ops/slab.py) against
+    its local shard — pure SPMD, one trace, no per-device code.
+  * Lane outputs are zeroed on non-owners and combined with ONE
+    `lax.psum` over the mesh axis; the result block is replicated, so any
+    host/controller reads the full batch's decisions. This is the "per-window
+    counts combined over ICI" north star (SURVEY.md section 2.8).
+
+Service replication (nomad app_count = 2..3 against one shared Redis,
+nomad/apigw-ratelimit/common.hcl:2) maps onto this too: N serving processes
+feed batches into one mesh-wide program, and limits stay globally correct
+because each key has exactly one owning shard — the same single-writer
+property Redis Cluster gives the reference.
+
+Window rollover, duplicate serialization, collision policy and decision math
+are all inherited from ops/slab.py — the shard boundary only selects WHICH
+table a key lives in, never changes the per-key algorithm, so single-chip
+parity tests certify the sharded path as well.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.slab import (
+    PACKED_OUT_ROWS,
+    ROW_DIVIDER,
+    ROW_FP_HI,
+    ROW_FP_LO,
+    ROW_HITS,
+    ROW_JITTER,
+    ROW_LIMIT,
+    ROW_SCALARS,
+    ROW_WIDTH,
+    SlabBatch,
+    SlabState,
+    _slab_step_sorted,
+)
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices=None, axis: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def _owner_mask(fp_lo, fp_hi, axis: str):
+    """Boolean[b]: does this device own each lane's key?
+
+    Ownership bits are (fp_lo ^ fp_hi) mod n_dev — independent of the probe
+    sequence (position fp_lo, stride fp_hi|1) so sharding does not bias the
+    local probe distribution.
+    """
+    n_dev = jax.lax.psum(1, axis)
+    me = jax.lax.axis_index(axis)
+    owner = (fp_lo ^ fp_hi) % jnp.uint32(n_dev)
+    return owner == me.astype(jnp.uint32)
+
+
+def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
+    """Per-device body under shard_map. table: local shard [n_local, ROW_WIDTH];
+    packed: replicated uint32[7, b]. Returns (new local shard, replicated
+    uint32[8, b] results in arrival order)."""
+    batch = SlabBatch(
+        fp_lo=packed[ROW_FP_LO],
+        fp_hi=packed[ROW_FP_HI],
+        hits=packed[ROW_HITS],
+        limit=packed[ROW_LIMIT],
+        divider=packed[ROW_DIVIDER].astype(jnp.int32),
+        jitter=packed[ROW_JITTER].astype(jnp.int32),
+    )
+    now = packed[ROW_SCALARS, 0].astype(jnp.int32)
+    near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
+
+    owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
+    batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
+
+    state, s_before, s_after, d, order = _slab_step_sorted(
+        SlabState(table=table), batch, now, near_ratio, n_probes, use_pallas
+    )
+
+    # Unsort ON DEVICE (the host-side unsort trick of slab_step_packed does
+    # not compose with psum: each device has its own permutation).
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
+    )
+    out = jnp.stack(
+        [
+            d.code.astype(jnp.uint32),
+            d.limit_remaining,
+            d.duration_until_reset.astype(jnp.uint32),
+            d.throttle_millis,
+            d.near_delta,
+            d.over_delta,
+            s_before,
+            s_after,
+        ]
+    )[:, inv]
+    out = jnp.where(owned[None, :], out, jnp.uint32(0))
+    return state.table, jax.lax.psum(out, axis)
+
+
+def sharded_slab_step(mesh: Mesh, n_probes: int = 4, use_pallas: bool = False):
+    """Build the jitted mesh-wide step: (state, packed) -> (state, out[8, b]).
+
+    state is sharded P(axis, None); packed and out are replicated. Compiled
+    once per batch-bucket shape (the backend pads to fixed buckets).
+    """
+    axis = mesh.axis_names[0]
+    body = functools.partial(
+        _sharded_body, n_probes=n_probes, use_pallas=use_pallas, axis=axis
+    )
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(axis, None), P(None, None)),
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+class ShardedSlabEngine:
+    """Drop-in device engine for TpuRateLimitCache: same packed block protocol
+    as ops/slab.py's slab_step_packed, but state spans every device of a mesh.
+
+    n_slots_global must split into a power-of-two number of rows per device.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        n_slots_global: int = 1 << 22,
+        n_probes: int = 4,
+        use_pallas: bool = False,
+    ):
+        if mesh is None:
+            mesh = make_mesh()
+        self.mesh = mesh
+        n_dev = mesh.devices.size
+        n_local, rem = divmod(n_slots_global, n_dev)
+        if rem or n_local & (n_local - 1):
+            raise ValueError(
+                f"n_slots_global={n_slots_global} must be n_devices "
+                f"({n_dev}) x a power of two"
+            )
+        self.n_slots_global = n_slots_global
+        axis = mesh.axis_names[0]
+        self._state_sharding = NamedSharding(mesh, P(axis, None))
+        self._batch_sharding = NamedSharding(mesh, P(None, None))
+        self._state = jax.device_put(
+            jnp.zeros((n_slots_global, ROW_WIDTH), dtype=jnp.uint32),
+            self._state_sharding,
+        )
+        self._step = sharded_slab_step(mesh, n_probes=n_probes, use_pallas=use_pallas)
+
+    def step_packed(self, packed: np.ndarray) -> np.ndarray:
+        """One mesh-wide launch. packed: uint32[7, b] -> uint32[8, b] results
+        in arrival order (no permutation row: unsorted on device pre-psum)."""
+        packed_dev = jax.device_put(packed, self._batch_sharding)
+        self._state, out = self._step(self._state, packed_dev)
+        return np.asarray(out)
+
+    # Matches TpuRateLimitCache._launch_packed's contract (rows 0..7, already
+    # in arrival order) so the backend can swap engines transparently.
+    out_rows = PACKED_OUT_ROWS - 1
