@@ -4,9 +4,17 @@ Replaces the reference's Redis hot path (src/redis/fixed_cache_impl.go) with
 an in-process TPU device program: descriptors are fingerprinted on the host
 (ops/hashing.py, xxhash), concurrent requests coalesce in the micro-batcher
 (backends/batcher.py — the TPU analog of implicit Redis pipelining), and one
-jitted launch executes probe + window-reset + increment + decide against the
-HBM slab (ops/slab.py). Near/over-limit stats deltas come back from the
-device and are added to the same per-rule counters the reference maintains.
+jitted launch executes probe + window-reset + duplicate-serialized increment
+against the HBM slab (ops/slab.py).
+
+Division of labor (after-mode, ops/slab.py:slab_step_after): the device owns
+the STATE — it returns only each item's post-increment counter, saturating-
+cast to the narrowest dtype the batch's limits allow so the readback is one
+byte or two per decision. The host then derives code/remaining/duration/
+throttle and the near/over stats split by calling the SAME
+BaseRateLimiter.get_response_descriptor_status oracle the memory backend
+uses (limiter/base_limiter.py:92-142) — TPU-vs-oracle parity holds by
+construction, exactly how both reference backends share base_limiter.go.
 
 The local over-limit cache stays host-side in front of the device exactly
 like the reference's freecache sits in front of Redis
@@ -14,7 +22,7 @@ like the reference's freecache sits in front of Redis
 never reach the batcher.
 
 Single-chip by default; parallel/sharded_slab.py provides the multi-chip
-variant (hash-sharded slab, decisions combined over ICI).
+variant (hash-sharded slab, decisions combined over ICI) behind `mesh=`.
 """
 
 from __future__ import annotations
@@ -23,19 +31,19 @@ import dataclasses
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..assertx import assert_
-from ..limiter.base_limiter import BaseRateLimiter
+from ..limiter.base_limiter import BaseRateLimiter, LimitInfo
 from ..limiter.cache import CacheError
 from ..limiter.cache_key import generate_cache_key
 from ..models.config import RateLimit
 from ..models.descriptors import RateLimitRequest
-from ..models.response import Code, DescriptorStatus, DoLimitResponse
+from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
-from ..utils.timeutil import calculate_reset
 from ..ops.hashing import fingerprint64, split_fingerprints
-from ..ops.slab import make_slab, slab_step_packed
+from ..ops.slab import make_slab, slab_step_after
 from .batcher import MicroBatcher
 
 
@@ -46,16 +54,6 @@ class _Item:
     limit: int
     divider: int
     jitter: int
-
-
-@dataclasses.dataclass(slots=True)
-class _ItemResult:
-    code: int
-    limit_remaining: int
-    duration_until_reset: int
-    throttle_millis: int
-    near_delta: int
-    over_delta: int
 
 
 class TpuRateLimitCache:
@@ -107,33 +105,36 @@ class TpuRateLimitCache:
                 return b
         return self._max_bucket
 
-    def _execute_batch(self, items: list[_Item]) -> list[_ItemResult]:
+    def _execute_batch(self, items: list[_Item]) -> list[int]:
         try:
-            out: list[_ItemResult] = []
+            out: list[int] = []
             for off in range(0, len(items), self._max_bucket):
                 out.extend(self._launch(items[off : off + self._max_bucket]))
             return out
         except Exception as e:  # surfaced as redis_error-equivalent
             raise CacheError(f"tpu backend failure: {e}") from e
 
-    def _launch(self, items: list[_Item]) -> list[_ItemResult]:
-        out = self._launch_packed(self._pack(items))
+    def _launch(self, items: list[_Item]) -> list[int]:
+        """One device launch; returns each item's post-increment counter."""
+        packed = self._pack(items)
         n = len(items)
-        # one bulk tolist per row, not 6*n numpy scalar reads
-        code, remaining, duration, throttle, near_d, over_d = (
-            out[ROW, :n].tolist() for ROW in range(6)
+        # Narrowest exact readback: a saturated value can only mean "already
+        # far over limit", which the oracle's all-over branch handles exactly
+        # as long as cap > limit + hits for every item in the launch.
+        maxv = max(it.limit + it.hits for it in items)
+        if self._engine is not None:
+            cap = 0xFF if maxv < 255 else 0xFFFF if maxv < 65535 else 0xFFFFFFFF
+            return self._engine.step_after(packed, cap)[:n].tolist()
+        if maxv < 255:
+            dtype = jnp.uint8
+        elif maxv < 65535:
+            dtype = jnp.uint16
+        else:
+            dtype = jnp.uint32
+        self._state, after_dev = slab_step_after(
+            self._state, jax.device_put(packed, self._device), out_dtype=dtype
         )
-        return [
-            _ItemResult(
-                code=code[i],
-                limit_remaining=remaining[i],
-                duration_until_reset=duration[i],
-                throttle_millis=throttle[i],
-                near_delta=near_d[i],
-                over_delta=over_d[i],
-            )
-            for i in range(n)
-        ]
+        return np.asarray(after_dev)[:n].tolist()
 
     def _pack(self, items: list[_Item]) -> np.ndarray:
         """uint32[7, bucket] input block (one H2D transfer per launch)."""
@@ -150,23 +151,6 @@ class TpuRateLimitCache:
         packed[6, 1] = np.float32(self._base.near_limit_ratio).view(np.uint32)
         return packed
 
-    def _launch_packed(self, packed: np.ndarray) -> np.ndarray:
-        """One device launch; returns the uint32[8, size] result block in
-        arrival order (device returns sort order + permutation; the host
-        unsorts with one fancy-index, cheaper than a device-side unsort)."""
-        if self._engine is not None:
-            return self._engine.step_packed(packed)
-        self._state, out_dev = slab_step_packed(
-            self._state,
-            jax.device_put(packed, self._device),
-            use_pallas=self._use_pallas,
-        )
-        out = np.asarray(out_dev)  # one D2H transfer
-        order = out[8]
-        unsorted = np.empty_like(out[:8])
-        unsorted[:, order] = out[:8]
-        return unsorted
-
     # -- RateLimitCache interface --
 
     def do_limit(
@@ -174,45 +158,29 @@ class TpuRateLimitCache:
         request: RateLimitRequest,
         limits: Sequence[RateLimit | None],
     ) -> DoLimitResponse:
-        assert_(len(request.descriptors) == len(limits))
         hits_addend = max(1, request.hits_addend)
-        now = self._base.time_source.unix_now()
-        local_cache = self._base.local_cache
+        cache_keys = self._base.generate_cache_keys(request, limits, hits_addend)
 
         n = len(request.descriptors)
-        statuses: list[DescriptorStatus | None] = [None] * n
-        response = DoLimitResponse()
+        over_local = [False] * n
+        results = [0] * n
 
         items: list[_Item] = []
         item_slots: list[int] = []  # descriptor index per item
-        keys: list[str] = [""] * n  # string keys only when local cache is on
-
-        for i, (descriptor, limit) in enumerate(zip(request.descriptors, limits)):
-            if limit is None:
-                statuses[i] = DescriptorStatus(code=Code.OK)
+        for i, cache_key in enumerate(cache_keys):
+            if cache_key.key == "":
                 continue
-            limit.stats.total_hits.add(hits_addend)
+            if self._base.is_over_limit_with_local_cache(cache_key.key):
+                over_local[i] = True
+                continue
+            limit = limits[i]
             divider = unit_to_divider(limit.unit)
-
-            if local_cache is not None:
-                keys[i] = generate_cache_key(
-                    request.domain, descriptor, limit, now
-                ).key
-                if local_cache.contains(keys[i]):
-                    limit.stats.over_limit.add(hits_addend)
-                    limit.stats.over_limit_with_local_cache.add(hits_addend)
-                    statuses[i] = DescriptorStatus(
-                        code=Code.OVER_LIMIT,
-                        current_limit=limit.limit,
-                        limit_remaining=0,
-                        duration_until_reset=calculate_reset(limit.unit, now),
-                    )
-                    continue
-
             jitter = self._base.expiration_seconds(divider) - divider
             items.append(
                 _Item(
-                    fp=fingerprint64(request.domain, descriptor.entries, divider),
+                    fp=fingerprint64(
+                        request.domain, request.descriptors[i].entries, divider
+                    ),
                     hits=hits_addend,
                     limit=limit.requests_per_unit,
                     divider=divider,
@@ -221,37 +189,41 @@ class TpuRateLimitCache:
             )
             item_slots.append(i)
 
-        results = self._batcher.submit(items)
+        for after, i in zip(self._batcher.submit(items), item_slots):
+            results[i] = after
 
-        for res, i in zip(results, item_slots):
+        response = DoLimitResponse()
+        for i, cache_key in enumerate(cache_keys):
             limit = limits[i]
-            statuses[i] = DescriptorStatus(
-                code=Code(res.code),
-                current_limit=limit.limit,
-                limit_remaining=res.limit_remaining,
-                duration_until_reset=res.duration_until_reset,
+            info = (
+                LimitInfo(limit, results[i] - hits_addend, results[i])
+                if limit is not None
+                else None
             )
-            if res.near_delta:
-                limit.stats.near_limit.add(res.near_delta)
-            if res.over_delta:
-                limit.stats.over_limit.add(res.over_delta)
-            if res.code == Code.OVER_LIMIT and local_cache is not None:
-                # Re-stamp the key at set time: with a batch window > 0 the
-                # device may have decided in a LATER fixed window than the
-                # one `keys[i]` was generated in (caller's now), and a stale
-                # window stamp would never be looked up again.
-                set_key = generate_cache_key(
+            key = cache_key.key
+            if (
+                key != ""
+                and not over_local[i]
+                and self._base.local_cache is not None
+                and limit is not None
+                and results[i] > limit.requests_per_unit
+            ):
+                # The batched decision may have landed in a LATER fixed
+                # window than the one `key` was stamped with: re-stamp at the
+                # current clock so the oracle's over-limit cache entry is the
+                # one later requests will actually look up.
+                key = generate_cache_key(
                     request.domain,
                     request.descriptors[i],
                     limit,
                     self._base.time_source.unix_now(),
                 ).key
-                local_cache.set(set_key, unit_to_divider(limit.unit))
-            if res.throttle_millis > response.throttle_millis:
-                response.throttle_millis = res.throttle_millis
-
-        response.descriptor_statuses = statuses  # type: ignore[assignment]
-        assert_(all(s is not None for s in statuses))
+            response.descriptor_statuses.append(
+                self._base.get_response_descriptor_status(
+                    key, info, over_local[i], hits_addend, response
+                )
+            )
+        assert_(len(response.descriptor_statuses) == n)
         return response
 
     def flush(self) -> None:

@@ -131,16 +131,17 @@ def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     return jnp.where(valid, chosen, jnp.int32(n))
 
 
-def _slab_step_sorted(
+def _slab_update_sorted(
     state: SlabState,
     batch: SlabBatch,
     now: jnp.ndarray,  # int32 scalar
-    near_ratio: jnp.ndarray,  # float32 scalar
     n_probes: int,
-    use_pallas: bool,
 ):
-    """Core step; returns results in slot-sorted order plus the permutation
-    (callers unsort on device or on the host)."""
+    """The stateful core: probe, serialize duplicates, window-reset,
+    increment, one row-scatter. Returns sorted before/after counters, the
+    sorted per-item inputs the decision needs, and the sort permutation.
+    No decision math — callers either decide on device (_slab_step_sorted)
+    or ship `after` to the host and reuse the BaseRateLimiter oracle."""
     n = state.n_slots
     now = now.astype(jnp.int32)
 
@@ -212,6 +213,29 @@ def _slab_step_sorted(
     table = state.table.at[write_idx].set(
         new_rows, mode="drop", unique_indices=True
     )
+    return (
+        SlabState(table=table),
+        s_before,
+        s_after,
+        (s_hits, s_limit, s_div),
+        order,
+    )
+
+
+def _slab_step_sorted(
+    state: SlabState,
+    batch: SlabBatch,
+    now: jnp.ndarray,  # int32 scalar
+    near_ratio: jnp.ndarray,  # float32 scalar
+    n_probes: int,
+    use_pallas: bool,
+):
+    """Core step with on-device decision; returns results in slot-sorted
+    order plus the permutation (callers unsort on device or on the host)."""
+    now = now.astype(jnp.int32)
+    state, s_before, s_after, (s_hits, s_limit, s_div), order = _slab_update_sorted(
+        state, batch, now, n_probes
+    )
 
     if use_pallas:
         from .pallas_decide import pallas_decide
@@ -229,7 +253,7 @@ def _slab_step_sorted(
             now=now,
             near_ratio=near_ratio,
         )
-    return SlabState(table=table), s_before, s_after, decision, order
+    return state, s_before, s_after, decision, order
 
 
 def _slab_step(
@@ -243,13 +267,9 @@ def _slab_step(
     state, s_before, s_after, s_dec, order = _slab_step_sorted(
         state, batch, now, near_ratio, n_probes, use_pallas
     )
-    # inverse permutation via scatter (cheaper than a second sort on TPU)
-    inv = jnp.zeros_like(order).at[order].set(
-        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
-    )
-    decision = DecideResult(*(field[inv] for field in s_dec))
+    decision = DecideResult(*(_unsort(field, order) for field in s_dec))
     return state, SlabResult(
-        before=s_before[inv], after=s_after[inv], decision=decision
+        before=_unsort(s_before, order), after=_unsort(s_after, order), decision=decision
     )
 
 
@@ -287,16 +307,7 @@ def slab_step_packed(
     n_probes: int = 4,
     use_pallas: bool = False,
 ) -> tuple[SlabState, jnp.ndarray]:
-    batch = SlabBatch(
-        fp_lo=packed[ROW_FP_LO],
-        fp_hi=packed[ROW_FP_HI],
-        hits=packed[ROW_HITS],
-        limit=packed[ROW_LIMIT],
-        divider=packed[ROW_DIVIDER].astype(jnp.int32),
-        jitter=packed[ROW_JITTER].astype(jnp.int32),
-    )
-    now = packed[ROW_SCALARS, 0].astype(jnp.int32)
-    near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
+    batch, now, near_ratio = _unpack(packed)
     state, s_before, s_after, d, order = _slab_step_sorted(
         state, batch, now, near_ratio, n_probes, use_pallas
     )
@@ -314,3 +325,86 @@ def slab_step_packed(
         ]
     )
     return state, out
+
+
+# --- compact transfer modes -------------------------------------------------
+#
+# The packed step above ships 9 uint32 rows back per item. On transfer-
+# constrained links (the PCIe DMA on real hardware; far more so the axon dev
+# tunnel) the readback dominates the whole hot path, so two compact modes cut
+# it to ONE row, or one BYTE, per item:
+#
+#   * after-mode (production): the device returns only the post-increment
+#     counter, unsorted on device. code/remaining/duration/throttle and the
+#     near/over stats split are all pure functions of (after, hits, limit,
+#     unit, now) — the host derives them by calling the SAME
+#     BaseRateLimiter.get_response_descriptor_status oracle the memory
+#     backend uses (limiter/base_limiter.py:92-142), which makes TPU-vs-
+#     oracle parity true by construction. Saturating u8/u16 casts are exact
+#     as long as cap > limit + hits: a saturated value can only mean
+#     "already far over limit", where the oracle's all-over branch
+#     (before >= threshold) yields the same stats no matter the magnitude.
+#
+#   * decided-mode (bench / fire-and-forget): the decision runs on device
+#     (Pallas kernel) and only the 1-byte code comes back.
+
+
+def _unsort(values: jnp.ndarray, order: jnp.ndarray) -> jnp.ndarray:
+    """Undo the slot sort on device (inverse permutation via scatter)."""
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
+    )
+    return values[inv]
+
+
+def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray]:
+    batch = SlabBatch(
+        fp_lo=packed[ROW_FP_LO],
+        fp_hi=packed[ROW_FP_HI],
+        hits=packed[ROW_HITS],
+        limit=packed[ROW_LIMIT],
+        divider=packed[ROW_DIVIDER].astype(jnp.int32),
+        jitter=packed[ROW_JITTER].astype(jnp.int32),
+    )
+    now = packed[ROW_SCALARS, 0].astype(jnp.int32)
+    near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
+    return batch, now, near_ratio
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "out_dtype"), donate_argnames=("state",)
+)
+def slab_step_after(
+    state: SlabState,
+    packed: jnp.ndarray,  # uint32[7, b]
+    n_probes: int = 4,
+    out_dtype=jnp.uint32,
+) -> tuple[SlabState, jnp.ndarray]:
+    """Stateful update only; returns post-increment counters in arrival
+    order, saturating-cast to out_dtype (the caller guarantees
+    max(limit) + max(hits) < dtype max)."""
+    batch, now, _ = _unpack(packed)
+    state, _before, s_after, _inputs, order = _slab_update_sorted(
+        state, batch, now, n_probes
+    )
+    after = _unsort(s_after, order)
+    cap = jnp.uint32(jnp.iinfo(out_dtype).max)
+    return state, jnp.minimum(after, cap).astype(out_dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "use_pallas"), donate_argnames=("state",)
+)
+def slab_step_decided(
+    state: SlabState,
+    packed: jnp.ndarray,  # uint32[7, b]
+    n_probes: int = 4,
+    use_pallas: bool = False,
+) -> tuple[SlabState, jnp.ndarray]:
+    """Full on-device decision; only the 1-byte code per item comes back
+    (1=OK, 2=OVER_LIMIT), in arrival order."""
+    batch, now, near_ratio = _unpack(packed)
+    state, _before, _after, d, order = _slab_step_sorted(
+        state, batch, now, near_ratio, n_probes, use_pallas
+    )
+    return state, _unsort(d.code, order).astype(jnp.uint8)

@@ -43,17 +43,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.slab import (
     PACKED_OUT_ROWS,
-    ROW_DIVIDER,
-    ROW_FP_HI,
-    ROW_FP_LO,
-    ROW_HITS,
-    ROW_JITTER,
-    ROW_LIMIT,
-    ROW_SCALARS,
     ROW_WIDTH,
-    SlabBatch,
     SlabState,
     _slab_step_sorted,
+    _slab_update_sorted,
+    _unpack,
+    _unsort,
 )
 
 SHARD_AXIS = "shard"
@@ -83,16 +78,7 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
     """Per-device body under shard_map. table: local shard [n_local, ROW_WIDTH];
     packed: replicated uint32[7, b]. Returns (new local shard, replicated
     uint32[8, b] results in arrival order)."""
-    batch = SlabBatch(
-        fp_lo=packed[ROW_FP_LO],
-        fp_hi=packed[ROW_FP_HI],
-        hits=packed[ROW_HITS],
-        limit=packed[ROW_LIMIT],
-        divider=packed[ROW_DIVIDER].astype(jnp.int32),
-        jitter=packed[ROW_JITTER].astype(jnp.int32),
-    )
-    now = packed[ROW_SCALARS, 0].astype(jnp.int32)
-    near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
+    batch, now, near_ratio = _unpack(packed)
 
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
@@ -103,9 +89,6 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
 
     # Unsort ON DEVICE (the host-side unsort trick of slab_step_packed does
     # not compose with psum: each device has its own permutation).
-    inv = jnp.zeros_like(order).at[order].set(
-        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
-    )
     out = jnp.stack(
         [
             d.code.astype(jnp.uint32),
@@ -117,28 +100,53 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
             s_before,
             s_after,
         ]
-    )[:, inv]
+    )
+    out = _unsort(out.T, order).T
     out = jnp.where(owned[None, :], out, jnp.uint32(0))
     return state.table, jax.lax.psum(out, axis)
 
 
-def sharded_slab_step(mesh: Mesh, n_probes: int = 4, use_pallas: bool = False):
-    """Build the jitted mesh-wide step: (state, packed) -> (state, out[8, b]).
+def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
+    """after-mode per-device body: stateful update only; psum the single
+    saturating-cast post-increment row (see ops/slab.py compact modes)."""
+    batch, now, _near = _unpack(packed)
 
-    state is sharded P(axis, None); packed and out are replicated. Compiled
-    once per batch-bucket shape (the backend pads to fixed buckets).
-    """
-    axis = mesh.axis_names[0]
-    body = functools.partial(
-        _sharded_body, n_probes=n_probes, use_pallas=use_pallas, axis=axis
+    owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
+    batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
+
+    state, _before, s_after, _inputs, order = _slab_update_sorted(
+        SlabState(table=table), batch, now, n_probes
     )
+    after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
+    after = jnp.where(owned, after, jnp.uint32(0))
+    return state.table, jax.lax.psum(after, axis)
+
+
+def _build_step(mesh: Mesh, body, out_spec: P, **kw):
+    axis = mesh.axis_names[0]
     mapped = jax.shard_map(
-        body,
+        functools.partial(body, axis=axis, **kw),
         mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
-        out_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(axis, None), out_spec),
     )
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+def sharded_slab_step(mesh: Mesh, n_probes: int = 4, use_pallas: bool = False):
+    """Build the jitted mesh-wide full step: (state, packed) -> (state,
+    out[8, b]). state is sharded P(axis, None); packed and out are
+    replicated. Compiled once per batch-bucket shape (the backend pads to
+    fixed buckets)."""
+    return _build_step(
+        mesh, _sharded_body, P(None, None), n_probes=n_probes, use_pallas=use_pallas
+    )
+
+
+def sharded_slab_step_after(mesh: Mesh, cap: int, n_probes: int = 4):
+    """Build the jitted mesh-wide after-mode step: (state, packed) ->
+    (state, after[b] saturated at cap), the production readback path."""
+    return _build_step(mesh, _sharded_body_after, P(None), n_probes=n_probes, cap=cap)
 
 
 class ShardedSlabEngine:
@@ -173,7 +181,9 @@ class ShardedSlabEngine:
             jnp.zeros((n_slots_global, ROW_WIDTH), dtype=jnp.uint32),
             self._state_sharding,
         )
+        self._n_probes = n_probes
         self._step = sharded_slab_step(mesh, n_probes=n_probes, use_pallas=use_pallas)
+        self._after_steps: dict[int, object] = {}
 
     def step_packed(self, packed: np.ndarray) -> np.ndarray:
         """One mesh-wide launch. packed: uint32[7, b] -> uint32[8, b] results
@@ -181,6 +191,18 @@ class ShardedSlabEngine:
         packed_dev = jax.device_put(packed, self._batch_sharding)
         self._state, out = self._step(self._state, packed_dev)
         return np.asarray(out)
+
+    def step_after(self, packed: np.ndarray, cap: int = 0xFFFFFFFF) -> np.ndarray:
+        """Production readback path: stateful update only, one saturated
+        post-increment counter row back (caller guarantees cap > limit+hits;
+        see ops/slab.py compact modes)."""
+        step = self._after_steps.get(cap)
+        if step is None:
+            step = sharded_slab_step_after(self.mesh, cap, n_probes=self._n_probes)
+            self._after_steps[cap] = step
+        packed_dev = jax.device_put(packed, self._batch_sharding)
+        self._state, after = step(self._state, packed_dev)
+        return np.asarray(after)
 
     # Matches TpuRateLimitCache._launch_packed's contract (rows 0..7, already
     # in arrival order) so the backend can swap engines transparently.

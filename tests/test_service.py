@@ -1,0 +1,290 @@
+"""Service layer tests — the Python twin of test/service/ratelimit_test.go:
+OK/OVER_LIMIT aggregation, reload success/failure keeping old config, empty
+domain/descriptor errors, cache error counting, sleep-on-throttle semantics,
+detail headers."""
+
+import base64
+import json
+
+import pytest
+
+from api_ratelimit_tpu.config.loader import ConfigFile, load_config
+from api_ratelimit_tpu.limiter.cache import CacheError
+from api_ratelimit_tpu.models import Code, Descriptor, RateLimitRequest
+from api_ratelimit_tpu.models.response import (
+    DescriptorStatus,
+    DoLimitResponse,
+    RateLimitValue,
+)
+from api_ratelimit_tpu.service import RateLimitService, ServiceError
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+class FakeSnapshot:
+    def __init__(self, files: dict[str, str]):
+        self._files = files
+
+    def keys(self):
+        return list(self._files)
+
+    def get(self, key):
+        return self._files[key]
+
+
+class FakeRuntime:
+    def __init__(self, files: dict[str, str]):
+        self.files = dict(files)
+        self.callbacks = []
+
+    def snapshot(self):
+        return FakeSnapshot(self.files)
+
+    def add_update_callback(self, cb):
+        self.callbacks.append(cb)
+
+    def update(self, files: dict[str, str]):
+        self.files = dict(files)
+        for cb in self.callbacks:
+            cb()
+
+
+class FakeCache:
+    """Scripted RateLimitCache."""
+
+    def __init__(self):
+        self.next_statuses = []
+        self.next_throttle = 0
+        self.calls = []
+        self.raise_error = None
+
+    def do_limit(self, request, limits):
+        self.calls.append((request, list(limits)))
+        if self.raise_error is not None:
+            raise self.raise_error
+        statuses = self.next_statuses or [
+            DescriptorStatus(code=Code.OK) for _ in request.descriptors
+        ]
+        return DoLimitResponse(
+            descriptor_statuses=list(statuses), throttle_millis=self.next_throttle
+        )
+
+    def flush(self):
+        pass
+
+
+BASIC_YAML = """
+domain: test-domain
+descriptors:
+  - key: k
+    value: v
+    rate_limit: {unit: minute, requests_per_unit: 10}
+"""
+
+OTHER_YAML = """
+domain: other-domain
+descriptors:
+  - key: k2
+    rate_limit: {unit: hour, requests_per_unit: 5}
+"""
+
+BAD_YAML = "domain: [this is not\nvalid yaml"
+
+
+def req(*pairs, domain="test-domain"):
+    return RateLimitRequest(
+        domain=domain,
+        descriptors=tuple(Descriptor.of(p) for p in pairs),
+        hits_addend=1,
+    )
+
+
+def make_service(files=None, cache=None, watch_root=True, **kw):
+    runtime = FakeRuntime(
+        files if files is not None else {"config.basic": BASIC_YAML}
+    )
+    cache = cache or FakeCache()
+    sink = TestSink()
+    store = Store(sink)
+    svc = RateLimitService(
+        runtime=runtime,
+        cache=cache,
+        stats_scope=store.scope("ratelimit"),
+        time_source=FakeTimeSource(1_000_000),
+        runtime_watch_root=watch_root,
+        **kw,
+    )
+    return svc, runtime, cache, store, sink
+
+
+class TestServiceBasics:
+    def test_initial_load_and_ok(self):
+        svc, _, cache, store, sink = make_service()
+        overall, statuses, headers = svc.should_rate_limit(req(("k", "v")))
+        assert overall == Code.OK
+        assert len(statuses) == 1
+        assert headers == []
+        # the resolved limit was passed to the cache
+        _, limits = cache.calls[0]
+        assert limits[0].requests_per_unit == 10
+        store.flush()
+        assert sink.counters["ratelimit.config_load_success"] == 1
+
+    def test_unmatched_descriptor_gets_none_limit(self):
+        svc, _, cache, _, _ = make_service()
+        svc.should_rate_limit(req(("nope", "x")))
+        _, limits = cache.calls[0]
+        assert limits == [None]
+
+    def test_overall_code_aggregation(self):
+        svc, _, cache, _, _ = make_service()
+        cache.next_statuses = [
+            DescriptorStatus(code=Code.OK),
+            DescriptorStatus(code=Code.OVER_LIMIT),
+        ]
+        overall, statuses, _ = svc.should_rate_limit(req(("k", "v"), ("k", "v")))
+        assert overall == Code.OVER_LIMIT
+        assert [s.code for s in statuses] == [Code.OK, Code.OVER_LIMIT]
+
+    def test_empty_domain_raises_service_error(self):
+        svc, _, _, store, sink = make_service()
+        with pytest.raises(ServiceError, match="domain must not be empty"):
+            svc.should_rate_limit(req(("k", "v"), domain=""))
+        store.flush()
+        assert (
+            sink.counters["ratelimit.call.should_rate_limit.service_error"] == 1
+        )
+
+    def test_empty_descriptors_raises_service_error(self):
+        svc, _, _, _, _ = make_service()
+        with pytest.raises(ServiceError, match="descriptor list must not be empty"):
+            svc.should_rate_limit(RateLimitRequest(domain="test-domain"))
+
+    def test_cache_error_counted_and_reraised(self):
+        svc, _, cache, store, sink = make_service()
+        cache.raise_error = CacheError("backend down")
+        with pytest.raises(CacheError):
+            svc.should_rate_limit(req(("k", "v")))
+        store.flush()
+        assert sink.counters["ratelimit.call.should_rate_limit.redis_error"] == 1
+
+
+class TestConfigReload:
+    def test_reload_picks_up_new_domain(self):
+        svc, runtime, _, store, sink = make_service()
+        assert svc.get_current_config().get_limit(
+            "other-domain", Descriptor.of(("k2", "x"))
+        ) is None
+        runtime.update(
+            {"config.basic": BASIC_YAML, "config.other": OTHER_YAML}
+        )
+        limit = svc.get_current_config().get_limit(
+            "other-domain", Descriptor.of(("k2", "x"))
+        )
+        assert limit is not None and limit.requests_per_unit == 5
+        store.flush()
+        assert sink.counters["ratelimit.config_load_success"] == 2
+
+    def test_bad_reload_keeps_old_config(self):
+        svc, runtime, _, store, sink = make_service()
+        runtime.update({"config.basic": BAD_YAML})
+        # old config still answers
+        limit = svc.get_current_config().get_limit(
+            "test-domain", Descriptor.of(("k", "v"))
+        )
+        assert limit is not None and limit.requests_per_unit == 10
+        store.flush()
+        assert sink.counters["ratelimit.config_load_error"] == 1
+        assert sink.counters["ratelimit.config_load_success"] == 1
+
+    def test_initial_load_failure_leaves_no_config(self):
+        svc, _, _, store, sink = make_service(files={"config.bad": BAD_YAML})
+        with pytest.raises(ServiceError, match="no rate limit configuration"):
+            svc.should_rate_limit(req(("k", "v")))
+        store.flush()
+        assert sink.counters["ratelimit.config_load_error"] == 1
+
+    def test_watch_root_filters_non_config_keys(self):
+        svc, _, _, _, _ = make_service(
+            files={"config.basic": BASIC_YAML, "ignored.key": BAD_YAML}
+        )
+        assert svc.get_current_config() is not None
+
+    def test_watch_root_false_loads_all_keys(self):
+        svc, _, _, _, _ = make_service(
+            files={"anything": BASIC_YAML}, watch_root=False
+        )
+        limit = svc.get_current_config().get_limit(
+            "test-domain", Descriptor.of(("k", "v"))
+        )
+        assert limit is not None
+
+
+SLEEPY_YAML = """
+domain: sleepy
+descriptors:
+  - key: k
+    value: v
+    rate_limit: {unit: minute, requests_per_unit: 10}
+    sleep_on_throttle: true
+    report_details: true
+"""
+
+
+class TestThrottleAndDetails:
+    def test_sleep_on_throttle_sleeps_and_clears(self):
+        svc, _, cache, _, _ = make_service(
+            files={"config.sleepy": SLEEPY_YAML}, max_sleeping_routines=2
+        )
+        cache.next_throttle = 1500
+        ts = svc._time_source
+        _, _, headers = svc.should_rate_limit(req(("k", "v"), domain="sleepy"))
+        assert ts.sleeps == [1.5]
+        # server slept; throttle header must NOT be added (millis reset)
+        assert all(h.key != "x-ratelimit-throttle-ms" for h in headers)
+
+    def test_no_semaphore_no_sleep(self):
+        svc, _, cache, _, _ = make_service(files={"config.sleepy": SLEEPY_YAML})
+        cache.next_throttle = 1500
+        ts = svc._time_source
+        _, _, headers = svc.should_rate_limit(req(("k", "v"), domain="sleepy"))
+        assert ts.sleeps == []
+        # not slept server-side: throttle-ms header reported instead
+        assert any(
+            h.key == "x-ratelimit-throttle-ms" and h.value == "1500"
+            for h in headers
+        )
+
+    def test_detail_header_is_base64_json(self):
+        svc, _, cache, _, _ = make_service(files={"config.sleepy": SLEEPY_YAML})
+        cache.next_statuses = [
+            DescriptorStatus(
+                code=Code.OVER_LIMIT,
+                current_limit=RateLimitValue(10, unit=1),
+                limit_remaining=0,
+            )
+        ]
+        _, _, headers = svc.should_rate_limit(req(("k", "v"), domain="sleepy"))
+        detail = next(h for h in headers if h.key == "x-ratelimit-details")
+        pad = "=" * (-len(detail.value) % 4)
+        decoded = json.loads(base64.urlsafe_b64decode(detail.value + pad))
+        assert decoded["descriptor_statuses"][0]["code"] == "OVER_LIMIT"
+
+    def test_no_details_for_plain_rules(self):
+        svc, _, cache, _, _ = make_service()
+        cache.next_throttle = 999
+        _, _, headers = svc.should_rate_limit(req(("k", "v")))
+        assert headers == []
+
+
+class TestLoaderDirect:
+    def test_load_config_duplicate_domain_raises(self):
+        from api_ratelimit_tpu.models.config import ConfigError
+
+        files = [
+            ConfigFile("a.yaml", BASIC_YAML),
+            ConfigFile("b.yaml", BASIC_YAML),
+        ]
+        store = Store(TestSink())
+        with pytest.raises(ConfigError):
+            load_config(files, store)

@@ -1,0 +1,88 @@
+"""Settings layer: env parsing with the reference's variable names
+(src/settings/settings.go:10-48)."""
+
+import pytest
+
+from api_ratelimit_tpu.settings import Settings, new_settings
+
+
+class TestSettings:
+    def test_defaults(self):
+        s = new_settings({})
+        assert s.port == 8080
+        assert s.grpc_port == 8081
+        assert s.debug_port == 6070
+        assert s.use_statsd is True
+        assert s.runtime_path == "/srv/runtime_data/current"
+        assert s.near_limit_ratio == pytest.approx(0.8)
+        assert s.expiration_jitter_max_seconds == 300
+        assert s.local_cache_size_in_bytes == 0
+        assert s.backend_type == "tpu"
+
+    def test_reference_env_names(self):
+        # a nomad-style env block (nomad/apigw-ratelimit/common.hcl)
+        s = new_settings(
+            {
+                "GRPC_PORT": "9484",
+                "PORT": "9486",
+                "DEBUG_PORT": "9485",
+                "USE_STATSD": "false",
+                "RUNTIME_ROOT": "/data/runtime",
+                "RUNTIME_SUBDIRECTORY": "ratelimit",
+                "RUNTIME_WATCH_ROOT": "false",
+                "LOG_LEVEL": "debug",
+                "MAX_SLEEPING_ROUTINES": "64",
+                "LOCAL_CACHE_SIZE_IN_BYTES": "1000000",
+                "NEAR_LIMIT_RATIO": "0.9",
+                "EXPIRATION_JITTER_MAX_SECONDS": "0",
+            }
+        )
+        assert s.grpc_port == 9484
+        assert s.use_statsd is False
+        assert s.runtime_subdirectory == "ratelimit"
+        assert s.runtime_watch_root is False
+        assert s.max_sleeping_routines == 64
+        assert s.local_cache_size_in_bytes == 1_000_000
+        assert s.near_limit_ratio == pytest.approx(0.9)
+        assert s.expiration_jitter_max_seconds == 0
+
+    def test_go_duration_strings(self):
+        s = new_settings(
+            {
+                "REDIS_PIPELINE_WINDOW": "75us",
+                "TPU_BATCH_WINDOW": "500us",
+            }
+        )
+        assert s.redis_pipeline_window == pytest.approx(75e-6)
+        assert s.tpu_batch_window == pytest.approx(500e-6)
+        assert new_settings({"TPU_BATCH_WINDOW": "2ms"}).tpu_batch_window == (
+            pytest.approx(2e-3)
+        )
+
+    def test_bad_value_raises(self):
+        with pytest.raises(ValueError, match="GRPC_PORT"):
+            new_settings({"GRPC_PORT": "not-a-port"})
+        with pytest.raises(ValueError, match="USE_STATSD"):
+            new_settings({"USE_STATSD": "maybe"})
+
+    def test_empty_string_keeps_default(self):
+        s = new_settings({"STATSD_HOST": ""})
+        assert s.statsd_host == "localhost"
+
+    def test_tpu_knobs(self):
+        s = new_settings(
+            {
+                "BACKEND_TYPE": "tpu",
+                "TPU_SLAB_SLOTS": "8388608",
+                "TPU_BATCH_LIMIT": "32768",
+                "TPU_MESH_DEVICES": "4",
+                "TPU_USE_PALLAS": "false",
+            }
+        )
+        assert s.tpu_slab_slots == 1 << 23
+        assert s.tpu_batch_limit == 32768
+        assert s.tpu_mesh_devices == 4
+        assert s.tpu_use_pallas is False
+
+    def test_dataclass_is_plain(self):
+        assert Settings().port == 8080
