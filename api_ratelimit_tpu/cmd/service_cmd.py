@@ -1,0 +1,16 @@
+"""Server binary entry point (src/service_cmd/main.go:5-8).
+
+    python -m api_ratelimit_tpu.cmd.service_cmd
+"""
+
+from __future__ import annotations
+
+from ..runner import Runner
+
+
+def main() -> None:
+    Runner().run()
+
+
+if __name__ == "__main__":
+    main()

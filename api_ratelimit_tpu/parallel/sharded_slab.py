@@ -119,7 +119,15 @@ def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     after = jnp.where(owned, after, jnp.uint32(0))
-    return state.table, jax.lax.psum(after, axis)
+    # psum in uint32 (ICI collectives want word lanes), then narrow to the
+    # smallest dtype cap fits so the host readback ships 1-2 bytes/item like
+    # the single-chip path (ops/slab.py compact modes).
+    summed = jax.lax.psum(after, axis)
+    if cap <= 0xFF:
+        return state.table, summed.astype(jnp.uint8)
+    if cap <= 0xFFFF:
+        return state.table, summed.astype(jnp.uint16)
+    return state.table, summed
 
 
 def _build_step(mesh: Mesh, body, out_spec: P, **kw):

@@ -1,0 +1,37 @@
+"""Generated Envoy protobuf modules (see proto/gen.sh) + gRPC service glue.
+
+The wire contract is Envoy's RateLimitService — v3 plus the legacy v2 — which
+the reference serves via go-control-plane imports (SURVEY.md §2.2,
+src/service_cmd/runner/runner.go:119-121). protoc emits absolute `envoy.*`
+imports, so this package roots itself on sys.path; `envoy` doesn't collide
+with anything in the image.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(__file__)
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+from envoy.config.core.v3 import base_pb2 as core_v3  # noqa: E402
+from envoy.extensions.common.ratelimit.v3 import (  # noqa: E402
+    ratelimit_pb2 as common_ratelimit_v3,
+)
+from envoy.service.ratelimit.v3 import rls_pb2 as rls_v3  # noqa: E402
+from envoy.api.v2.core import base_pb2 as core_v2  # noqa: E402
+from envoy.api.v2.ratelimit import ratelimit_pb2 as ratelimit_v2  # noqa: E402
+from envoy.service.ratelimit.v2 import rls_pb2 as rls_v2  # noqa: E402
+from grpc_health_pb.health.v1 import health_pb2  # noqa: E402
+
+__all__ = [
+    "core_v3",
+    "common_ratelimit_v3",
+    "rls_v3",
+    "core_v2",
+    "ratelimit_v2",
+    "rls_v2",
+    "health_pb2",
+]

@@ -1,0 +1,217 @@
+"""Composition root — the Python twin of src/service_cmd/runner/runner.go.
+
+Run(): parse settings, configure logging, build the local over-limit cache,
+stats store + sink, transport server, the backend selected by BACKEND_TYPE
+(runner.go:43-64 — here: tpu | memory), the service with its runtime loader,
+register v3 + v2 gRPC services and /json (runner.go:115-121), hang /rlconfig
+on the debug port (runner.go:108-113), and serve.
+
+Backend factory differences from the reference: the reference switches
+between redis and memcache processes reached over TCP; here the equivalents
+are the in-process TPU slab engine (single- or multi-chip) and the pure-host
+memory oracle. The redis/memcache parity backends plug into the same switch
+when present.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import sys
+import threading
+
+from .backends.memory import MemoryRateLimitCache
+from .limiter.base_limiter import BaseRateLimiter
+from .limiter.cache import RateLimitCache
+from .limiter.local_cache import LocalCache, LocalCacheStats
+from .server.runtime_loader import DirectoryRuntimeLoader
+from .server.server import Server, new_server
+from .service.ratelimit import RateLimitService
+from .settings import Settings, new_settings
+from .stats.sinks import NullSink, StatsdSink
+from .stats.store import Store
+from .utils.timeutil import RealTimeSource
+
+logger = logging.getLogger("ratelimit.runner")
+
+_LOG_LEVELS = {
+    "TRACE": logging.DEBUG,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "FATAL": logging.CRITICAL,
+}
+
+
+class _JsonFormatter(logging.Formatter):
+    """LOG_FORMAT=json with the reference's field remaps: @timestamp/@message
+    (runner.go:75-83) so existing log collectors keep working."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "@timestamp": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
+            "@message": record.getMessage(),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def setup_logging(settings: Settings) -> None:
+    level = _LOG_LEVELS.get(settings.log_level.upper())
+    if level is None:
+        raise ValueError(f"invalid log level: {settings.log_level}")
+    handler = logging.StreamHandler(sys.stderr)
+    if settings.log_format == "json":
+        handler.setFormatter(_JsonFormatter())
+    elif settings.log_format == "text":
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+    else:
+        raise ValueError(f"invalid log format: {settings.log_format}")
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level)
+
+
+def create_limiter(
+    settings: Settings, base: BaseRateLimiter, stats_store: Store
+) -> RateLimitCache:
+    """BackendType switch (runner.go:43-64)."""
+    backend = settings.backend_type
+    if backend == "tpu":
+        from .backends.tpu import TpuRateLimitCache
+
+        mesh = None
+        if settings.tpu_mesh_devices > 1:
+            import jax
+            from jax.sharding import Mesh
+            import numpy as np
+
+            devices = jax.devices()[: settings.tpu_mesh_devices]
+            mesh = Mesh(np.array(devices), ("shard",))
+        return TpuRateLimitCache(
+            base,
+            n_slots=settings.tpu_slab_slots,
+            batch_window_seconds=settings.tpu_batch_window,
+            max_batch=settings.tpu_batch_limit,
+            use_pallas=None if settings.tpu_use_pallas else False,
+            mesh=mesh,
+        )
+    if backend == "memory":
+        return MemoryRateLimitCache(base)
+    if backend == "redis":
+        from .backends.redis import new_redis_cache_from_settings
+
+        return new_redis_cache_from_settings(settings, base, stats_store)
+    if backend == "memcache":
+        from .backends.memcache import new_memcache_cache_from_settings
+
+        return new_memcache_cache_from_settings(settings, base)
+    raise ValueError(f"invalid backend type: {backend!r}")
+
+
+class Runner:
+    def __init__(self, settings: Settings | None = None, sink=None):
+        self.settings = settings if settings is not None else new_settings()
+        if sink is None:
+            sink = (
+                StatsdSink(self.settings.statsd_host, self.settings.statsd_port)
+                if self.settings.use_statsd
+                else NullSink()
+            )
+        self.stats_store = Store(sink)
+        self.scope = self.stats_store.scope("ratelimit")
+        self.server: Server | None = None
+        self.service: RateLimitService | None = None
+        self.runtime: DirectoryRuntimeLoader | None = None
+        self._ready = threading.Event()
+
+    def get_stats_store(self) -> Store:
+        return self.stats_store
+
+    def _build(self) -> None:
+        settings = self.settings
+        setup_logging(settings)
+
+        local_cache = None
+        if settings.local_cache_size_in_bytes > 0:
+            # freecache is sized in bytes; entries here are (key -> expiry)
+            # pairs of ~100 bytes, so the byte knob maps onto an entry cap.
+            local_cache = LocalCache(
+                max_entries=max(1, settings.local_cache_size_in_bytes // 100),
+                time_source=RealTimeSource(),
+            )
+            self.stats_store.add_stat_generator(
+                LocalCacheStats(local_cache, self.scope.scope("localcache"))
+            )
+
+        self.server = new_server(settings, self.stats_store)
+
+        base = BaseRateLimiter(
+            time_source=RealTimeSource(),
+            jitter_rand=random.Random(),
+            expiration_jitter_max_seconds=settings.expiration_jitter_max_seconds,
+            local_cache=local_cache,
+            near_limit_ratio=settings.near_limit_ratio,
+        )
+        cache = create_limiter(settings, base, self.stats_store)
+
+        self.runtime = DirectoryRuntimeLoader(
+            runtime_path=settings.runtime_path,
+            runtime_subdirectory=settings.runtime_subdirectory,
+            watch_root=settings.runtime_watch_root,
+            ignore_dotfiles=settings.runtime_ignoredotfiles,
+        )
+        self.service = RateLimitService(
+            runtime=self.runtime,
+            cache=cache,
+            stats_scope=self.scope.scope("service"),
+            time_source=RealTimeSource(),
+            runtime_watch_root=settings.runtime_watch_root,
+            max_sleeping_routines=settings.max_sleeping_routines,
+        )
+
+        def dump_config() -> str:
+            config = self.service.get_current_config()
+            return config.dump() if config is not None else ""
+
+        self.server.add_debug_endpoint("/rlconfig", dump_config)
+        self.server.register_service(self.service, self.scope.scope("service"))
+        self.runtime.start_watching()
+        self.stats_store.start_flushing()
+
+    def run(self) -> None:
+        """Build and serve; blocks until shutdown (Runner.Run, runner.go:66)."""
+        self._build()
+        self.server.install_signal_handlers()
+        self._ready.set()
+        try:
+            self.server.start()
+        finally:
+            self._teardown()
+
+    def run_background(self) -> None:
+        """Build and serve on daemon threads (integration-test entry)."""
+        self._build()
+        self.server.start_background()
+        self._ready.set()
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        return self._ready.wait(timeout)
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        if self.runtime is not None:
+            self.runtime.stop()
+        self.stats_store.stop_flushing()

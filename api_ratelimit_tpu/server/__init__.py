@@ -1,0 +1,9 @@
+from .runtime_loader import DirectoryRuntimeLoader, StaticRuntimeLoader
+from .server import Server, new_server
+
+__all__ = [
+    "DirectoryRuntimeLoader",
+    "StaticRuntimeLoader",
+    "Server",
+    "new_server",
+]

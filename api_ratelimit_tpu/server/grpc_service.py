@@ -1,0 +1,65 @@
+"""gRPC-facing servicers wrapping the transport-agnostic service brain.
+
+v3 servicer: proto in -> service.should_rate_limit -> proto out; typed
+exceptions surface as gRPC errors the way the reference's panic-recovery
+returns them to grpc-go (src/service/ratelimit.go:254-296 -> codes.Unknown).
+
+v2 legacy servicer: delegates to the same brain through the legacy adapters,
+with the reference's three conversion/dispatch error counters
+(src/service/ratelimit_legacy.go:23-36).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from ..limiter.cache import CacheError
+from ..pb import rls_grpc
+from ..service.ratelimit import RateLimitService, ServiceError
+from . import proto_adapter
+
+logger = logging.getLogger("ratelimit.server.grpc")
+
+
+class RateLimitServicerV3(rls_grpc.RateLimitServiceV3Servicer):
+    def __init__(self, service: RateLimitService):
+        self._service = service
+
+    def ShouldRateLimit(self, request, context):  # noqa: N802
+        internal = proto_adapter.request_from_v3(request)
+        logger.debug("handling v3 should_rate_limit for domain %s", internal.domain)
+        try:
+            overall, statuses, headers = self._service.should_rate_limit(internal)
+        except (CacheError, ServiceError) as e:
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        return proto_adapter.response_to_v3(overall, statuses, headers)
+
+
+class RateLimitServicerV2(rls_grpc.RateLimitServiceV2Servicer):
+    """Legacy endpoint (ratelimit_legacy.go:39-60)."""
+
+    def __init__(self, service: RateLimitService, stats_scope):
+        self._service = service
+        scope = stats_scope.scope("call.should_rate_limit_legacy")
+        self._req_conversion_error = scope.counter("req_conversion_error")
+        self._resp_conversion_error = scope.counter("resp_conversion_error")
+        self._should_rate_limit_error = scope.counter("should_rate_limit_error")
+
+    def ShouldRateLimit(self, request, context):  # noqa: N802
+        try:
+            internal = proto_adapter.request_from_v2(request)
+        except Exception as e:
+            self._req_conversion_error.add(1)
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        try:
+            overall, statuses, headers = self._service.should_rate_limit(internal)
+        except (CacheError, ServiceError) as e:
+            self._should_rate_limit_error.add(1)
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        try:
+            return proto_adapter.response_to_v2(overall, statuses, headers)
+        except Exception as e:
+            self._resp_conversion_error.add(1)
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))

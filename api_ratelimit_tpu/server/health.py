@@ -1,0 +1,58 @@
+"""Dual HTTP + gRPC health checking (src/server/health.go).
+
+One atomic ok flag backs both surfaces: HTTP /healthcheck answers 200 "OK" /
+500 (health.go:40-47), the standard grpc.health.v1.Health service answers
+SERVING / NOT_SERVING, and fail() flips both — called from the SIGTERM path
+so load balancers drain before shutdown (health.go:28-35).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..pb import health_pb2
+
+HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
+
+
+class HealthChecker:
+    def __init__(self, name: str = "ratelimit"):
+        self.name = name
+        self._ok = threading.Event()
+        self._ok.set()
+
+    def ok(self) -> bool:
+        return self._ok.is_set()
+
+    def fail(self) -> None:
+        """Flip to unhealthy (health.go:49-52). One-way, used for LB drain."""
+        self._ok.clear()
+
+    # -- gRPC surface --
+
+    def Check(self, request, context):  # noqa: N802 (proto casing)
+        status = (
+            health_pb2.HealthCheckResponse.SERVING
+            if self.ok()
+            else health_pb2.HealthCheckResponse.NOT_SERVING
+        )
+        return health_pb2.HealthCheckResponse(status=status)
+
+    def add_to_grpc_server(self, server: grpc.Server) -> None:
+        handlers = {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                self.Check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            )
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(HEALTH_SERVICE_NAME, handlers),)
+        )
+
+    # -- HTTP surface (handler contract used by http_server) --
+
+    def http_response(self) -> tuple[int, str]:
+        return (200, "OK") if self.ok() else (500, "")

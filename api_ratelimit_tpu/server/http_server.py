@@ -1,0 +1,208 @@
+"""HTTP listeners: main port (/json + /healthcheck) and the debug port.
+
+Main port mirrors src/server/server_impl.go:
+  - POST /json: jsonpb <-> proto translation of the v3 RPC with status
+    mapping OK->200, OVER_LIMIT->429, UNKNOWN/error->500, bad request->400
+    (server_impl.go:62-104).
+  - GET /healthcheck (server_impl.go:213).
+
+Debug port (DEBUG_PORT=6070) mirrors server_impl.go:217-250:
+  - GET /            endpoint index
+  - GET /stats       current stat values (expvar equivalent)
+  - GET /rlconfig    running config dump (runner.go:108-113)
+  - GET /debug/pprof/ profiling: thread stack dump (the Python analog of
+    goroutine profiles; CPU profiles come from py-spy/perf externally)
+
+Both are stdlib ThreadingHTTPServer instances with SO_REUSEPORT, matching
+the reference's go_reuseport listeners (server_impl.go:115,131,141).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from google.protobuf import json_format
+
+from ..limiter.cache import CacheError
+from ..pb import rls_v3
+from ..service.ratelimit import RateLimitService, ServiceError
+from . import proto_adapter
+from .health import HealthChecker
+
+logger = logging.getLogger("ratelimit.server.http")
+
+
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def server_bind(self):
+        if hasattr(socket, "SO_REUSEPORT"):
+            try:
+                self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            except OSError:
+                pass
+        socketserver.TCPServer.server_bind(self)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    routes_get: dict[str, Callable[["_Handler"], None]] = {}
+    routes_post: dict[str, Callable[["_Handler"], None]] = {}
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        logger.debug("http: " + format, *args)
+
+    def _write(self, status: int, body: bytes, content_type: str = "text/plain"):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        handler = self.routes_get.get(path)
+        if handler is None and path.startswith("/debug/pprof"):
+            handler = self.routes_get.get("/debug/pprof/")
+        if handler is None:
+            self._write(404, b"404 page not found\n")
+            return
+        handler(self)
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        handler = self.routes_post.get(path)
+        if handler is None:
+            self._write(404, b"404 page not found\n")
+            return
+        handler(self)
+
+
+def _make_handler_class(name: str) -> type[_Handler]:
+    return type(name, (_Handler,), {"routes_get": {}, "routes_post": {}})
+
+
+class HttpServer:
+    """One listener + its route table; serve() runs in the caller's thread,
+    serve_background() in a daemon thread."""
+
+    def __init__(self, host: str, port: int, name: str):
+        self._handler_cls = _make_handler_class(f"{name}Handler")
+        self._server = _ReusePortHTTPServer((host, port), self._handler_cls)
+        self._thread: threading.Thread | None = None
+        self.name = name
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def add_get(self, path: str, fn: Callable[[_Handler], None]) -> None:
+        self._handler_cls.routes_get[path] = fn
+
+    def add_post(self, path: str, fn: Callable[[_Handler], None]) -> None:
+        self._handler_cls.routes_post[path] = fn
+
+    def endpoints(self) -> list[str]:
+        return sorted(
+            set(self._handler_cls.routes_get) | set(self._handler_cls.routes_post)
+        )
+
+    def serve(self) -> None:
+        self._server.serve_forever(poll_interval=0.1)
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(
+            target=self.serve, name=f"http-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def add_json_handler(server: HttpServer, service: RateLimitService) -> None:
+    """POST /json — HTTP/JSON mirror of the v3 RPC (server_impl.go:62-104)."""
+
+    def handle(h: _Handler) -> None:
+        length = int(h.headers.get("Content-Length", 0))
+        body = h.rfile.read(length) if length else b""
+        if not body:
+            h._write(400, b"Bad Request: empty body\n")
+            return
+        try:
+            req = json_format.Parse(body, rls_v3.RateLimitRequest())
+        except json_format.ParseError as e:
+            h._write(400, f"Bad Request: {e}\n".encode())
+            return
+        try:
+            overall, statuses, headers = service.should_rate_limit(
+                proto_adapter.request_from_v3(req)
+            )
+            resp = proto_adapter.response_to_v3(overall, statuses, headers)
+        except (CacheError, ServiceError) as e:
+            h._write(500, f"Internal Server Error: {e}\n".encode())
+            return
+        out = json_format.MessageToJson(resp).encode()
+        code = resp.overall_code
+        if code == rls_v3.RateLimitResponse.OK:
+            status = 200
+        elif code == rls_v3.RateLimitResponse.OVER_LIMIT:
+            status = 429
+        else:
+            status = 500
+        h._write(status, out, content_type="application/json")
+
+    server.add_post("/json", handle)
+
+
+def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
+    def handle(h: _Handler) -> None:
+        status, body = health.http_response()
+        h._write(status, body.encode())
+
+    server.add_get("/healthcheck", handle)
+
+
+def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
+    """The debug-port suite (server_impl.go:217-250); /rlconfig is added by
+    the runner via Server.add_debug_endpoint (runner.go:108-113)."""
+    server = HttpServer(host, port, "debug")
+
+    def handle_stats(h: _Handler) -> None:
+        h._write(
+            200,
+            json.dumps(stats_store.debug_snapshot(), indent=2).encode(),
+            content_type="application/json",
+        )
+
+    def handle_pprof(h: _Handler) -> None:
+        frames = sys._current_frames()
+        out = []
+        for thread in threading.enumerate():
+            frame = frames.get(thread.ident)
+            out.append(f"--- thread {thread.name} (id {thread.ident}) ---")
+            if frame is not None:
+                out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        h._write(200, ("\n".join(out) + "\n").encode())
+
+    def handle_index(h: _Handler) -> None:
+        lines = ["/debug endpoints:"] + [f"  {e}" for e in server.endpoints()]
+        h._write(200, ("\n".join(lines) + "\n").encode())
+
+    server.add_get("/stats", handle_stats)
+    server.add_get("/debug/pprof/", handle_pprof)
+    server.add_get("/", handle_index)
+    return server

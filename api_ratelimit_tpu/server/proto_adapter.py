@@ -1,0 +1,114 @@
+"""Wire <-> internal model conversion.
+
+The service brain works on the lightweight dataclasses in models/; the
+transport edge converts real Envoy protobuf to/from them here. The v2 legacy
+path converts v2 proto -> internal request and internal result -> v2 proto
+directly (the reference adapts v2<->v3 proto in src/service/
+ratelimit_legacy.go:62-150; same field-for-field mapping, one fewer hop).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ..models.descriptors import Descriptor, Entry, LimitOverride, RateLimitRequest
+from ..models.response import Code, DescriptorStatus, HeaderValue
+from ..models.units import Unit
+from ..pb import core_v2, core_v3, rls_v2, rls_v3
+
+
+def request_from_v3(msg) -> RateLimitRequest:
+    """envoy.service.ratelimit.v3.RateLimitRequest -> internal request."""
+    descriptors = []
+    for d in msg.descriptors:
+        limit = None
+        if d.HasField("limit"):
+            limit = LimitOverride(
+                requests_per_unit=d.limit.requests_per_unit,
+                unit=Unit(d.limit.unit),
+            )
+        descriptors.append(
+            Descriptor(
+                entries=tuple(Entry(e.key, e.value) for e in d.entries),
+                limit=limit,
+            )
+        )
+    return RateLimitRequest(
+        domain=msg.domain,
+        descriptors=tuple(descriptors),
+        hits_addend=msg.hits_addend,
+    )
+
+
+def request_from_v2(msg) -> RateLimitRequest:
+    """Legacy request: identical shape minus the per-descriptor override
+    (ratelimit_legacy.go:62-92)."""
+    return RateLimitRequest(
+        domain=msg.domain,
+        descriptors=tuple(
+            Descriptor(entries=tuple(Entry(e.key, e.value) for e in d.entries))
+            for d in msg.descriptors
+        ),
+        hits_addend=msg.hits_addend,
+    )
+
+
+def _fill_response(
+    resp,
+    rl_cls,
+    header_cls,
+    overall: Code,
+    statuses: Sequence[DescriptorStatus],
+    headers: Iterable[HeaderValue],
+    header_field: str,
+):
+    resp.overall_code = int(overall)
+    for status in statuses:
+        out = resp.statuses.add()
+        out.code = int(status.code)
+        out.limit_remaining = status.limit_remaining
+        if status.current_limit is not None:
+            out.current_limit.requests_per_unit = status.current_limit.requests_per_unit
+            out.current_limit.unit = int(status.current_limit.unit)
+            if status.current_limit.name:
+                out.current_limit.name = status.current_limit.name
+        if status.duration_until_reset is not None:
+            out.duration_until_reset.seconds = status.duration_until_reset
+    field = getattr(resp, header_field)
+    for h in headers:
+        field.add(key=h.key, value=h.value)
+    return resp
+
+
+def response_to_v3(
+    overall: Code,
+    statuses: Sequence[DescriptorStatus],
+    headers: Iterable[HeaderValue] = (),
+):
+    return _fill_response(
+        rls_v3.RateLimitResponse(),
+        rls_v3.RateLimitResponse.RateLimit,
+        core_v3.HeaderValue,
+        overall,
+        statuses,
+        headers,
+        "response_headers_to_add",
+    )
+
+
+def response_to_v2(
+    overall: Code,
+    statuses: Sequence[DescriptorStatus],
+    headers: Iterable[HeaderValue] = (),
+):
+    """Legacy response; v2 carries the response headers in `headers`
+    (ratelimit_legacy.go:94-150)."""
+    return _fill_response(
+        rls_v2.RateLimitResponse(),
+        rls_v2.RateLimitResponse.RateLimit,
+        core_v2.HeaderValue,
+        overall,
+        statuses,
+        headers,
+        "headers",
+    )

@@ -1,0 +1,172 @@
+"""Transport server: three listeners + graceful shutdown.
+
+Python twin of src/server/server_impl.go — debug HTTP (:6070), gRPC (:8081),
+main HTTP (:8080), all SO_REUSEPORT; signal handling flips health to
+NOT_SERVING and gracefully stops gRPC before exiting (server_impl.go:255-269,
+health.go:28-35). start() blocks serving the main HTTP listener
+(server_impl.go:129-136); start_background() serves everything on daemon
+threads for in-process integration tests (the reference boots its real
+runner in-process the same way, test/integration/integration_test.go:251-274).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+from ..service.ratelimit import RateLimitService
+from .grpc_service import RateLimitServicerV2, RateLimitServicerV3
+from .health import HealthChecker
+from .http_server import (
+    HttpServer,
+    add_healthcheck,
+    add_json_handler,
+    new_debug_server,
+)
+from ..pb import rls_grpc
+
+logger = logging.getLogger("ratelimit.server")
+
+
+class Server:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        grpc_port: int,
+        debug_port: int,
+        stats_store,
+        grpc_max_workers: int = 32,
+    ):
+        self.health = HealthChecker()
+        self.stats_store = stats_store
+
+        self.grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=grpc_max_workers, thread_name_prefix="grpc"
+            ),
+            options=[("grpc.so_reuseport", 1)],
+        )
+        self._grpc_bound_port = self.grpc_server.add_insecure_port(
+            f"{host or '[::]'}:{grpc_port}"
+        )
+        self.health.add_to_grpc_server(self.grpc_server)
+
+        self.http = HttpServer(host, port, "main")
+        add_healthcheck(self.http, self.health)
+
+        self.debug = new_debug_server(host, debug_port, stats_store)
+
+        self._stopped = threading.Event()
+        self._signals_installed = False
+
+    # -- ports (bound values; 0 in the request means ephemeral — tests) --
+
+    @property
+    def grpc_port(self) -> int:
+        return self._grpc_bound_port
+
+    @property
+    def http_port(self) -> int:
+        return self.http.port
+
+    @property
+    def debug_port(self) -> int:
+        return self.debug.port
+
+    def add_debug_endpoint(self, path: str, fn: Callable[[], str]) -> None:
+        """AddDebugHttpEndpoint equivalent (src/server/server.go:20-24) —
+        the runner hangs /rlconfig here (runner.go:108-113)."""
+
+        def handle(h) -> None:
+            h._write(200, fn().encode())
+
+        self.debug.add_get(path, handle)
+
+    def register_service(self, service: RateLimitService, stats_scope) -> None:
+        """Register v3 + legacy v2 RLS and the /json route
+        (runner.go:115-121)."""
+        rls_grpc.add_v3_servicer(RateLimitServicerV3(service), self.grpc_server)
+        rls_grpc.add_v2_servicer(
+            RateLimitServicerV2(service, stats_scope), self.grpc_server
+        )
+        add_json_handler(self.http, service)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT/SIGHUP -> drain + stop (server_impl.go:255-269).
+        Main-thread only; background starts skip this."""
+
+        def on_signal(signum, frame):
+            logger.warning("got signal %s, shutting down", signum)
+            self.stop()
+
+        for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+            signal.signal(sig, on_signal)
+        self._signals_installed = True
+
+    def start_background(self) -> None:
+        """Serve all listeners on daemon threads (integration tests)."""
+        self.debug.serve_background()
+        self.grpc_server.start()
+        self.http.serve_background()
+        logger.info(
+            "listening: http=%d grpc=%d debug=%d",
+            self.http_port,
+            self.grpc_port,
+            self.debug_port,
+        )
+
+    def start(self) -> None:
+        """Serve; blocks until stop() (signal or explicit)."""
+        self.debug.serve_background()
+        self.grpc_server.start()
+        logger.info(
+            "listening: http=%d grpc=%d debug=%d",
+            self.http_port,
+            self.grpc_port,
+            self.debug_port,
+        )
+        try:
+            self.http.serve()  # blocking, like srv.ListenAndServe
+        finally:
+            self._shutdown()
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        # Drain order per the reference: NOT_SERVING first so LBs stop
+        # sending, then graceful gRPC stop, then HTTP. The teardown runs on
+        # its own thread because stop() may arrive via a signal handler
+        # executing inside http.serve_forever's thread, where a same-thread
+        # shutdown() would deadlock.
+        self.health.fail()
+
+        def teardown() -> None:
+            self.grpc_server.stop(grace=5.0)
+            self.http.shutdown()
+            self.debug.shutdown()
+
+        threading.Thread(target=teardown, name="server-stop", daemon=True).start()
+
+    def _shutdown(self) -> None:
+        if not self._stopped.is_set():
+            self.stop()
+
+    def wait_stopped(self, timeout: float | None = None) -> bool:
+        return self._stopped.wait(timeout)
+
+
+def new_server(settings, stats_store) -> Server:
+    return Server(
+        host="",
+        port=settings.port,
+        grpc_port=settings.grpc_port,
+        debug_port=settings.debug_port,
+        stats_store=stats_store,
+    )

@@ -88,10 +88,6 @@ class Settings:
     tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
     tpu_use_pallas: bool = True
 
-    _ENV: tuple[tuple[str, str, Callable], ...] = dataclasses.field(
-        default=(), repr=False
-    )
-
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("port", "PORT", int),

@@ -171,6 +171,22 @@ class Store(Scope):
         with self._reg_lock:
             self._generators.append(generator)
 
+    def debug_snapshot(self) -> dict[str, int]:
+        """Current counter/gauge values by full name — backs the debug-port
+        /stats endpoint (expvar dump in the reference, server_impl.go:227-234).
+        Runs the generators first so computed gauges are fresh."""
+        with self._reg_lock:
+            generators = list(self._generators)
+        for gen in generators:
+            try:
+                gen.generate_stats()
+            except Exception:
+                pass
+        with self._reg_lock:
+            out = {name: c.value() for name, c in self._counters.items()}
+            out.update({name: g.value() for name, g in self._gauges.items()})
+        return dict(sorted(out.items()))
+
     # -- flushing --
 
     def flush(self) -> None:

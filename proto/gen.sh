@@ -1,0 +1,22 @@
+#!/bin/sh
+# Regenerate the checked-in protobuf message modules under api_ratelimit_tpu/pb/.
+# Message code only — the gRPC service glue is hand-written in
+# api_ratelimit_tpu/pb/rls_grpc.py (no grpc_tools plugin in the image).
+set -e
+cd "$(dirname "$0")"
+OUT=../api_ratelimit_tpu/pb
+protoc -I. \
+  envoy/config/core/v3/base.proto \
+  envoy/extensions/common/ratelimit/v3/ratelimit.proto \
+  envoy/service/ratelimit/v3/rls.proto \
+  envoy/api/v2/core/base.proto \
+  envoy/api/v2/ratelimit/ratelimit.proto \
+  envoy/service/ratelimit/v2/rls.proto \
+  grpc/health/v1/health.proto \
+  --python_out="$OUT"
+# Package markers so the generated trees import cleanly when rooted at
+# api_ratelimit_tpu.pb. The health tree is generated into grpc_health_pb/ to
+# avoid shadowing the real `grpc` package.
+rm -rf "$OUT/grpc_health_pb"
+mv "$OUT/grpc" "$OUT/grpc_health_pb"
+find "$OUT/envoy" "$OUT/grpc_health_pb" -type d -exec sh -c 'touch "$1/__init__.py"' _ {} \;

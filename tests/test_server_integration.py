@@ -1,0 +1,304 @@
+"""End-to-end integration tests: the real Runner booted in-process, driven
+over real gRPC (v3 + legacy v2), HTTP /json, the health checker, the debug
+port, and hot reload — the reference's integration pattern
+(test/integration/integration_test.go:251-274: NewRunner + go runner.Run(),
+then drive over the wire).
+
+Ports are ephemeral (0) so parallel test runs can't collide — the reference
+burns distinct fixed ports per scenario for the same reason (:47-48).
+"""
+
+import json
+import os
+import time
+import urllib.request
+import urllib.error
+
+import grpc
+import pytest
+
+from api_ratelimit_tpu.pb import rls_grpc, rls_v3, rls_v2, health_pb2
+from api_ratelimit_tpu.runner import Runner
+from api_ratelimit_tpu.settings import Settings
+from api_ratelimit_tpu.stats.sinks import TestSink
+
+BASIC_CONFIG = """\
+domain: basic
+descriptors:
+  - key: key1
+    rate_limit:
+      unit: second
+      requests_per_unit: 50
+  - key: one_per_minute
+    rate_limit:
+      unit: minute
+      requests_per_unit: 1
+"""
+
+ANOTHER_CONFIG = """\
+domain: another
+descriptors:
+  - key: key2
+    rate_limit:
+      unit: minute
+      requests_per_unit: 20
+  - key: key3
+    rate_limit:
+      unit: hour
+      requests_per_unit: 10
+"""
+
+
+def make_runtime(tmp_path, watch_root=True):
+    """Reference layout: RUNTIME_ROOT/RUNTIME_SUBDIRECTORY/config/*.yaml
+    (test/integration/runtime/current/ratelimit/config)."""
+    config_dir = tmp_path / "current" / "ratelimit" / "config"
+    config_dir.mkdir(parents=True)
+    (config_dir / "basic.yaml").write_text(BASIC_CONFIG)
+    (config_dir / "another.yaml").write_text(ANOTHER_CONFIG)
+    return str(tmp_path / "current"), "ratelimit", config_dir
+
+
+@pytest.fixture
+def running_server(tmp_path):
+    runtime_path, subdir, config_dir = make_runtime(tmp_path)
+    settings = Settings(
+        port=0,
+        grpc_port=0,
+        debug_port=0,
+        use_statsd=False,
+        runtime_path=runtime_path,
+        runtime_subdirectory=subdir,
+        backend_type="memory",
+        local_cache_size_in_bytes=0,
+        expiration_jitter_max_seconds=0,
+        log_level="ERROR",
+    )
+    runner = Runner(settings, sink=TestSink())
+    runner.run_background()
+    assert runner.wait_ready(10.0)
+    yield runner, config_dir
+    runner.stop()
+
+
+def v3_request(domain, pairs_list, hits_addend=0):
+    req = rls_v3.RateLimitRequest(domain=domain, hits_addend=hits_addend)
+    for pairs in pairs_list:
+        d = req.descriptors.add()
+        for k, v in pairs:
+            d.entries.add(key=k, value=v)
+    return req
+
+
+def http_get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://localhost:{port}{path}") as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_grpc_v3_over_limit_sequence(running_server):
+    runner, _ = running_server
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV3Stub(ch)
+        # one_per_minute: first call OK, second OVER_LIMIT
+        # (integration_test.go over-limit sequences, :334-355)
+        r1 = stub.ShouldRateLimit(v3_request("basic", [[("one_per_minute", "foo")]]))
+        assert r1.overall_code == rls_v3.RateLimitResponse.OK
+        assert r1.statuses[0].current_limit.requests_per_unit == 1
+        assert r1.statuses[0].current_limit.unit == rls_v3.RateLimitResponse.RateLimit.MINUTE
+        assert r1.statuses[0].limit_remaining == 0
+        r2 = stub.ShouldRateLimit(v3_request("basic", [[("one_per_minute", "foo")]]))
+        assert r2.overall_code == rls_v3.RateLimitResponse.OVER_LIMIT
+        assert r2.statuses[0].limit_remaining == 0
+
+        # unmatched descriptor: OK with no current_limit
+        r3 = stub.ShouldRateLimit(v3_request("basic", [[("unmatched", "x")]]))
+        assert r3.overall_code == rls_v3.RateLimitResponse.OK
+        assert not r3.statuses[0].HasField("current_limit")
+
+        # multi-descriptor aggregation: one over -> overall OVER_LIMIT
+        r4 = stub.ShouldRateLimit(
+            v3_request("basic", [[("key1", "a")], [("one_per_minute", "foo")]])
+        )
+        assert r4.overall_code == rls_v3.RateLimitResponse.OVER_LIMIT
+        assert r4.statuses[0].code == rls_v3.RateLimitResponse.OK
+        assert r4.statuses[1].code == rls_v3.RateLimitResponse.OVER_LIMIT
+
+
+def test_grpc_v3_stats_counters(running_server):
+    runner, _ = running_server
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV3Stub(ch)
+        for _ in range(3):
+            stub.ShouldRateLimit(v3_request("another", [[("key2", "dude")]]))
+    snap = runner.stats_store.debug_snapshot()
+    # exact reference stat paths (README.md:392-427); stats attach to the
+    # configured rule's composite key (config_impl.go:64-71)
+    assert snap["ratelimit.service.rate_limit.another.key2.total_hits"] == 3
+    assert snap["ratelimit.service.rate_limit.another.key2.over_limit"] == 0
+    assert snap["ratelimit.service.config_load_success"] >= 1
+
+
+def test_grpc_v3_error_on_empty_domain(running_server):
+    runner, _ = running_server
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV3Stub(ch)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.ShouldRateLimit(v3_request("", [[("key1", "a")]]))
+        assert err.value.code() == grpc.StatusCode.UNKNOWN
+        assert "domain" in err.value.details()
+    snap = runner.stats_store.debug_snapshot()
+    assert snap["ratelimit.service.call.should_rate_limit.service_error"] == 1
+
+
+def test_grpc_v2_legacy(running_server):
+    """Legacy v2 end-to-end (integration_test.go:491-601)."""
+    runner, _ = running_server
+    req = rls_v2.RateLimitRequest(domain="basic")
+    d = req.descriptors.add()
+    d.entries.add(key="one_per_minute", value="legacy")
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV2Stub(ch)
+        r1 = stub.ShouldRateLimit(req)
+        assert r1.overall_code == rls_v2.RateLimitResponse.OK
+        assert r1.statuses[0].current_limit.requests_per_unit == 1
+        r2 = stub.ShouldRateLimit(req)
+        assert r2.overall_code == rls_v2.RateLimitResponse.OVER_LIMIT
+
+
+def test_http_json_status_mapping(running_server):
+    """200/429/400 mapping (server_impl.go:62-104)."""
+    runner, _ = running_server
+    port = runner.server.http_port
+    url = f"http://localhost:{port}/json"
+
+    def post(body):
+        req = urllib.request.Request(
+            url, data=body.encode(), headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    body = json.dumps(
+        {
+            "domain": "basic",
+            "descriptors": [{"entries": [{"key": "one_per_minute", "value": "json"}]}],
+        }
+    )
+    status, text = post(body)
+    assert status == 200
+    assert json.loads(text)["overallCode"] == "OK"
+
+    status, text = post(body)
+    assert status == 429
+    assert json.loads(text)["overallCode"] == "OVER_LIMIT"
+
+    assert post("")[0] == 400
+    assert post("{nonsense")[0] == 400
+
+
+def test_healthcheck_and_grpc_health(running_server):
+    runner, _ = running_server
+    status, text = http_get(runner.server.http_port, "/healthcheck")
+    assert (status, text) == (200, "OK")
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        check = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        resp = check(health_pb2.HealthCheckRequest())
+        assert resp.status == health_pb2.HealthCheckResponse.SERVING
+
+    # flip to unhealthy (the SIGTERM drain path, health.go:28-35)
+    runner.server.health.fail()
+    status, _ = http_get(runner.server.http_port, "/healthcheck")
+    assert status == 500
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        check = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        resp = check(health_pb2.HealthCheckRequest())
+        assert resp.status == health_pb2.HealthCheckResponse.NOT_SERVING
+
+
+def test_debug_endpoints(running_server):
+    runner, _ = running_server
+    port = runner.server.debug_port
+
+    status, text = http_get(port, "/")
+    assert status == 200
+    assert "/stats" in text and "/rlconfig" in text
+
+    status, text = http_get(port, "/stats")
+    assert status == 200
+    assert "config_load_success" in text
+
+    status, text = http_get(port, "/rlconfig")
+    assert status == 200
+    assert "basic" in text and "one_per_minute" in text
+
+    status, text = http_get(port, "/debug/pprof/")
+    assert status == 200
+    assert "thread" in text
+
+    assert http_get(port, "/nope")[0] == 404
+
+
+def test_hot_reload(running_server):
+    """Copy a new config into the watched dir; poll config_load_success and
+    verify the new domain works (integration_test.go:603-708)."""
+    runner, config_dir = running_server
+    before = runner.stats_store.debug_snapshot()[
+        "ratelimit.service.config_load_success"
+    ]
+    (config_dir / "reload.yaml").write_text(
+        "domain: reload\n"
+        "descriptors:\n"
+        "  - key: block\n"
+        "    rate_limit:\n"
+        "      unit: second\n"
+        "      requests_per_unit: 0\n"
+    )
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        snap = runner.stats_store.debug_snapshot()
+        if snap["ratelimit.service.config_load_success"] > before:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("config reload never observed")
+
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV3Stub(ch)
+        resp = stub.ShouldRateLimit(v3_request("reload", [[("block", "x")]]))
+        # requests_per_unit: 0 -> always over limit
+        assert resp.overall_code == rls_v3.RateLimitResponse.OVER_LIMIT
+
+
+def test_config_error_keeps_old_config(running_server):
+    """A bad reload bumps config_load_error and keeps serving the old rules
+    (ratelimit.go:81-92)."""
+    runner, config_dir = running_server
+    (config_dir / "broken.yaml").write_text("domain: basic\n")  # duplicate domain
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        snap = runner.stats_store.debug_snapshot()
+        if snap.get("ratelimit.service.config_load_error", 0) >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("config load error never observed")
+
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        stub = rls_grpc.RateLimitServiceV3Stub(ch)
+        resp = stub.ShouldRateLimit(v3_request("basic", [[("key1", "still")]]))
+        assert resp.overall_code == rls_v3.RateLimitResponse.OK
+        assert resp.statuses[0].current_limit.requests_per_unit == 50

@@ -231,3 +231,53 @@ class TestSlabDifferentialVsDict:
                     f"step {step} item {i} fp={fp:x} div={divider} now={now}"
                 )
                 assert int(res.after[i]) == count
+
+
+class TestCompactReadbackModes:
+    """slab_step_after / slab_step_decided — the production readback modes
+    (ops/slab.py compact-modes block)."""
+
+    def _packed(self, items, now, near_ratio=0.8):
+        # scalar row needs >= 2 columns; pad with inert all-zero items
+        b = max(len(items), 2)
+        packed = np.zeros((7, b), dtype=np.uint32)
+        for i, (fp, hits, limit, divider) in enumerate(items):
+            packed[0, i] = fp & 0xFFFFFFFF
+            packed[1, i] = fp >> 32
+            packed[2, i] = hits
+            packed[3, i] = limit
+            packed[4, i] = divider
+        packed[6, 0] = np.uint32(now)
+        packed[6, 1] = np.float32(near_ratio).view(np.uint32)
+        return jnp.asarray(packed)
+
+    def test_decided_mode_codes(self):
+        from api_ratelimit_tpu.ops.slab import slab_step_decided
+
+        state = make_slab(N_SLOTS)
+        # limit 2/second: hits 1,1,1 in one batch -> OK, OK, OVER
+        items = [(KEY_A, 1, 2, 1)] * 3 + [(KEY_B, 1, 100, 1)]
+        state, codes = slab_step_decided(state, self._packed(items, now=5_000))
+        assert codes.dtype == jnp.uint8
+        assert codes.tolist()[:4] == [1, 1, 2, 1]
+        # next batch: still over for A within the window
+        state, codes = slab_step_decided(state, self._packed(items[:1], now=5_000))
+        assert codes.tolist()[:1] == [2]
+
+    def test_after_mode_saturating_cast(self):
+        from api_ratelimit_tpu.ops.slab import slab_step_after
+
+        state = make_slab(N_SLOTS)
+        items = [(KEY_A, 300, 100, 1)]
+        state, after = slab_step_after(
+            state, self._packed(items, now=5_000), out_dtype=jnp.uint8
+        )
+        # 300 saturates the u8 cast; exactness holds because the caller only
+        # picks u8 when cap > limit + hits
+        assert after.dtype == jnp.uint8
+        assert after.tolist()[:1] == [255]
+        state, after = slab_step_after(
+            state, self._packed([(KEY_B, 3, 100, 1)], now=5_000), out_dtype=jnp.uint16
+        )
+        assert after.dtype == jnp.uint16
+        assert after.tolist()[:1] == [3]

@@ -1,0 +1,179 @@
+"""Unit tests for the transport edge: proto adapters (the conversion logic of
+src/service/ratelimit_legacy.go:62-150 and the v3 edge), the runtime loader's
+key convention + change detection, and the aux CLIs."""
+
+import os
+
+import pytest
+
+from api_ratelimit_tpu.models.descriptors import Descriptor, Entry, LimitOverride
+from api_ratelimit_tpu.models.response import Code, DescriptorStatus, HeaderValue, RateLimitValue
+from api_ratelimit_tpu.models.units import Unit
+from api_ratelimit_tpu.pb import common_ratelimit_v3, rls_v2, rls_v3
+from api_ratelimit_tpu.server import proto_adapter
+from api_ratelimit_tpu.server.runtime_loader import DirectoryRuntimeLoader, scan_directory
+
+
+class TestProtoAdapter:
+    def test_request_from_v3_full(self):
+        msg = rls_v3.RateLimitRequest(domain="d", hits_addend=7)
+        d0 = msg.descriptors.add()
+        d0.entries.add(key="k1", value="v1")
+        d0.entries.add(key="k2", value="v2")
+        d1 = msg.descriptors.add()
+        d1.entries.add(key="k3", value="v3")
+        d1.limit.requests_per_unit = 42
+        d1.limit.unit = common_ratelimit_v3.HOUR
+
+        req = proto_adapter.request_from_v3(msg)
+        assert req.domain == "d"
+        assert req.hits_addend == 7
+        assert req.descriptors[0] == Descriptor(
+            entries=(Entry("k1", "v1"), Entry("k2", "v2"))
+        )
+        assert req.descriptors[1].limit == LimitOverride(
+            requests_per_unit=42, unit=Unit.HOUR
+        )
+        # absent override stays None (HasField, not default-instance)
+        assert req.descriptors[0].limit is None
+
+    def test_request_from_v2(self):
+        msg = rls_v2.RateLimitRequest(domain="legacy", hits_addend=2)
+        d = msg.descriptors.add()
+        d.entries.add(key="k", value="v")
+        req = proto_adapter.request_from_v2(msg)
+        assert req.domain == "legacy"
+        assert req.descriptors[0].entries == (Entry("k", "v"),)
+        assert req.descriptors[0].limit is None
+
+    def _statuses(self):
+        return [
+            DescriptorStatus(
+                code=Code.OK,
+                current_limit=RateLimitValue(10, Unit.MINUTE),
+                limit_remaining=9,
+                duration_until_reset=30,
+            ),
+            DescriptorStatus(code=Code.OVER_LIMIT, limit_remaining=0),
+            DescriptorStatus(code=Code.OK),  # unmatched: no limit
+        ]
+
+    def test_response_to_v3(self):
+        resp = proto_adapter.response_to_v3(
+            Code.OVER_LIMIT,
+            self._statuses(),
+            [HeaderValue("x-ratelimit-throttle-ms", "250")],
+        )
+        assert resp.overall_code == rls_v3.RateLimitResponse.OVER_LIMIT
+        assert len(resp.statuses) == 3
+        s0 = resp.statuses[0]
+        assert s0.code == rls_v3.RateLimitResponse.OK
+        assert s0.current_limit.requests_per_unit == 10
+        assert s0.current_limit.unit == rls_v3.RateLimitResponse.RateLimit.MINUTE
+        assert s0.limit_remaining == 9
+        assert s0.duration_until_reset.seconds == 30
+        assert not resp.statuses[2].HasField("current_limit")
+        assert resp.response_headers_to_add[0].key == "x-ratelimit-throttle-ms"
+        assert resp.response_headers_to_add[0].value == "250"
+
+    def test_response_to_v2_headers_field(self):
+        """v2 carries response headers in `headers`
+        (ratelimit_legacy.go:94-150)."""
+        resp = proto_adapter.response_to_v2(
+            Code.OK, self._statuses(), [HeaderValue("h", "v")]
+        )
+        assert resp.overall_code == rls_v2.RateLimitResponse.OK
+        assert resp.headers[0].key == "h"
+
+    def test_v3_v2_wire_compatible(self):
+        """The v2 and v3 request messages are wire-identical — the reference
+        relies on this adapting legacy traffic."""
+        v3 = rls_v3.RateLimitRequest(domain="d", hits_addend=1)
+        v3.descriptors.add().entries.add(key="k", value="v")
+        v2 = rls_v2.RateLimitRequest.FromString(v3.SerializeToString())
+        assert v2.domain == "d"
+        assert v2.descriptors[0].entries[0].key == "k"
+
+
+class TestRuntimeLoader:
+    def _mkconfig(self, root, name, text="domain: d\n"):
+        config = root / "config"
+        config.mkdir(parents=True, exist_ok=True)
+        (config / name).write_text(text)
+
+    def test_key_convention(self, tmp_path):
+        """config/basic.yaml -> key `config.basic` (goruntime convention, so
+        the service's `config.` filter works, ratelimit.go:94-102)."""
+        self._mkconfig(tmp_path, "basic.yaml", "x")
+        entries, _sig = scan_directory(str(tmp_path))
+        assert entries == {"config.basic": "x"}
+
+    def test_refresh_detects_changes(self, tmp_path):
+        self._mkconfig(tmp_path, "a.yaml", "one")
+        loader = DirectoryRuntimeLoader(str(tmp_path))
+        fired = []
+        loader.add_update_callback(lambda: fired.append(1))
+        assert loader.refresh() is False  # unchanged
+
+        self._mkconfig(tmp_path, "b.yaml", "two")
+        assert loader.refresh() is True
+        assert fired == [1]
+        snap = loader.snapshot()
+        assert list(snap.keys()) == ["config.a", "config.b"]
+        assert snap.get("config.b") == "two"
+
+    def test_symlink_swap(self, tmp_path):
+        """Deploys swap a `current` symlink atomically; a re-walk through the
+        link must observe the new tree (RUNTIME_WATCH_ROOT deploys)."""
+        v1 = tmp_path / "v1"
+        v2 = tmp_path / "v2"
+        self._mkconfig(v1, "r.yaml", "old")
+        self._mkconfig(v2, "r.yaml", "new")
+        current = tmp_path / "current"
+        current.symlink_to(v1)
+        loader = DirectoryRuntimeLoader(str(current))
+        assert loader.snapshot().get("config.r") == "old"
+
+        tmp = tmp_path / "current.tmp"
+        tmp.symlink_to(v2)
+        os.replace(tmp, current)
+        assert loader.refresh() is True
+        assert loader.snapshot().get("config.r") == "new"
+
+    def test_ignore_dotfiles(self, tmp_path):
+        self._mkconfig(tmp_path, "a.yaml", "x")
+        self._mkconfig(tmp_path, ".hidden.yaml", "secret")
+        entries, _ = scan_directory(str(tmp_path), ignore_dotfiles=True)
+        assert list(entries) == ["config.a"]
+        entries, _ = scan_directory(str(tmp_path), ignore_dotfiles=False)
+        assert "config..hidden" in entries
+
+
+class TestConfigCheckCmd:
+    def test_valid_config(self, tmp_path, capsys):
+        from api_ratelimit_tpu.cmd.config_check_cmd import main
+
+        (tmp_path / "ok.yaml").write_text(
+            "domain: d\ndescriptors:\n  - key: k\n"
+        )
+        assert main(["-config_dir", str(tmp_path)]) == 0
+
+    def test_invalid_config_exits_nonzero(self, tmp_path, capsys):
+        from api_ratelimit_tpu.cmd.config_check_cmd import main
+
+        (tmp_path / "bad.yaml").write_text("domain: d\nunknown_field: 1\n")
+        assert main(["-config_dir", str(tmp_path)]) == 1
+        assert "error loading config" in capsys.readouterr().err
+
+
+class TestClientCmd:
+    def test_parse_descriptor(self):
+        from api_ratelimit_tpu.cmd.client_cmd import parse_descriptor
+
+        d = parse_descriptor("database=users,tier=gold")
+        assert d.entries[0].key == "database"
+        assert d.entries[0].value == "users"
+        assert d.entries[1].key == "tier"
+
+        with pytest.raises(ValueError):
+            parse_descriptor("noequals")
