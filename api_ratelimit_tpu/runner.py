@@ -166,7 +166,6 @@ class Runner:
         self.runtime = DirectoryRuntimeLoader(
             runtime_path=settings.runtime_path,
             runtime_subdirectory=settings.runtime_subdirectory,
-            watch_root=settings.runtime_watch_root,
             ignore_dotfiles=settings.runtime_ignoredotfiles,
         )
         self.service = RateLimitService(

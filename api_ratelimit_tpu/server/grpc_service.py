@@ -28,9 +28,9 @@ class RateLimitServicerV3(rls_grpc.RateLimitServiceV3Servicer):
         self._service = service
 
     def ShouldRateLimit(self, request, context):  # noqa: N802
-        internal = proto_adapter.request_from_v3(request)
-        logger.debug("handling v3 should_rate_limit for domain %s", internal.domain)
+        logger.debug("handling v3 should_rate_limit for domain %s", request.domain)
         try:
+            internal = proto_adapter.request_from_v3(request)
             overall, statuses, headers = self._service.should_rate_limit(internal)
         except (CacheError, ServiceError) as e:
             context.abort(grpc.StatusCode.UNKNOWN, str(e))

@@ -148,9 +148,8 @@ def add_json_handler(server: HttpServer, service: RateLimitService) -> None:
             h._write(400, f"Bad Request: {e}\n".encode())
             return
         try:
-            overall, statuses, headers = service.should_rate_limit(
-                proto_adapter.request_from_v3(req)
-            )
+            internal = proto_adapter.request_from_v3(req)
+            overall, statuses, headers = service.should_rate_limit(internal)
             resp = proto_adapter.response_to_v3(overall, statuses, headers)
         except (CacheError, ServiceError) as e:
             h._write(500, f"Internal Server Error: {e}\n".encode())

@@ -14,18 +14,26 @@ from typing import Iterable, Sequence
 from ..models.descriptors import Descriptor, Entry, LimitOverride, RateLimitRequest
 from ..models.response import Code, DescriptorStatus, HeaderValue
 from ..models.units import Unit
-from ..pb import core_v2, core_v3, rls_v2, rls_v3
+from ..pb import rls_v2, rls_v3
+from ..service.ratelimit import ServiceError
 
 
 def request_from_v3(msg) -> RateLimitRequest:
-    """envoy.service.ratelimit.v3.RateLimitRequest -> internal request."""
+    """envoy.service.ratelimit.v3.RateLimitRequest -> internal request.
+    Raises ServiceError on malformed fields (proto3 preserves out-of-range
+    enum ints) so the transports surface it like any request error."""
     descriptors = []
     for d in msg.descriptors:
         limit = None
         if d.HasField("limit"):
+            try:
+                unit = Unit(d.limit.unit)
+            except ValueError:
+                raise ServiceError(
+                    f"invalid limit override unit: {d.limit.unit}"
+                ) from None
             limit = LimitOverride(
-                requests_per_unit=d.limit.requests_per_unit,
-                unit=Unit(d.limit.unit),
+                requests_per_unit=d.limit.requests_per_unit, unit=unit
             )
         descriptors.append(
             Descriptor(
@@ -55,8 +63,6 @@ def request_from_v2(msg) -> RateLimitRequest:
 
 def _fill_response(
     resp,
-    rl_cls,
-    header_cls,
     overall: Code,
     statuses: Sequence[DescriptorStatus],
     headers: Iterable[HeaderValue],
@@ -87,8 +93,6 @@ def response_to_v3(
 ):
     return _fill_response(
         rls_v3.RateLimitResponse(),
-        rls_v3.RateLimitResponse.RateLimit,
-        core_v3.HeaderValue,
         overall,
         statuses,
         headers,
@@ -104,11 +108,5 @@ def response_to_v2(
     """Legacy response; v2 carries the response headers in `headers`
     (ratelimit_legacy.go:94-150)."""
     return _fill_response(
-        rls_v2.RateLimitResponse(),
-        rls_v2.RateLimitResponse.RateLimit,
-        core_v2.HeaderValue,
-        overall,
-        statuses,
-        headers,
-        "headers",
+        rls_v2.RateLimitResponse(), overall, statuses, headers, "headers"
     )

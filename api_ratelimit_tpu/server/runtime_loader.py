@@ -62,11 +62,30 @@ def _key_for(relpath: str) -> str:
     return base.replace(os.sep, ".")
 
 
+def scan_signature(root: str, ignore_dotfiles: bool = False) -> tuple:
+    """Stat-only walk (through symlinks): the change signature of
+    (relpath, mtime_ns, size) triples. Cheap enough to poll."""
+    sig = []
+    for dirpath, dirnames, filenames in os.walk(root, followlinks=True):
+        if ignore_dotfiles:
+            dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        dirnames.sort()
+        for fname in sorted(filenames):
+            if ignore_dotfiles and fname.startswith("."):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # racing a deploy swap; next scan settles
+            sig.append((os.path.relpath(path, root), st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
 def scan_directory(
     root: str, ignore_dotfiles: bool = False
 ) -> tuple[dict[str, str], tuple]:
-    """Walk root (through symlinks), returning {key: contents} plus a change
-    signature of (relpath, mtime_ns, size) triples."""
+    """Full walk: {key: contents} plus the change signature."""
     entries: dict[str, str] = {}
     sig = []
     for dirpath, dirnames, filenames in os.walk(root, followlinks=True):
@@ -95,13 +114,15 @@ class DirectoryRuntimeLoader:
         self,
         runtime_path: str,
         runtime_subdirectory: str = "",
-        watch_root: bool = True,
         ignore_dotfiles: bool = False,
         poll_interval_seconds: float = 0.25,
     ):
-        # Watching the root means keys keep the subdirectory-relative layout
-        # of a symlink-swap deploy; watching the app dir directly matches
-        # RUNTIME_WATCH_ROOT=false (server_impl.go:191-206).
+        # goruntime's RUNTIME_WATCH_ROOT flag only chooses which directory
+        # the inotify watcher observes (root, to catch symlink-swap deploys);
+        # keys are always relative to runtime_path/subdirectory. A polling
+        # re-walk resolves the symlink every scan, so both deploy styles are
+        # covered without a flag here — the service keeps its own copy of
+        # the flag for the `config.` key filter (ratelimit.go:94-102).
         self._dir = (
             os.path.join(runtime_path, runtime_subdirectory)
             if runtime_subdirectory
@@ -125,7 +146,14 @@ class DirectoryRuntimeLoader:
 
     def refresh(self) -> bool:
         """One scan; swap the snapshot and fire callbacks when changed.
-        Returns whether a change was seen (exposed for tests)."""
+        Returns whether a change was seen (exposed for tests). Contents are
+        only read when the stat signature differs."""
+        with self._lock:
+            unchanged = (
+                scan_signature(self._dir, self._ignore_dotfiles) == self._sig
+            )
+        if unchanged:
+            return False
         entries, sig = scan_directory(self._dir, self._ignore_dotfiles)
         with self._lock:
             if sig == self._sig:

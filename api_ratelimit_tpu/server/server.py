@@ -148,7 +148,9 @@ class Server:
         self.health.fail()
 
         def teardown() -> None:
-            self.grpc_server.stop(grace=5.0)
+            # stop() returns an event; wait it out so gRPC has actually
+            # drained before the HTTP listeners go away.
+            self.grpc_server.stop(grace=5.0).wait()
             self.http.shutdown()
             self.debug.shutdown()
 

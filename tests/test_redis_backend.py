@@ -1,0 +1,338 @@
+"""Redis backend tests against the in-process fake server — the twin of
+test/redis/driver_impl_test.go (miniredis scenarios: refused connection,
+auth, pipelines) and test/redis/fixed_cache_impl_test.go (exact wire
+commands, window math, per-second routing, local-cache short-circuit,
+jitter)."""
+
+import random
+import threading
+
+import pytest
+
+from api_ratelimit_tpu.backends.redis import RedisRateLimitCache
+from api_ratelimit_tpu.backends.redis_driver import (
+    RedisClient,
+    RedisClusterClient,
+    RedisError,
+    key_slot,
+)
+from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+from api_ratelimit_tpu.limiter.local_cache import LocalCache
+from api_ratelimit_tpu.models.config import RateLimit, new_rate_limit_stats
+from api_ratelimit_tpu.models.descriptors import Descriptor, RateLimitRequest
+from api_ratelimit_tpu.models.response import Code
+from api_ratelimit_tpu.models.units import Unit
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+@pytest.fixture
+def fake_redis():
+    server = FakeRedisServer()
+    yield server
+    server.close()
+
+
+def make_limit(scope, requests_per_unit, unit, key="k_v"):
+    return RateLimit(
+        full_key=key,
+        limit=__import__(
+            "api_ratelimit_tpu.models.response", fromlist=["RateLimitValue"]
+        ).RateLimitValue(requests_per_unit, unit),
+        stats=new_rate_limit_stats(scope, key),
+    )
+
+
+class TestDriver:
+    def test_connection_refused(self):
+        with pytest.raises(RedisError, match="dial failed"):
+            RedisClient("tcp", "127.0.0.1:1", pool_size=1)
+
+    def test_ping_on_startup_and_do_cmd(self, fake_redis):
+        client = RedisClient("tcp", fake_redis.addr, pool_size=2)
+        assert client.do_cmd("SET", "a", "1") == "OK"
+        assert client.do_cmd("INCRBY", "a", 4) == 5
+        client.close()
+
+    def test_auth_fail_and_pass(self):
+        server = FakeRedisServer(password="hunter2")
+        try:
+            with pytest.raises(RedisError, match="auth failed"):
+                RedisClient("tcp", server.addr, pool_size=1, auth="wrong")
+            client = RedisClient("tcp", server.addr, pool_size=1, auth="hunter2")
+            assert client.do_cmd("PING") == "PONG"
+            client.close()
+        finally:
+            server.close()
+
+    def test_no_auth_when_required(self):
+        server = FakeRedisServer(password="hunter2")
+        try:
+            with pytest.raises(RedisError, match="NOAUTH"):
+                RedisClient("tcp", server.addr, pool_size=1)
+        finally:
+            server.close()
+
+    def test_pipe_do_one_rtt(self, fake_redis):
+        client = RedisClient("tcp", fake_redis.addr, pool_size=1)
+        replies = client.pipe_do(
+            [("INCRBY", "x", 1), ("EXPIRE", "x", 60), ("INCRBY", "x", 2)]
+        )
+        assert replies == [1, 1, 3]
+        client.close()
+
+    def test_implicit_pipelining_coalesces(self, fake_redis):
+        """window/limit knobs enable cross-request coalescing
+        (driver_impl.go:84-90)."""
+        client = RedisClient(
+            "tcp",
+            fake_redis.addr,
+            pool_size=1,
+            pipeline_window_seconds=0.005,
+            pipeline_limit=64,
+        )
+        assert client.implicit_pipelining_enabled()
+        results = {}
+
+        def call(i):
+            results[i] = client.pipe_do([("INCRBY", f"key{i}", 1)])[0]
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(results[i] == 1 for i in range(8))
+        client.close()
+
+    def test_pipe_do_error_surfaces(self, fake_redis):
+        client = RedisClient("tcp", fake_redis.addr, pool_size=1)
+        with pytest.raises(RedisError, match="unknown command"):
+            client.pipe_do([("NOSUCH", "k")])
+        client.close()
+
+    def test_sentinel_resolution(self, fake_redis):
+        """Sentinel reports the fake as master; client transparently
+        connects to it (driver_impl.go:111-116)."""
+        sentinel = FakeRedisServer(
+            sentinel_master=("mymaster", "127.0.0.1", fake_redis.port)
+        )
+        try:
+            client = RedisClient(
+                "tcp",
+                f"mymaster,{sentinel.addr}",
+                pool_size=1,
+                redis_type="SENTINEL",
+            )
+            assert client.do_cmd("INCRBY", "s", 7) == 7
+            assert fake_redis.get_int("s") == 7
+            client.close()
+        finally:
+            sentinel.close()
+
+    def test_cluster_topology(self, fake_redis):
+        client = RedisClusterClient(fake_redis.addr, pool_size=1)
+        replies = client.pipe_do([("INCRBY", "ck", 3), ("EXPIRE", "ck", 60)])
+        assert replies == [3, 1]
+        client.close()
+
+    def test_key_slot_hash_tags(self):
+        assert key_slot("{user}.a") == key_slot("{user}.b")
+        assert 0 <= key_slot("anything") < 16384
+
+
+class TestRedisFixedCache:
+    def _setup(self, fake_redis, local_cache=None, jitter_max=0, per_second=None):
+        store = Store(TestSink())
+        scope = store.scope("ratelimit").scope("service").scope("rate_limit")
+        time_source = FakeTimeSource(now=1234)
+        base = BaseRateLimiter(
+            time_source=time_source,
+            jitter_rand=random.Random(0),
+            expiration_jitter_max_seconds=jitter_max,
+            local_cache=local_cache,
+            near_limit_ratio=0.8,
+        )
+        client = RedisClient("tcp", fake_redis.addr, pool_size=2)
+        cache = RedisRateLimitCache(client, base, per_second_client=per_second)
+        return cache, scope, time_source
+
+    def test_exact_wire_commands(self, fake_redis):
+        """INCRBY domain_key_value_1234 1 + EXPIRE ... 1 — the exact wire
+        assertion from fixed_cache_impl_test.go:59-64 (window snap of
+        now=1234 with SECOND unit -> suffix 1234, TTL = divider)."""
+        cache, scope, _ = self._setup(fake_redis)
+        limit = make_limit(scope, 10, Unit.SECOND, "key_value")
+        req = RateLimitRequest(
+            domain="domain", descriptors=(Descriptor.of(("key", "value")),)
+        )
+        resp = cache.do_limit(req, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].limit_remaining == 9
+        seen = [c for c in fake_redis.commands_seen if c[0] != b"PING"]
+        assert seen == [
+            [b"INCRBY", b"domain_key_value_1234", b"1"],
+            [b"EXPIRE", b"domain_key_value_1234", b"1"],
+        ]
+        assert fake_redis.get_int("domain_key_value_1234") == 1
+
+    def test_window_snap_minute(self, fake_redis):
+        cache, scope, _ = self._setup(fake_redis)
+        limit = make_limit(scope, 10, Unit.MINUTE, "key_value")
+        req = RateLimitRequest(
+            domain="domain", descriptors=(Descriptor.of(("key", "value")),)
+        )
+        cache.do_limit(req, [limit])
+        # 1234 // 60 * 60 = 1200; TTL = 60
+        assert fake_redis.get_int("domain_key_value_1200") == 1
+        assert 59 <= fake_redis.ttl("domain_key_value_1200") <= 60
+
+    def test_over_limit_and_stats(self, fake_redis):
+        cache, scope, _ = self._setup(fake_redis)
+        limit = make_limit(scope, 2, Unit.SECOND, "k_v")
+        req = RateLimitRequest(domain="d", descriptors=(Descriptor.of(("k", "v")),))
+        codes = [cache.do_limit(req, [limit]).descriptor_statuses[0].code for _ in range(4)]
+        assert codes == [Code.OK, Code.OK, Code.OVER_LIMIT, Code.OVER_LIMIT]
+        assert limit.stats.total_hits.value() == 4
+        assert limit.stats.over_limit.value() == 2
+
+    def test_hits_addend(self, fake_redis):
+        cache, scope, _ = self._setup(fake_redis)
+        limit = make_limit(scope, 10, Unit.SECOND, "k_v")
+        req = RateLimitRequest(
+            domain="d", descriptors=(Descriptor.of(("k", "v")),), hits_addend=5
+        )
+        resp = cache.do_limit(req, [limit])
+        assert resp.descriptor_statuses[0].limit_remaining == 5
+        resp = cache.do_limit(req, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].limit_remaining == 0
+
+    def test_nil_limit_skips_backend(self, fake_redis):
+        cache, scope, _ = self._setup(fake_redis)
+        req = RateLimitRequest(domain="d", descriptors=(Descriptor.of(("k", "v")),))
+        resp = cache.do_limit(req, [None])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert resp.descriptor_statuses[0].current_limit is None
+        assert [c for c in fake_redis.commands_seen if c[0] != b"PING"] == []
+
+    def test_local_cache_short_circuits_redis(self, fake_redis):
+        """Once a key is known over-limit, no redis commands are issued for
+        it (.Times(0) assertion, fixed_cache_impl_test.go:175-276)."""
+        time_source = FakeTimeSource(now=1234)
+        local = LocalCache(max_entries=100, time_source=time_source)
+        cache, scope, _ = self._setup(fake_redis, local_cache=local)
+        limit = make_limit(scope, 1, Unit.SECOND, "k_v")
+        req = RateLimitRequest(domain="d", descriptors=(Descriptor.of(("k", "v")),))
+        assert cache.do_limit(req, [limit]).descriptor_statuses[0].code == Code.OK
+        assert (
+            cache.do_limit(req, [limit]).descriptor_statuses[0].code
+            == Code.OVER_LIMIT
+        )
+        fake_redis.commands_seen.clear()
+        resp = cache.do_limit(req, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        assert fake_redis.commands_seen == []  # served from local cache
+        assert limit.stats.over_limit_with_local_cache.value() == 1
+
+    def test_jitter_extends_ttl(self, fake_redis):
+        """EXPIRE = divider + Int63n(jitter_max) with seeded rand
+        (fixed_cache_impl_test.go:451+)."""
+        cache, scope, _ = self._setup(fake_redis, jitter_max=300)
+        expected_jitter = random.Random(0).randrange(300)
+        limit = make_limit(scope, 10, Unit.SECOND, "k_v")
+        req = RateLimitRequest(domain="d", descriptors=(Descriptor.of(("k", "v")),))
+        cache.do_limit(req, [limit])
+        expire = [c for c in fake_redis.commands_seen if c[0] == b"EXPIRE"][0]
+        assert int(expire[2]) == 1 + expected_jitter
+
+    def test_per_second_pool_routing(self, fake_redis):
+        """SECOND-unit keys go to the per-second client; others to main
+        (fixed_cache_impl_test.go:26-29)."""
+        second_server = FakeRedisServer()
+        try:
+            per_second = RedisClient("tcp", second_server.addr, pool_size=1)
+            cache, scope, _ = self._setup(fake_redis, per_second=per_second)
+            limits = [
+                make_limit(scope, 10, Unit.SECOND, "sec"),
+                make_limit(scope, 10, Unit.MINUTE, "min"),
+            ]
+            req = RateLimitRequest(
+                domain="d",
+                descriptors=(
+                    Descriptor.of(("sec", "s")),
+                    Descriptor.of(("min", "m")),
+                ),
+            )
+            resp = cache.do_limit(req, limits)
+            assert [s.code for s in resp.descriptor_statuses] == [Code.OK, Code.OK]
+            assert second_server.get_int("d_sec_s_1234") == 1
+            assert fake_redis.get_int("d_min_m_1200") == 1
+            assert second_server.get_int("d_min_m_1200") is None
+            assert fake_redis.get_int("d_sec_s_1234") is None
+        finally:
+            second_server.close()
+
+    def test_redis_down_raises_cache_error(self, fake_redis):
+        cache, scope, _ = self._setup(fake_redis)
+        limit = make_limit(scope, 10, Unit.SECOND, "k_v")
+        req = RateLimitRequest(domain="d", descriptors=(Descriptor.of(("k", "v")),))
+        fake_redis.close()
+        with pytest.raises(RedisError):
+            cache.do_limit(req, [limit])
+
+
+class TestRedisVsMemoryOracle:
+    def test_differential_random_stream(self, fake_redis):
+        """The redis backend and the in-process memory oracle must agree
+        decision-for-decision on a random stream (SURVEY.md §4.4)."""
+        from api_ratelimit_tpu.backends.memory import MemoryRateLimitCache
+
+        rng = random.Random(42)
+        store = Store(TestSink())
+        scope_a = store.scope("a")
+        scope_b = store.scope("b")
+        time_source = FakeTimeSource(now=5000)
+
+        def base():
+            return BaseRateLimiter(
+                time_source=time_source,
+                jitter_rand=random.Random(0),
+                expiration_jitter_max_seconds=0,
+                local_cache=None,
+                near_limit_ratio=0.8,
+            )
+
+        redis_cache = RedisRateLimitCache(
+            RedisClient("tcp", fake_redis.addr, pool_size=2), base()
+        )
+        oracle = MemoryRateLimitCache(base())
+
+        limits_a = {
+            key: make_limit(scope_a, rpu, unit, key)
+            for key, rpu, unit in [
+                ("u1", 3, Unit.SECOND),
+                ("u2", 5, Unit.MINUTE),
+                ("u3", 2, Unit.HOUR),
+            ]
+        }
+        limits_b = {
+            key: make_limit(scope_b, limit.limit.requests_per_unit, limit.limit.unit, key)
+            for key, limit in limits_a.items()
+        }
+
+        for step in range(200):
+            if rng.random() < 0.2:
+                time_source.advance(rng.randrange(0, 3))
+            key = rng.choice(list(limits_a))
+            value = rng.choice(["x", "y"])
+            req = RateLimitRequest(
+                domain="diff", descriptors=(Descriptor.of((key, value)),)
+            )
+            got = redis_cache.do_limit(req, [limits_a[key]]).descriptor_statuses[0]
+            want = oracle.do_limit(req, [limits_b[key]]).descriptor_statuses[0]
+            assert (got.code, got.limit_remaining) == (
+                want.code,
+                want.limit_remaining,
+            ), f"divergence at step {step} key {key}"

@@ -302,3 +302,129 @@ def test_config_error_keeps_old_config(running_server):
         resp = stub.ShouldRateLimit(v3_request("basic", [[("key1", "still")]]))
         assert resp.overall_code == rls_v3.RateLimitResponse.OK
         assert resp.statuses[0].current_limit.requests_per_unit == 50
+
+
+class TestBackendMatrix:
+    """BACKEND_TYPE matrix through the full runner, reference-style
+    (integration_test.go:49-92 runs {redis, redis+persecond, memcache}
+    scenarios; here the live backends are the in-process fakes)."""
+
+    def _boot(self, tmp_path, **settings_kw):
+        runtime_path, subdir, config_dir = make_runtime(tmp_path)
+        settings = Settings(
+            port=0,
+            grpc_port=0,
+            debug_port=0,
+            use_statsd=False,
+            runtime_path=runtime_path,
+            runtime_subdirectory=subdir,
+            expiration_jitter_max_seconds=0,
+            log_level="ERROR",
+            **settings_kw,
+        )
+        runner = Runner(settings, sink=TestSink())
+        runner.run_background()
+        assert runner.wait_ready(10.0)
+        return runner
+
+    def _over_limit_sequence(self, runner):
+        with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+            stub = rls_grpc.RateLimitServiceV3Stub(ch)
+            req = v3_request("basic", [[("one_per_minute", "matrix")]])
+            codes = [stub.ShouldRateLimit(req).overall_code for _ in range(3)]
+        return codes
+
+    def test_redis_backend(self, tmp_path):
+        from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
+
+        server = FakeRedisServer()
+        try:
+            runner = self._boot(
+                tmp_path,
+                backend_type="redis",
+                redis_socket_type="tcp",
+                redis_url=server.addr,
+            )
+            OK = rls_v3.RateLimitResponse.OK
+            OVER = rls_v3.RateLimitResponse.OVER_LIMIT
+            assert self._over_limit_sequence(runner) == [OK, OVER, OVER]
+            assert any(c[0] == b"INCRBY" for c in server.commands_seen)
+            runner.stop()
+        finally:
+            server.close()
+
+    def test_redis_backend_with_per_second_pool(self, tmp_path):
+        from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
+
+        main = FakeRedisServer()
+        second = FakeRedisServer()
+        try:
+            runner = self._boot(
+                tmp_path,
+                backend_type="redis",
+                redis_socket_type="tcp",
+                redis_url=main.addr,
+                redis_per_second=True,
+                redis_per_second_socket_type="tcp",
+                redis_per_second_url=second.addr,
+            )
+            with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+                stub = rls_grpc.RateLimitServiceV3Stub(ch)
+                # key1 is unit=second -> per-second pool; one_per_minute -> main
+                stub.ShouldRateLimit(v3_request("basic", [[("key1", "a")]]))
+                stub.ShouldRateLimit(
+                    v3_request("basic", [[("one_per_minute", "b")]])
+                )
+            second_keys = [
+                c[1] for c in second.commands_seen if c[0] == b"INCRBY"
+            ]
+            main_keys = [c[1] for c in main.commands_seen if c[0] == b"INCRBY"]
+            assert any(b"key1" in k for k in second_keys)
+            assert any(b"one_per_minute" in k for k in main_keys)
+            assert not any(b"one_per_minute" in k for k in second_keys)
+            runner.stop()
+        finally:
+            main.close()
+            second.close()
+
+    def test_memcache_backend(self, tmp_path):
+        from api_ratelimit_tpu.testing.fake_memcache import FakeMemcacheServer
+
+        server = FakeMemcacheServer()
+        try:
+            runner = self._boot(
+                tmp_path,
+                backend_type="memcache",
+                memcache_host_port=server.addr,
+            )
+            with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+                stub = rls_grpc.RateLimitServiceV3Stub(ch)
+                req = v3_request("basic", [[("one_per_minute", "mc")]])
+                r1 = stub.ShouldRateLimit(req)
+                assert r1.overall_code == rls_v3.RateLimitResponse.OK
+                runner.service._cache.flush()  # join async increments
+                r2 = stub.ShouldRateLimit(req)
+                assert r2.overall_code == rls_v3.RateLimitResponse.OVER_LIMIT
+            runner.stop()
+        finally:
+            server.close()
+
+    def test_redis_down_surfaces_grpc_error_and_counter(self, tmp_path):
+        from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
+
+        server = FakeRedisServer()
+        runner = self._boot(
+            tmp_path,
+            backend_type="redis",
+            redis_socket_type="tcp",
+            redis_url=server.addr,
+        )
+        server.close()
+        with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+            stub = rls_grpc.RateLimitServiceV3Stub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.ShouldRateLimit(v3_request("basic", [[("key1", "a")]]))
+            assert err.value.code() == grpc.StatusCode.UNKNOWN
+        snap = runner.stats_store.debug_snapshot()
+        assert snap["ratelimit.service.call.should_rate_limit.redis_error"] == 1
+        runner.stop()

@@ -177,3 +177,18 @@ class TestClientCmd:
 
         with pytest.raises(ValueError):
             parse_descriptor("noequals")
+
+
+class TestInvalidOverrideUnit:
+    def test_v3_invalid_unit_raises_service_error(self):
+        """proto3 preserves out-of-range enum ints; a bad override unit must
+        surface as a request error, not an uncaught ValueError."""
+        from api_ratelimit_tpu.service.ratelimit import ServiceError
+
+        msg = rls_v3.RateLimitRequest(domain="d")
+        d = msg.descriptors.add()
+        d.entries.add(key="k", value="v")
+        d.limit.requests_per_unit = 5
+        d.limit.unit = 7  # not a valid RateLimitUnit
+        with pytest.raises(ServiceError, match="invalid limit override unit"):
+            proto_adapter.request_from_v3(msg)
