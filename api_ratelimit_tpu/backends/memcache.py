@@ -26,6 +26,7 @@ from ..models.config import RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import DescriptorStatus, DoLimitResponse
 from ..models.units import unit_to_divider
+from ..tracing import tag_do_limit_start
 
 MAX_KEY_LENGTH = 250
 
@@ -171,6 +172,8 @@ class MemcacheRateLimitCache:
     ) -> DoLimitResponse:
         hits_addend = max(1, request.hits_addend)
         cache_keys = self._base.generate_cache_keys(request, limits, hits_addend)
+
+        tag_do_limit_start("memcache", len(limits), len(cache_keys))
 
         n = len(request.descriptors)
         over_local = [False] * n

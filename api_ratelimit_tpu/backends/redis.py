@@ -19,6 +19,7 @@ from ..models.config import RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import DescriptorStatus, DoLimitResponse
 from ..models.units import unit_to_divider
+from ..tracing import tag_do_limit_start
 from .redis_driver import RedisClient, RedisClusterClient
 
 
@@ -40,6 +41,8 @@ class RedisRateLimitCache:
     ) -> DoLimitResponse:
         hits_addend = max(1, request.hits_addend)
         cache_keys = self._base.generate_cache_keys(request, limits, hits_addend)
+
+        span = tag_do_limit_start("redis", len(limits), len(cache_keys))
 
         n = len(request.descriptors)
         over_local = [False] * n
@@ -63,15 +66,19 @@ class RedisRateLimitCache:
             idx.append(i)
 
         results = [0] * n
-        for client, cmds, idx in (
-            (self._client, main_cmds, main_idx),
-            (self._per_second_client, second_cmds, second_idx),
+        if span is not None:
+            span.log_kv(event="lookup.start")
+        for name, client, cmds, idx in (
+            ("main", self._client, main_cmds, main_idx),
+            ("per_second", self._per_second_client, second_cmds, second_idx),
         ):
             if not cmds:
                 continue
             replies = client.pipe_do(cmds)
             for j, i in enumerate(idx):
                 results[i] = int(replies[2 * j])  # INCRBY reply; EXPIRE ignored
+            if span is not None:
+                span.log_kv(event="redis.lookup.done", client=name)
 
         response = DoLimitResponse()
         for i, cache_key in enumerate(cache_keys):

@@ -44,6 +44,7 @@ from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint64, split_fingerprints
 from ..ops.slab import make_slab, slab_step_after
+from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
 
 
@@ -161,6 +162,8 @@ class TpuRateLimitCache:
         hits_addend = max(1, request.hits_addend)
         cache_keys = self._base.generate_cache_keys(request, limits, hits_addend)
 
+        span = tag_do_limit_start("tpu", len(limits), len(cache_keys))
+
         n = len(request.descriptors)
         over_local = [False] * n
         results = [0] * n
@@ -189,8 +192,12 @@ class TpuRateLimitCache:
             )
             item_slots.append(i)
 
+        if span is not None:
+            span.log_kv(event="lookup.start", batch_items=len(items))
         for after, i in zip(self._batcher.submit(items), item_slots):
             results[i] = after
+        if span is not None:
+            span.log_kv(event="tpu.lookup.done", client="slab")
 
         response = DoLimitResponse()
         for i, cache_key in enumerate(cache_keys):

@@ -31,6 +31,7 @@ from .service.ratelimit import RateLimitService
 from .settings import Settings, new_settings
 from .stats.sinks import NullSink, StatsdSink
 from .stats.store import Store
+from .tracing import set_global_tracer, tracer_from_env
 from .utils.timeutil import RealTimeSource
 
 logger = logging.getLogger("ratelimit.runner")
@@ -131,6 +132,7 @@ class Runner:
         self.server: Server | None = None
         self.service: RateLimitService | None = None
         self.runtime: DirectoryRuntimeLoader | None = None
+        self.tracer = None
         self._ready = threading.Event()
 
     def get_stats_store(self) -> Store:
@@ -139,6 +141,12 @@ class Runner:
     def _build(self) -> None:
         settings = self.settings
         setup_logging(settings)
+
+        # Tracer from K_TRACING_* env, registered globally so the gRPC
+        # interceptor and /json middleware pick it up (runner.go:90-95);
+        # closed with a bounded flush in _teardown (runner.go:91).
+        self.tracer = tracer_from_env()
+        set_global_tracer(self.tracer)
 
         local_cache = None
         if settings.local_cache_size_in_bytes > 0:
@@ -214,3 +222,5 @@ class Runner:
         if self.runtime is not None:
             self.runtime.stop()
         self.stats_store.stop_flushing()
+        if self.tracer is not None:
+            self.tracer.close()

@@ -34,6 +34,7 @@ from google.protobuf import json_format
 from ..limiter.cache import CacheError
 from ..pb import rls_v3
 from ..service.ratelimit import RateLimitService, ServiceError
+from .. import tracing
 from . import proto_adapter
 from .health import HealthChecker
 
@@ -137,6 +138,13 @@ def add_json_handler(server: HttpServer, service: RateLimitService) -> None:
     """POST /json — HTTP/JSON mirror of the v3 RPC (server_impl.go:62-104)."""
 
     def handle(h: _Handler) -> None:
+        # HTTP middleware span honoring inbound B3 headers
+        # (src/tracing/lightstep.go:107-160); no-op when tracing is off.
+        with tracing.start_http_server_span("/json", h.headers) as span:
+            with tracing.activate(span):
+                _handle_json(h)
+
+    def _handle_json(h: _Handler) -> None:
         length = int(h.headers.get("Content-Length", 0))
         body = h.rfile.read(length) if length else b""
         if not body:
@@ -201,7 +209,15 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
         lines = ["/debug endpoints:"] + [f"  {e}" for e in server.endpoints()]
         h._write(200, ("\n".join(lines) + "\n").encode())
 
+    def handle_traces(h: _Handler) -> None:
+        h._write(
+            200,
+            tracing.global_tracer().dump_json().encode(),
+            content_type="application/json",
+        )
+
     server.add_get("/stats", handle_stats)
     server.add_get("/debug/pprof/", handle_pprof)
+    server.add_get("/debug/traces", handle_traces)
     server.add_get("/", handle_index)
     return server

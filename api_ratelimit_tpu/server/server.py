@@ -20,6 +20,7 @@ from typing import Callable
 import grpc
 
 from ..service.ratelimit import RateLimitService
+from ..tracing import OpenTracingServerInterceptor
 from .grpc_service import RateLimitServicerV2, RateLimitServicerV3
 from .health import HealthChecker
 from .http_server import (
@@ -46,11 +47,15 @@ class Server:
         self.health = HealthChecker()
         self.stats_store = stats_store
 
+        # Server spans enter via the tracing interceptor (runner.go:95); the
+        # interceptor resolves the global tracer per call, so it is a no-op
+        # until the runner registers one.
         self.grpc_server = grpc.server(
             futures.ThreadPoolExecutor(
                 max_workers=grpc_max_workers, thread_name_prefix="grpc"
             ),
             options=[("grpc.so_reuseport", 1)],
+            interceptors=[OpenTracingServerInterceptor()],
         )
         self._grpc_bound_port = self.grpc_server.add_insecure_port(
             f"{host or '[::]'}:{grpc_port}"

@@ -28,6 +28,7 @@ from ..limiter.cache import CacheError, RateLimitCache
 from ..models.config import ConfigError, RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import Code, DoLimitResponse, HeaderValue
+from ..tracing import active_span
 from ..utils.sampler import BurstSampler, RandomSampler, Sampler
 from ..utils.timeutil import TimeSource
 
@@ -139,14 +140,39 @@ class RateLimitService:
         CacheError / ServiceError after counting them."""
         try:
             return self._worker(request)
-        except CacheError:
+        except CacheError as e:
             self._stats.redis_error.add(1)
+            span = active_span()
+            if span is not None:
+                span.set_error(e)
             raise
-        except ServiceError:
+        except ServiceError as e:
             self._stats.service_error.add(1)
+            span = active_span()
+            if span is not None:
+                span.set_error(e)
             raise
 
     def _worker(
+        self, request: RateLimitRequest
+    ) -> tuple[Code, list, list[HeaderValue]]:
+        span = active_span()
+        if span is not None:
+            span.log_kv(event="shouldRateLimitWorker.start")
+        try:
+            result = self._worker_inner(request)
+        except BaseException:
+            if span is not None:
+                span.log_kv(event="shouldRateLimitWorker.done")
+            raise
+        if span is not None:
+            span.log_kv(
+                event="shouldRateLimitWorker.done",
+                response_code=int(result[0]),
+            )
+        return result
+
+    def _worker_inner(
         self, request: RateLimitRequest
     ) -> tuple[Code, list, list[HeaderValue]]:
         if request.domain == "":
@@ -195,20 +221,43 @@ class RateLimitService:
 
     def _maybe_sleep(self, do_limit_response: DoLimitResponse) -> None:
         """Server-side pacing: sleep the handler instead of answering
-        immediately, bounded by the sleeper semaphore (ratelimit.go:180-205)."""
-        sem = self._sleeper_semaphore
-        if sem is None:
-            return
-        if sem.acquire(blocking=False):
-            try:
-                logger.debug(
-                    "near limit, sleeping %d", do_limit_response.throttle_millis
-                )
-                self._time_source.sleep(do_limit_response.throttle_millis / 1000.0)
-            finally:
-                sem.release()
-            # throttled server-side by sleeping; don't also report it
-            do_limit_response.throttle_millis = 0
+        immediately, bounded by the sleeper semaphore (ratelimit.go:180-205).
+        Traced as a child span carrying the sleep duration, with an error tag
+        when the semaphore is exhausted (ratelimit.go:181-204)."""
+        # Like the reference, the span is created before the semaphore check,
+        # so a nil/None semaphore still emits an (empty) pacing span.
+        parent = active_span()
+        throttle_span = None
+        if parent is not None and parent.tracer is not None:
+            throttle_span = parent.tracer.start_span(
+                "sleep_on_throttle", child_of=parent
+            )
+            throttle_span.set_tag(
+                "throttling.sleep_ms", do_limit_response.throttle_millis
+            )
+        try:
+            sem = self._sleeper_semaphore
+            if sem is None:
+                return
+            if sem.acquire(blocking=False):
+                try:
+                    logger.debug(
+                        "near limit, sleeping %d",
+                        do_limit_response.throttle_millis,
+                    )
+                    self._time_source.sleep(
+                        do_limit_response.throttle_millis / 1000.0
+                    )
+                finally:
+                    sem.release()
+                # throttled server-side by sleeping; don't also report it
+                do_limit_response.throttle_millis = 0
+            elif throttle_span is not None:
+                throttle_span.log_kv(event="throttling.sem_exhausted")
+                throttle_span.set_tag("error", True)
+        finally:
+            if throttle_span is not None:
+                throttle_span.finish()
 
     def _detail_headers(
         self, do_limit_response: DoLimitResponse

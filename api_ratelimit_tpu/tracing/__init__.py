@@ -1,0 +1,50 @@
+"""Distributed tracing subsystem (reference: src/tracing/).
+
+See tracer.py for the design. Public surface:
+
+    tracer = tracer_from_env(version)      # Noop | Recording | Collector
+    set_global_tracer(tracer)
+    with tracer.start_span("op") as span, activate(span):
+        ...
+    span = active_span()                   # inside instrumented layers
+"""
+
+from .propagation import extract, inject
+from .tracer import (
+    CollectorTracer,
+    NoopTracer,
+    RecordingTracer,
+    Span,
+    SpanContext,
+    Tracer,
+    activate,
+    active_span,
+    global_tracer,
+    is_global_tracer_registered,
+    reset_global_tracer,
+    set_global_tracer,
+    tag_do_limit_start,
+    tracer_from_env,
+)
+from .middleware import OpenTracingServerInterceptor, start_http_server_span
+
+__all__ = [
+    "CollectorTracer",
+    "NoopTracer",
+    "OpenTracingServerInterceptor",
+    "RecordingTracer",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "activate",
+    "active_span",
+    "extract",
+    "global_tracer",
+    "inject",
+    "is_global_tracer_registered",
+    "reset_global_tracer",
+    "set_global_tracer",
+    "start_http_server_span",
+    "tag_do_limit_start",
+    "tracer_from_env",
+]

@@ -1,0 +1,488 @@
+"""In-process distributed tracing: the framework's OpenTracing/Lightstep
+analog (reference: src/tracing/lightstep.go, src/tracing/utils.go).
+
+The reference registers a Lightstep tracer as the opentracing global tracer
+with B3 propagation (lightstep.go:58-95) and hand-instruments the service
+worker, the cache DoLimit phases, and the sleep_on_throttle pacing
+(ratelimit.go:129-133,181-204; fixed_cache_impl.go:44-48,88-102). This module
+provides the same capability TPU-side-car style, with zero hot-path cost when
+disabled:
+
+  - `Span` / `SpanContext` — 128-bit trace ids, tags, timestamped key-value
+    logs, error marking, child-of relationships.
+  - `NoopTracer` — the disabled default (lightstep.go:59-62's empty struct);
+    every operation is a no-op on shared singletons.
+  - `RecordingTracer` — bounded in-process ring of finished spans, exported
+    as JSON on the debug port (/debug/traces), the hermetic stand-in for a
+    collector in tests and dev.
+  - `CollectorTracer` — ships finished spans as JSON lines over TCP to a
+    collector endpoint from a background flusher thread; `close()` honors the
+    reference's 1s shutdown timeout (lightstep.go:97-105).
+
+The active span travels via `contextvars` (the Python analog of the
+opentracing context/ScopeManager), so instrumented layers read
+`active_span()` instead of threading a ctx argument through every call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("ratelimit.tracing")
+
+# Env vars: accept the framework's own names and fall back to the reference's
+# Lightstep-specific ones (lightstep.go:22-29) so deploy configs carry over.
+TRACING_ENABLED_ENV = "K_TRACING_ENABLED"
+TRACING_HOST_ENV = "K_TRACING_HOST"
+TRACING_PORT_ENV = "K_TRACING_PORT"
+TRACING_TOKEN_ENV = "K_TRACING_TOKEN"
+LIGHTSTEP_ENABLED_ENV = "K_TRACING_LIGHTSTEP_ENABLED"
+LIGHTSTEP_HOST_ENV = "K_TRACING_LIGHTSTEP_HOST"
+LIGHTSTEP_PORT_ENV = "K_TRACING_LIGHTSTEP_PORT"
+LIGHTSTEP_TOKEN_ENV = "K_TRACING_LIGHTSTEP_TOKEN"
+
+COMPONENT_NAME = "apigw-ratelimit"
+
+
+def _getenv_fallback(key: str, fallback_key: str) -> str:
+    """tracing/utils.go:10-16."""
+    v = os.environ.get(key)
+    if v is None:
+        return os.environ.get(fallback_key, "")
+    return v
+
+
+def parse_bool_default(s: str, default: bool) -> bool:
+    """tracing/utils.go:65-71 semantics: empty -> default, bad -> raise."""
+    if s == "":
+        return default
+    low = s.strip().lower()
+    if low in ("1", "t", "true"):
+        return True
+    if low in ("0", "f", "false"):
+        return False
+    raise ValueError(f"invalid boolean: {s!r}")
+
+
+def parse_int_default(s: str, default: int) -> int:
+    """tracing/utils.go:42-55 semantics."""
+    if s == "":
+        return default
+    return int(s)
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Identity that crosses process boundaries (B3 headers)."""
+
+    trace_id: int  # 128-bit
+    span_id: int  # 64-bit
+    sampled: bool = True
+
+
+@dataclass
+class Span:
+    tracer: "Tracer"
+    operation_name: str
+    context: SpanContext
+    parent_id: int = 0
+    start_time: float = 0.0  # wall clock (epoch) for display
+    finish_time: float = 0.0
+    duration: float = 0.0  # monotonic-clock delta, immune to NTP steps
+    tags: dict = field(default_factory=dict)
+    logs: list = field(default_factory=list)  # [(timestamp, {k: v})]
+    _finished: bool = False
+    _mono_start: float = 0.0
+
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def set_error(self, err=None) -> "Span":
+        """ext.Error.Set + err log field (ratelimit.go:266-272)."""
+        self.tags["error"] = True
+        if err is not None:
+            self.log_kv(event="error", message=str(err))
+        return self
+
+    def log_kv(self, **fields) -> "Span":
+        self.logs.append((time.time(), fields))
+        return self
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self.finish_time = time.time()
+        self.duration = time.monotonic() - self._mono_start
+        self.tracer._on_finish(self)
+
+    # `with tracer.start_span(...) as span:` finishes the span and marks the
+    # error tag on an escaping exception, like defer-finish + recover marking.
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.set_error(exc)
+        self.finish()
+
+    def to_json(self) -> dict:
+        return {
+            "operation_name": self.operation_name,
+            "trace_id": f"{self.context.trace_id:032x}",
+            "span_id": f"{self.context.span_id:016x}",
+            "parent_id": f"{self.parent_id:016x}" if self.parent_id else "",
+            "start_us": int(self.start_time * 1e6),
+            "duration_us": int(self.duration * 1e6),
+            "tags": self.tags,
+            "logs": [
+                {"ts_us": int(ts * 1e6), "fields": fields}
+                for ts, fields in self.logs
+            ],
+        }
+
+
+_active_span: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "ratelimit_active_span", default=None
+)
+
+
+def active_span() -> "Span | None":
+    """opentracing.SpanFromContext equivalent (ratelimit.go:129)."""
+    return _active_span.get()
+
+
+@contextlib.contextmanager
+def activate(span: "Span"):
+    """Make `span` the active span for the dynamic extent of the block.
+    No-op spans are not activated, so `active_span() is not None` means
+    tracing is genuinely on — consistent across all transports."""
+    if span.tracer is None:  # the shared no-op span
+        yield span
+        return
+    token = _active_span.set(span)
+    try:
+        yield span
+    finally:
+        _active_span.reset(token)
+
+
+class Tracer:
+    """Base tracer: id generation + span lifecycle; subclasses consume
+    finished spans in `_on_finish`."""
+
+    def __init__(self):
+        # Thread-safe id generation without per-span lock contention:
+        # os.urandom is atomic and cheap at this call rate.
+        self._component = COMPONENT_NAME
+
+    def _new_ids(self) -> tuple[int, int]:
+        raw = os.urandom(24)
+        trace_id = int.from_bytes(raw[:16], "big") or 1
+        span_id = int.from_bytes(raw[16:], "big") or 1
+        return trace_id, span_id
+
+    def start_span(
+        self,
+        operation_name: str,
+        child_of: "Span | SpanContext | None" = None,
+        tags: dict | None = None,
+    ) -> Span:
+        parent_ctx = (
+            child_of.context if isinstance(child_of, Span) else child_of
+        )
+        trace_id, span_id = self._new_ids()
+        if parent_ctx is not None:
+            context = SpanContext(
+                trace_id=parent_ctx.trace_id,
+                span_id=span_id,
+                sampled=parent_ctx.sampled,
+            )
+            parent_id = parent_ctx.span_id
+        else:
+            context = SpanContext(trace_id=trace_id, span_id=span_id)
+            parent_id = 0
+        return Span(
+            tracer=self,
+            operation_name=operation_name,
+            context=context,
+            parent_id=parent_id,
+            start_time=time.time(),
+            tags=dict(tags) if tags else {},
+            _mono_start=time.monotonic(),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def _on_finish(self, span: Span) -> None:
+        raise NotImplementedError
+
+    def dump_json(self) -> str:
+        """Span dump for /debug/traces; tracers without a local buffer
+        report an empty set."""
+        return '{"spans": []}\n'
+
+    def close(self) -> None:
+        """Flush and shut down (lightstep.go:97-105)."""
+
+
+class _NoopSpan(Span):
+    """Shared do-nothing span: all mutators return self without touching
+    state, so a disabled tracer adds no allocation to the hot path."""
+
+    def __init__(self):
+        super().__init__(
+            tracer=None,
+            operation_name="",
+            context=SpanContext(trace_id=0, span_id=0, sampled=False),
+        )
+
+    def set_tag(self, key, value):
+        return self
+
+    def set_error(self, err=None):
+        return self
+
+    def log_kv(self, **fields):
+        return self
+
+    def finish(self):
+        pass
+
+    def __exit__(self, exc_type, exc, tb):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer(Tracer):
+    """Disabled tracing: the reference's empty LightstepTracer
+    (lightstep.go:59-62)."""
+
+    @property
+    def enabled(self) -> bool:
+        return False
+
+    def start_span(self, operation_name, child_of=None, tags=None) -> Span:
+        return _NOOP_SPAN
+
+    def _on_finish(self, span: Span) -> None:
+        pass
+
+
+class RecordingTracer(Tracer):
+    """Keeps the most recent finished spans in memory for inspection —
+    the test double and the /debug/traces source."""
+
+    def __init__(self, max_spans: int = 2048):
+        super().__init__()
+        self._max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+
+    def _on_finish(self, span: Span) -> None:
+        if not span.context.sampled:  # honor B3 sampled=0
+            return
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self._max_spans:
+                del self._spans[: len(self._spans) - self._max_spans]
+
+    def finished_spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"spans": [s.to_json() for s in self.finished_spans()]}, indent=2
+        )
+
+    def dump_json(self) -> str:
+        return self.to_json()
+
+
+class CollectorTracer(Tracer):
+    """Ships finished spans as JSON lines over TCP to a collector — the
+    satellite-export role Lightstep's tracer plays in the reference
+    (lightstep.go:64-77). Export failures drop spans and log once; tracing
+    must never take the service down."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        token: str = "",
+        version: str = "dev",
+        max_queue: int = 4096,
+        flush_interval: float = 1.0,
+    ):
+        super().__init__()
+        self._host = host
+        self._port = port
+        self._token = token
+        self._version = version
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._flush_interval = flush_interval
+        self._stop = threading.Event()
+        self._warned = False
+        self._conn: socket.socket | None = None  # persistent, flusher-owned
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="tracing-flush", daemon=True
+        )
+        self._thread.start()
+
+    def _on_finish(self, span: Span) -> None:
+        if not span.context.sampled:  # honor B3 sampled=0
+            return
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            pass  # drop under pressure, never block the request path
+
+    def _drain(self) -> list[Span]:
+        spans: list[Span] = []
+        while True:
+            try:
+                spans.append(self._queue.get_nowait())
+            except queue.Empty:
+                return spans
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self._flush_interval):
+            self._flush_once()
+        self._flush_once()  # final drain on shutdown
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _flush_once(self) -> None:
+        spans = self._drain()
+        if not spans:
+            return
+        payload = b"".join(
+            (
+                json.dumps(
+                    {
+                        "component": self._component,
+                        "service.version": self._version,
+                        "access_token": self._token,
+                        "span": s.to_json(),
+                    }
+                )
+                + "\n"
+            ).encode()
+            for s in spans
+        )
+        try:
+            if self._conn is None:
+                self._conn = socket.create_connection(
+                    (self._host, self._port), timeout=1.0
+                )
+            self._conn.sendall(payload)
+            self._warned = False  # re-arm warning after a good flush
+        except OSError as e:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+            if not self._warned:
+                self._warned = True
+                logger.warning(
+                    "trace export to %s:%d failed (%s); dropping spans",
+                    self._host,
+                    self._port,
+                    e,
+                )
+
+    def close(self, timeout: float = 1.0) -> None:
+        """Bounded shutdown flush (lightstep.go:97-105, runner.go:91)."""
+        self._stop.set()
+        self._thread.join(timeout)
+
+
+_global_tracer: Tracer = NoopTracer()
+_global_registered = False
+
+
+def set_global_tracer(tracer: Tracer) -> None:
+    """opentracing.SetGlobalTracer (lightstep.go:87)."""
+    global _global_tracer, _global_registered
+    _global_tracer = tracer
+    _global_registered = True
+
+
+def global_tracer() -> Tracer:
+    return _global_tracer
+
+
+def is_global_tracer_registered() -> bool:
+    """opentracing.IsGlobalTracerRegistered (lightstep.go:108)."""
+    return _global_registered
+
+
+def reset_global_tracer() -> None:
+    """Test hook: back to the unregistered no-op default."""
+    global _global_tracer, _global_registered
+    _global_tracer = NoopTracer()
+    _global_registered = False
+
+
+def tag_do_limit_start(
+    backend: str, limits_count: int, cache_keys_count: int
+) -> "Span | None":
+    """Shared DoLimit entry instrumentation for every cache backend: the
+    backend tag + DoLimit.start event (fixed_cache_impl.go:44-48). Returns
+    the active span (None when tracing is off) for further phase events."""
+    span = active_span()
+    if span is not None:
+        span.set_tag("backend", backend)
+        span.log_kv(
+            event="DoLimit.start",
+            limits_count=limits_count,
+            cache_keys_count=cache_keys_count,
+        )
+    return span
+
+
+def tracer_from_env(version: str = "dev") -> Tracer:
+    """Build the tracer the env asks for (GetLightstepConfigFromEnv,
+    lightstep.go:43-50): disabled -> NoopTracer; enabled with a collector
+    host -> CollectorTracer; enabled without one -> RecordingTracer (spans
+    stay inspectable on the debug port)."""
+    enabled = parse_bool_default(
+        _getenv_fallback(TRACING_ENABLED_ENV, LIGHTSTEP_ENABLED_ENV), False
+    )
+    if not enabled:
+        return NoopTracer()
+    host = _getenv_fallback(TRACING_HOST_ENV, LIGHTSTEP_HOST_ENV)
+    port = parse_int_default(
+        _getenv_fallback(TRACING_PORT_ENV, LIGHTSTEP_PORT_ENV), 0
+    )
+    token = _getenv_fallback(TRACING_TOKEN_ENV, LIGHTSTEP_TOKEN_ENV)
+    if host and port:
+        logger.info("tracing enabled, exporting to %s:%d", host, port)
+        return CollectorTracer(host, port, token=token, version=version)
+    logger.info("tracing enabled (in-process recording, no collector)")
+    return RecordingTracer()

@@ -428,3 +428,57 @@ class TestBackendMatrix:
         snap = runner.stats_store.debug_snapshot()
         assert snap["ratelimit.service.call.should_rate_limit.redis_error"] == 1
         runner.stop()
+
+
+def test_tracing_end_to_end(tmp_path, monkeypatch):
+    """B3 context from gRPC metadata -> server span in the recording tracer,
+    exposed on /debug/traces (runner.go:90-95 + interceptor wiring)."""
+    from api_ratelimit_tpu import tracing
+
+    monkeypatch.setenv("K_TRACING_ENABLED", "true")
+    runtime_path, subdir, _ = make_runtime(tmp_path)
+    settings = Settings(
+        port=0,
+        grpc_port=0,
+        debug_port=0,
+        use_statsd=False,
+        runtime_path=runtime_path,
+        runtime_subdirectory=subdir,
+        backend_type="memory",
+        expiration_jitter_max_seconds=0,
+        log_level="ERROR",
+    )
+    runner = Runner(settings, sink=TestSink())
+    runner.run_background()
+    assert runner.wait_ready(10.0)
+    try:
+        assert isinstance(runner.tracer, tracing.RecordingTracer)
+        trace_id = "0123456789abcdef0123456789abcdef"
+        with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+            stub = rls_grpc.RateLimitServiceV3Stub(ch)
+            stub.ShouldRateLimit(
+                v3_request("basic", [[("key1", "a")]]),
+                metadata=(
+                    ("x-b3-traceid", trace_id),
+                    ("x-b3-spanid", "00000000000000ab"),
+                ),
+            )
+        spans = runner.tracer.finished_spans()
+        rpc = [s for s in spans if "ShouldRateLimit" in s.operation_name]
+        assert rpc, f"no RPC span among {[s.operation_name for s in spans]}"
+        got = rpc[-1]
+        assert f"{got.context.trace_id:032x}" == trace_id
+        assert got.parent_id == 0xAB
+        assert got.tags.get("backend") == "memory"
+        events = [f.get("event") for _, f in got.logs]
+        assert "shouldRateLimitWorker.start" in events
+
+        status, body = http_get(runner.server.debug_port, "/debug/traces")
+        assert status == 200
+        dump = json.loads(body)
+        assert any(
+            "ShouldRateLimit" in s["operation_name"] for s in dump["spans"]
+        )
+    finally:
+        runner.stop()
+        tracing.reset_global_tracer()

@@ -1,0 +1,351 @@
+"""Tracing subsystem tests: span lifecycle, B3 propagation, env config,
+gRPC/HTTP server spans, and the service/backend instrumentation points
+(reference: src/tracing/, span usage in src/service/ratelimit.go and
+src/redis/fixed_cache_impl.go)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from api_ratelimit_tpu import tracing
+from api_ratelimit_tpu.tracing import (
+    CollectorTracer,
+    NoopTracer,
+    RecordingTracer,
+    SpanContext,
+    activate,
+    active_span,
+    extract,
+    inject,
+    reset_global_tracer,
+    set_global_tracer,
+    tracer_from_env,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tracer():
+    reset_global_tracer()
+    yield
+    reset_global_tracer()
+
+
+class TestSpanLifecycle:
+    def test_basic_span(self):
+        tracer = RecordingTracer()
+        span = tracer.start_span("op")
+        span.set_tag("backend", "tpu")
+        span.log_kv(event="DoLimit.start", limits_count=3)
+        time.sleep(0.01)
+        span.finish()
+        (got,) = tracer.finished_spans()
+        assert got.operation_name == "op"
+        assert got.tags == {"backend": "tpu"}
+        assert got.logs[0][1] == {"event": "DoLimit.start", "limits_count": 3}
+        assert got.finish_time >= got.start_time
+        # duration is the span's own elapsed time (monotonic), not a raw
+        # clock reading: ~10ms here, never minutes of machine uptime
+        assert 0.005 < got.duration < 5.0
+
+    def test_child_span_shares_trace_id(self):
+        tracer = RecordingTracer()
+        parent = tracer.start_span("parent")
+        child = tracer.start_span("child", child_of=parent)
+        assert child.context.trace_id == parent.context.trace_id
+        assert child.context.span_id != parent.context.span_id
+        assert child.parent_id == parent.context.span_id
+
+    def test_with_statement_finishes_and_marks_error(self):
+        tracer = RecordingTracer()
+        with pytest.raises(ValueError):
+            with tracer.start_span("boom"):
+                raise ValueError("nope")
+        (got,) = tracer.finished_spans()
+        assert got.tags["error"] is True
+        assert any(f.get("event") == "error" for _, f in got.logs)
+
+    def test_double_finish_records_once(self):
+        tracer = RecordingTracer()
+        span = tracer.start_span("op")
+        span.finish()
+        span.finish()
+        assert len(tracer.finished_spans()) == 1
+
+    def test_ring_bound(self):
+        tracer = RecordingTracer(max_spans=4)
+        for i in range(10):
+            tracer.start_span(f"op{i}").finish()
+        names = [s.operation_name for s in tracer.finished_spans()]
+        assert names == ["op6", "op7", "op8", "op9"]
+
+    def test_active_span_contextvar(self):
+        tracer = RecordingTracer()
+        assert active_span() is None
+        with tracer.start_span("op") as span, activate(span):
+            assert active_span() is span
+        assert active_span() is None
+
+    def test_unsampled_spans_not_recorded(self):
+        # B3 sampled=0 must suppress recording/export of the whole trace
+        tracer = RecordingTracer()
+        parent_ctx = SpanContext(trace_id=5, span_id=6, sampled=False)
+        with tracer.start_span("unsampled", child_of=parent_ctx):
+            pass
+        assert tracer.finished_spans() == []
+
+    def test_noop_span_not_activated(self):
+        # Disabled tracing must leave active_span() None on every transport
+        span = NoopTracer().start_span("op")
+        with activate(span):
+            assert active_span() is None
+
+    def test_noop_tracer_is_free(self):
+        tracer = NoopTracer()
+        span = tracer.start_span("op")
+        span2 = tracer.start_span("other")
+        assert span is span2  # shared singleton, no allocation
+        span.set_tag("k", "v").log_kv(event="e").set_error(ValueError())
+        span.finish()
+        assert span.tags == {}
+        assert span.logs == []
+
+
+class TestB3Propagation:
+    def test_roundtrip(self):
+        ctx = SpanContext(trace_id=0xABC123, span_id=0xDEF456, sampled=True)
+        carrier: dict[str, str] = {}
+        inject(ctx, carrier)
+        got = extract(carrier)
+        assert got == ctx
+
+    def test_extract_case_insensitive_and_64bit(self):
+        got = extract(
+            {"X-B3-TraceId": "00000000000000ab", "X-B3-SpanId": "00000000000000cd"}
+        )
+        assert got is not None
+        assert got.trace_id == 0xAB
+        assert got.span_id == 0xCD
+        assert got.sampled is True  # absent header defaults to sampled
+
+    def test_extract_sampled_zero(self):
+        carrier = {}
+        inject(SpanContext(trace_id=1, span_id=2, sampled=False), carrier)
+        assert extract(carrier).sampled is False
+
+    @pytest.mark.parametrize(
+        "carrier",
+        [
+            {},
+            {"x-b3-traceid": "zz", "x-b3-spanid": "0000000000000001"},
+            {"x-b3-traceid": "abc", "x-b3-spanid": "0000000000000001"},
+            {"x-b3-traceid": "0" * 32, "x-b3-spanid": "0" * 16},  # zero ids
+            {"x-b3-traceid": "0" * 32},  # missing span id
+        ],
+    )
+    def test_extract_invalid_returns_none(self, carrier):
+        assert extract(carrier) is None
+
+    def test_extract_from_tuples(self):
+        # gRPC invocation_metadata shape: iterable of (key, value)
+        meta = [("x-b3-traceid", "0" * 31 + "1"), ("x-b3-spanid", "0" * 15 + "2")]
+        got = extract(meta)
+        assert (got.trace_id, got.span_id) == (1, 2)
+
+
+class TestEnvConfig:
+    def test_disabled_by_default(self, monkeypatch):
+        for var in (
+            tracing.tracer.TRACING_ENABLED_ENV,
+            tracing.tracer.LIGHTSTEP_ENABLED_ENV,
+        ):
+            monkeypatch.delenv(var, raising=False)
+        assert isinstance(tracer_from_env(), NoopTracer)
+
+    def test_enabled_without_collector_records(self, monkeypatch):
+        monkeypatch.setenv(tracing.tracer.TRACING_ENABLED_ENV, "true")
+        monkeypatch.delenv(tracing.tracer.TRACING_HOST_ENV, raising=False)
+        monkeypatch.delenv(tracing.tracer.LIGHTSTEP_HOST_ENV, raising=False)
+        assert isinstance(tracer_from_env(), RecordingTracer)
+
+    def test_reference_lightstep_names_accepted(self, monkeypatch):
+        monkeypatch.delenv(tracing.tracer.TRACING_ENABLED_ENV, raising=False)
+        monkeypatch.setenv(tracing.tracer.LIGHTSTEP_ENABLED_ENV, "1")
+        assert isinstance(tracer_from_env(), RecordingTracer)
+
+    def test_bad_bool_raises(self, monkeypatch):
+        monkeypatch.setenv(tracing.tracer.TRACING_ENABLED_ENV, "banana")
+        with pytest.raises(ValueError):
+            tracer_from_env()
+
+    def test_enabled_with_collector(self, monkeypatch):
+        monkeypatch.setenv(tracing.tracer.TRACING_ENABLED_ENV, "true")
+        monkeypatch.setenv(tracing.tracer.TRACING_HOST_ENV, "localhost")
+        monkeypatch.setenv(tracing.tracer.TRACING_PORT_ENV, "9999")
+        tracer = tracer_from_env()
+        try:
+            assert isinstance(tracer, CollectorTracer)
+        finally:
+            tracer.close()
+
+
+class TestCollectorExport:
+    def test_spans_ship_as_json_lines(self):
+        received: list[bytes] = []
+        done = threading.Event()
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+
+        def accept():
+            conn, _ = listener.accept()
+            with conn:
+                while chunk := conn.recv(65536):
+                    received.append(chunk)
+            done.set()
+
+        threading.Thread(target=accept, daemon=True).start()
+        tracer = CollectorTracer(
+            "127.0.0.1", port, token="tok", flush_interval=0.05
+        )
+        with tracer.start_span("exported") as span:
+            span.set_tag("backend", "tpu")
+        tracer.close(timeout=2.0)
+        listener.close()
+        assert done.wait(2.0)
+        lines = b"".join(received).decode().strip().splitlines()
+        payload = json.loads(lines[0])
+        assert payload["span"]["operation_name"] == "exported"
+        assert payload["access_token"] == "tok"
+        assert payload["component"] == "apigw-ratelimit"
+
+    def test_unreachable_collector_drops_without_error(self):
+        tracer = CollectorTracer("127.0.0.1", 1, flush_interval=0.05)
+        tracer.start_span("dropped").finish()
+        time.sleep(0.2)
+        tracer.close(timeout=2.0)  # must not raise
+
+
+class TestServiceInstrumentation:
+    def _service(self, test_store, **kwargs):
+        from api_ratelimit_tpu.backends.memory import MemoryRateLimitCache
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+        from api_ratelimit_tpu.service.ratelimit import RateLimitService
+        from api_ratelimit_tpu.utils.timeutil import FakeTimeSource
+
+        store, _sink = test_store
+
+        class FakeRuntime:
+            def snapshot(self):
+                class Snap:
+                    def keys(self):
+                        return ["config.basic"]
+
+                    def get(self, key):
+                        return (
+                            "domain: basic\n"
+                            "descriptors:\n"
+                            "  - key: k1\n"
+                            "    rate_limit: {unit: second, requests_per_unit: 10}\n"
+                        )
+
+                return Snap()
+
+            def add_update_callback(self, cb):
+                pass
+
+        ts = FakeTimeSource(1234)
+        base = BaseRateLimiter(time_source=ts, jitter_rand=None)
+        return RateLimitService(
+            runtime=FakeRuntime(),
+            cache=MemoryRateLimitCache(base),
+            stats_scope=store.scope("ratelimit").scope("service"),
+            time_source=ts,
+            runtime_watch_root=True,
+            **kwargs,
+        )
+
+    def test_worker_logs_and_backend_tag(self, test_store):
+        from api_ratelimit_tpu.models.descriptors import (
+            Descriptor,
+            RateLimitRequest,
+        )
+
+        service = self._service(test_store)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        req = RateLimitRequest(
+            domain="basic", descriptors=(Descriptor.of(("k1", "v1")),)
+        )
+        with tracer.start_span("rpc") as span, activate(span):
+            service.should_rate_limit(req)
+        (got,) = tracer.finished_spans()
+        events = [f.get("event") for _, f in got.logs]
+        assert "shouldRateLimitWorker.start" in events
+        assert "shouldRateLimitWorker.done" in events
+        assert got.tags.get("backend") == "memory"
+        done = [
+            f for _, f in got.logs if f.get("event") == "shouldRateLimitWorker.done"
+        ]
+        assert done[0]["response_code"] == 1  # Code.OK
+
+    def test_error_marks_span(self, test_store):
+        from api_ratelimit_tpu.models.descriptors import RateLimitRequest
+        from api_ratelimit_tpu.service.ratelimit import ServiceError
+
+        service = self._service(test_store)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        req = RateLimitRequest(domain="", descriptors=[])
+        with pytest.raises(ServiceError):
+            with tracer.start_span("rpc") as span, activate(span):
+                service.should_rate_limit(req)
+        (got,) = tracer.finished_spans()
+        assert got.tags["error"] is True
+
+    def test_sleep_on_throttle_child_span(self, test_store):
+        from api_ratelimit_tpu.models.response import DoLimitResponse
+
+        service = self._service(test_store, max_sleeping_routines=2)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        resp = DoLimitResponse()
+        resp.throttle_millis = 250
+        with tracer.start_span("rpc") as span, activate(span):
+            service._maybe_sleep(resp)
+        throttle = [
+            s
+            for s in tracer.finished_spans()
+            if s.operation_name == "sleep_on_throttle"
+        ]
+        assert len(throttle) == 1
+        assert throttle[0].tags["throttling.sleep_ms"] == 250
+        assert throttle[0].parent_id == span.context.span_id
+        assert resp.throttle_millis == 0  # server-side throttled: reset
+
+    def test_sleep_semaphore_exhausted_tags_error(self, test_store):
+        from api_ratelimit_tpu.models.response import DoLimitResponse
+
+        service = self._service(test_store, max_sleeping_routines=1)
+        # exhaust the semaphore so acquire(blocking=False) fails
+        assert service._sleeper_semaphore.acquire(blocking=False)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        resp = DoLimitResponse()
+        resp.throttle_millis = 250
+        with tracer.start_span("rpc") as span, activate(span):
+            service._maybe_sleep(resp)
+        (throttle,) = [
+            s
+            for s in tracer.finished_spans()
+            if s.operation_name == "sleep_on_throttle"
+        ]
+        assert throttle.tags.get("error") is True
+        events = [f.get("event") for _, f in throttle.logs]
+        assert "throttling.sem_exhausted" in events
+        assert resp.throttle_millis == 250  # not throttled server-side
