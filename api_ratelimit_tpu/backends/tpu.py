@@ -42,7 +42,7 @@ from ..models.config import RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
-from ..ops.hashing import fingerprint64, split_fingerprints
+from ..ops.hashing import fingerprint_many, split_fingerprints
 from ..ops.slab import make_slab, slab_step_after
 from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
@@ -72,6 +72,11 @@ class TpuRateLimitCache:
         mesh=None,
     ):
         self._base = base_limiter
+        # Prewarm the native host codec so the first request never pays the
+        # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
+        from ..ops import native
+
+        native.available()
         if device is None:
             device = jax.devices()[0]
         self._device = device
@@ -168,29 +173,36 @@ class TpuRateLimitCache:
         over_local = [False] * n
         results = [0] * n
 
-        items: list[_Item] = []
-        item_slots: list[int] = []  # descriptor index per item
+        pending: list[tuple[int, int, int]] = []  # (desc idx, divider, jitter)
         for i, cache_key in enumerate(cache_keys):
             if cache_key.key == "":
                 continue
             if self._base.is_over_limit_with_local_cache(cache_key.key):
                 over_local[i] = True
                 continue
-            limit = limits[i]
-            divider = unit_to_divider(limit.unit)
+            divider = unit_to_divider(limits[i].unit)
             jitter = self._base.expiration_seconds(divider) - divider
-            items.append(
-                _Item(
-                    fp=fingerprint64(
-                        request.domain, request.descriptors[i].entries, divider
-                    ),
-                    hits=hits_addend,
-                    limit=limit.requests_per_unit,
-                    divider=divider,
-                    jitter=jitter,
-                )
+            pending.append((i, divider, jitter))
+
+        # one batched fingerprint pass (native codec when available)
+        fps = fingerprint_many(
+            [
+                (request.domain, request.descriptors[i].entries)
+                for i, _, _ in pending
+            ],
+            [divider for _, divider, _ in pending],
+        )
+        items = [
+            _Item(
+                fp=int(fp),
+                hits=hits_addend,
+                limit=limits[i].requests_per_unit,
+                divider=divider,
+                jitter=jitter,
             )
-            item_slots.append(i)
+            for fp, (i, divider, jitter) in zip(fps, pending)
+        ]
+        item_slots = [i for i, _, _ in pending]  # descriptor index per item
 
         if span is not None:
             span.log_kv(event="lookup.start", batch_items=len(items))

@@ -43,6 +43,27 @@ def fingerprint64(domain: str, entries, divider: int) -> int:
     return h.intdigest()
 
 
+def fingerprint_many(records, dividers) -> np.ndarray:
+    """Batch fingerprinting: `records` is a sequence of (domain, entries)
+    and `dividers` the per-record window divider (= hash seed). Uses the
+    native codec (ops/native.py) when it is available and the batch is big
+    enough to amortize the FFI call; falls back to the per-record Python
+    path with identical output."""
+    from . import native
+
+    if len(records) >= 4 and native.available():
+        return native.fingerprint_batch(
+            [native.record_strings(d, e) for d, e in records], dividers
+        )
+    return np.array(
+        [
+            fingerprint64(d, e, int(s))
+            for (d, e), s in zip(records, dividers)
+        ],
+        dtype=np.uint64,
+    )
+
+
 def split_fingerprints(fps: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Vectorized split of uint64 fingerprints into (lo, hi) uint32 arrays."""
     fps = np.asarray(fps, dtype=np.uint64)

@@ -1,0 +1,210 @@
+"""ctypes bindings for the native host codec (native/host_codec.cpp).
+
+The native library accelerates the host-side per-descriptor work in front of
+the device batch: descriptor fingerprinting and cache-key composition. One
+FFI call covers a whole batch (flattened string blob + offset arrays), so
+the per-call overhead amortizes the way the reference's pipelining amortizes
+Redis RTTs (src/redis/driver_impl.go:153-164).
+
+Loading is best-effort with a pure-Python fallback: `lib()` returns None
+when the shared object is absent and cannot be built, and every caller in
+the package degrades to the Python implementation (ops/hashing.py,
+limiter/cache_key.py). `ensure_built()` compiles it on demand with g++ —
+no pip, no pybind11, just the baked-in toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("ratelimit.native")
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "host_codec.cpp",
+)
+_OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native"
+)
+_SO_PATH = os.environ.get(
+    "RL_NATIVE_LIB", os.path.join(_OUT_DIR, "libratelimit_host.so")
+)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.rl_xxh64.restype = ctypes.c_uint64
+    lib.rl_xxh64.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rl_fingerprint_batch.restype = None
+    lib.rl_fingerprint_batch.argtypes = [
+        u8p, u64p, u64p, u64p, ctypes.c_uint64, u8p, u64p,
+    ]
+    lib.rl_compose_keys.restype = ctypes.c_int64
+    lib.rl_compose_keys.argtypes = [
+        u8p, u64p, u64p, i64p, ctypes.c_uint64, u8p, ctypes.c_uint64, u64p,
+    ]
+    return lib
+
+
+def ensure_built() -> bool:
+    """Compile the shared object if it is missing. Safe to call repeatedly."""
+    if os.path.exists(_SO_PATH):
+        return True
+    if not os.path.exists(_SRC):
+        return False
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO_PATH, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native codec build failed (%s); using Python path", e)
+        return False
+    logger.info("built native host codec: %s", _SO_PATH)
+    return True
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded library, building it on first use; None => Python path."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not ensure_built():
+            _load_failed = True
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO_PATH))
+        except OSError as e:
+            logger.warning("native codec load failed (%s); using Python path", e)
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _as_u64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """One-shot native hash (parity primitive; tests compare vs xxhash)."""
+    native = lib()
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(0, np.uint8)
+    return int(native.rl_xxh64(_as_u8p(buf), len(data), seed))
+
+
+class _Flattened:
+    """Records flattened to the C layout: one UTF-8 blob + string/record
+    offset arrays. A record is (domain, k1, v1, k2, v2, ...)."""
+
+    __slots__ = ("blob", "str_off", "rec_off", "max_record_bytes")
+
+    def __init__(self, records):
+        chunks: list[bytes] = []
+        str_off = [0]
+        rec_off = [0]
+        total = 0
+        max_rec = 0
+        for strings in records:
+            rec_bytes = 0
+            n_strings = 0
+            for s in strings:
+                b = s.encode()
+                chunks.append(b)
+                total += len(b)
+                str_off.append(total)
+                rec_bytes += len(b)
+                n_strings += 1
+            rec_off.append(rec_off[-1] + n_strings)
+            max_rec = max(max_rec, rec_bytes + 4 * n_strings)
+        self.blob = np.frombuffer(
+            b"".join(chunks) or b"\0", dtype=np.uint8
+        ).copy()
+        self.str_off = np.asarray(str_off, dtype=np.uint64)
+        self.rec_off = np.asarray(rec_off, dtype=np.uint64)
+        self.max_record_bytes = max_rec
+
+
+def record_strings(domain: str, entries) -> list[str]:
+    """The flattened string sequence for one descriptor record."""
+    out = [domain]
+    for entry in entries:
+        out.append(entry.key)
+        out.append(entry.value)
+    return out
+
+
+def fingerprint_batch(records, seeds) -> np.ndarray:
+    """records: sequence of string sequences (from `record_strings`);
+    seeds: per-record hash seed (the window divider). Returns uint64[n]."""
+    native = lib()
+    flat = _Flattened(records)
+    n = len(flat.rec_off) - 1
+    seeds_arr = np.asarray(seeds, dtype=np.uint64)
+    out = np.empty(n, dtype=np.uint64)
+    scratch = np.empty(max(1, flat.max_record_bytes), dtype=np.uint8)
+    native.rl_fingerprint_batch(
+        _as_u8p(flat.blob),
+        _as_u64p(flat.str_off),
+        _as_u64p(flat.rec_off),
+        _as_u64p(seeds_arr),
+        n,
+        _as_u8p(scratch),
+        _as_u64p(out),
+    )
+    return out
+
+
+def compose_keys_batch(records, window_starts) -> list[str]:
+    """Batched cache-key composition: "<domain>_<k>_<v>_..._<window>"
+    (src/limiter/cache_key.go:43-73). Returns the decoded key strings."""
+    native = lib()
+    flat = _Flattened(records)
+    n = len(flat.rec_off) - 1
+    windows = np.asarray(window_starts, dtype=np.int64)
+    out_off = np.empty(n + 1, dtype=np.uint64)
+    cap = int(flat.blob.size + flat.str_off.size * 1 + n * 24 + 64)
+    while True:
+        out = np.empty(cap, dtype=np.uint8)
+        written = native.rl_compose_keys(
+            _as_u8p(flat.blob),
+            _as_u64p(flat.str_off),
+            _as_u64p(flat.rec_off),
+            windows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            _as_u8p(out),
+            cap,
+            _as_u64p(out_off),
+        )
+        if written >= 0:
+            break
+        cap *= 2
+    raw = out[:written].tobytes()
+    return [
+        raw[int(out_off[i]) : int(out_off[i + 1])].decode()
+        for i in range(n)
+    ]

@@ -26,7 +26,14 @@ from .tracer import (
     tag_do_limit_start,
     tracer_from_env,
 )
-from .middleware import OpenTracingServerInterceptor, start_http_server_span
+def __getattr__(name):
+    # middleware pulls in grpc; load it lazily so backends that import
+    # tracing for tag_do_limit_start don't transitively require grpcio.
+    if name in ("OpenTracingServerInterceptor", "start_http_server_span"):
+        from . import middleware
+
+        return getattr(middleware, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "CollectorTracer",

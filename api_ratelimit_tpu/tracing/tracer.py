@@ -54,9 +54,10 @@ COMPONENT_NAME = "apigw-ratelimit"
 
 
 def _getenv_fallback(key: str, fallback_key: str) -> str:
-    """tracing/utils.go:10-16."""
-    v = os.environ.get(key)
-    if v is None:
+    """tracing/utils.go:10-16. Go's os.Getenv cannot distinguish unset from
+    empty, so the reference falls back on empty too — match that."""
+    v = os.environ.get(key, "")
+    if v == "":
         return os.environ.get(fallback_key, "")
     return v
 

@@ -1,0 +1,195 @@
+// Native host-path codec for the TPU rate-limit framework.
+//
+// The reference delegates its performance-critical native work to Redis's
+// C execution engine over TCP (SURVEY.md §2.6); the TPU build replaces that
+// with an in-process Pallas device program, and THIS library occupies the
+// host-side native slot: the per-descriptor work that runs before a batch
+// ships to the device — 64-bit descriptor fingerprinting (the slab's key
+// identity, api_ratelimit_tpu/ops/hashing.py) and fixed-window cache-key
+// composition (src/limiter/cache_key.go:43-73 semantics).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+// All batch entry points take pre-flattened buffers + offset arrays so one
+// library call amortizes the FFI cost across a whole micro-batch.
+//
+// The hash is XXH64, implemented from the public specification
+// (github.com/Cyan4973/xxHash doc/xxhash_spec.md) so fingerprints match the
+// Python xxhash package bit-for-bit — the slab must resolve identical slots
+// whether the host path is native or pure Python.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t P1 = 0x9E3779B185EBCA87ULL;
+constexpr uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t P3 = 0x165667B19E3779F9ULL;
+constexpr uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+constexpr uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, sizeof v);  // little-endian hosts only (x86/ARM/TPU VM)
+  return v;
+}
+
+inline uint64_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, sizeof v);
+  return v;
+}
+
+inline uint64_t round64(uint64_t acc, uint64_t lane) {
+  return rotl64(acc + lane * P2, 31) * P1;
+}
+
+inline uint64_t merge_round(uint64_t acc, uint64_t lane) {
+  acc ^= round64(0, lane);
+  return acc * P1 + P4;
+}
+
+uint64_t xxh64(const uint8_t* data, uint64_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* const end = data + len;
+  uint64_t acc;
+
+  if (len >= 32) {
+    uint64_t a1 = seed + P1 + P2;
+    uint64_t a2 = seed + P2;
+    uint64_t a3 = seed;
+    uint64_t a4 = seed - P1;
+    const uint8_t* const limit = end - 32;
+    do {
+      a1 = round64(a1, read64(p));
+      a2 = round64(a2, read64(p + 8));
+      a3 = round64(a3, read64(p + 16));
+      a4 = round64(a4, read64(p + 24));
+      p += 32;
+    } while (p <= limit);
+    acc = rotl64(a1, 1) + rotl64(a2, 7) + rotl64(a3, 12) + rotl64(a4, 18);
+    acc = merge_round(acc, a1);
+    acc = merge_round(acc, a2);
+    acc = merge_round(acc, a3);
+    acc = merge_round(acc, a4);
+  } else {
+    acc = seed + P5;
+  }
+
+  acc += len;
+
+  while (p + 8 <= end) {
+    acc ^= round64(0, read64(p));
+    acc = rotl64(acc, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    acc ^= read32(p) * P1;
+    acc = rotl64(acc, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    acc ^= (*p) * P5;
+    acc = rotl64(acc, 11) * P1;
+    ++p;
+  }
+
+  acc ^= acc >> 33;
+  acc *= P2;
+  acc ^= acc >> 29;
+  acc *= P3;
+  acc ^= acc >> 32;
+  return acc;
+}
+
+// Field serialization identical to ops/hashing.py fingerprint64: each field
+// is a 4-byte little-endian length prefix followed by the raw bytes, so
+// request-controlled strings cannot alias across field boundaries.
+inline void hash_field(uint8_t* scratch, uint64_t& n, const uint8_t* s,
+                       uint32_t len) {
+  std::memcpy(scratch + n, &len, 4);
+  n += 4;
+  std::memcpy(scratch + n, s, len);
+  n += len;
+}
+
+}  // namespace
+
+extern "C" {
+
+// One-shot hash of a pre-serialized record. Parity primitive for tests.
+uint64_t rl_xxh64(const uint8_t* data, uint64_t len, uint64_t seed) {
+  return xxh64(data, len, seed);
+}
+
+// Batched descriptor fingerprinting.
+//
+// Layout: `blob` holds every string back to back (UTF-8). `str_off` has
+// n_strings+1 entries framing each string. Record i covers strings
+// [rec_off[i], rec_off[i+1]) — its first string is the domain, followed by
+// alternating entry key/value strings — and is hashed with seed `seeds[i]`
+// (the window divider). Fingerprints land in `out[i]`.
+//
+// `scratch` must hold the largest serialized record
+// (record bytes + 4 per string); the caller sizes it once per batch.
+void rl_fingerprint_batch(const uint8_t* blob, const uint64_t* str_off,
+                          const uint64_t* rec_off, const uint64_t* seeds,
+                          uint64_t n_records, uint8_t* scratch,
+                          uint64_t* out) {
+  for (uint64_t i = 0; i < n_records; ++i) {
+    uint64_t n = 0;
+    for (uint64_t s = rec_off[i]; s < rec_off[i + 1]; ++s) {
+      const uint64_t beg = str_off[s];
+      hash_field(scratch, n, blob + beg,
+                 static_cast<uint32_t>(str_off[s + 1] - beg));
+    }
+    out[i] = xxh64(scratch, n, seeds[i]);
+  }
+}
+
+// Batched fixed-window cache-key composition (cache_key.go:43-73 layout):
+//   "<domain>_<k1>_<v1>_..._<window_start>"
+// Same record framing as rl_fingerprint_batch; window_starts[i] is the
+// already-snapped (now/divider)*divider value. Composed keys are written
+// back to back into `out` (caller-sized), with out_off[i]..out_off[i+1]
+// framing key i. Returns total bytes written, or -1 if `out_cap` is too
+// small (caller retries with a bigger buffer).
+int64_t rl_compose_keys(const uint8_t* blob, const uint64_t* str_off,
+                        const uint64_t* rec_off, const int64_t* window_starts,
+                        uint64_t n_records, uint8_t* out, uint64_t out_cap,
+                        uint64_t* out_off) {
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < n_records; ++i) {
+    out_off[i] = n;
+    // worst case: record strings + '_' separators + 20-digit window
+    uint64_t need = 21;
+    for (uint64_t s = rec_off[i]; s < rec_off[i + 1]; ++s)
+      need += str_off[s + 1] - str_off[s] + 1;
+    if (n + need > out_cap) return -1;
+    for (uint64_t s = rec_off[i]; s < rec_off[i + 1]; ++s) {
+      const uint64_t beg = str_off[s];
+      const uint64_t len = str_off[s + 1] - beg;
+      std::memcpy(out + n, blob + beg, len);
+      n += len;
+      out[n++] = '_';
+    }
+    // decimal window start (non-negative in practice; handle 0 explicitly)
+    char digits[21];
+    int nd = 0;
+    int64_t w = window_starts[i];
+    if (w == 0) digits[nd++] = '0';
+    while (w > 0) {
+      digits[nd++] = static_cast<char>('0' + (w % 10));
+      w /= 10;
+    }
+    while (nd > 0) out[n++] = digits[--nd];
+  }
+  out_off[n_records] = n;
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"
