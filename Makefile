@@ -1,0 +1,57 @@
+# Build / test / run workflow for the TPU-native rate-limit framework.
+# Mirrors the reference's Make targets (Makefile:76-125) mapped onto this
+# stack: the "compile" step builds the native host codec (C++ -> .so) and
+# generates protos; serving is `python -m api_ratelimit_tpu.cmd.service_cmd`.
+
+PY ?= python
+NATIVE_SRC := native/host_codec.cpp
+NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
+
+.PHONY: all compile native proto tests tests_unit tests_integration bench \
+        serve check_config clean docker_image
+
+all: compile
+
+compile: native proto
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_SRC)
+	mkdir -p $(dir $(NATIVE_SO))
+	g++ -O3 -shared -fPIC -std=c++17 -o $(NATIVE_SO) $(NATIVE_SRC)
+
+# Proto messages are compiled with the protoc binary (grpcio-tools is not
+# required); gRPC service glue is hand-written in api_ratelimit_tpu/pb/.
+proto:
+	./proto/gen.sh
+
+# Unit + hermetic integration tests on a virtual 8-device CPU mesh
+# (tests/conftest.py forces JAX_PLATFORMS=cpu; the reference's equivalent
+# is `go test -race ./...`, Makefile:83-85).
+tests_unit:
+	$(PY) -m pytest tests/ -x -q
+
+# Full suite; the in-process fake Redis/Memcache servers play the role the
+# reference's local redis fleet plays (Makefile:91-125).
+tests: tests_unit
+
+# Decisions/sec + p99 benchmark; prints one JSON line. Run on TPU.
+bench:
+	$(PY) bench.py
+
+# Local dev server with the example config on the TPU backend.
+serve:
+	RUNTIME_ROOT=examples/ratelimit RUNTIME_SUBDIRECTORY= \
+	  RUNTIME_WATCH_ROOT=false USE_STATSD=false LOG_LEVEL=INFO \
+	  $(PY) -m api_ratelimit_tpu.cmd.service_cmd
+
+# Offline config linter (config_check_cmd, src/config_check_cmd/main.go).
+check_config:
+	$(PY) -m api_ratelimit_tpu.cmd.config_check_cmd -config_dir examples/ratelimit/config
+
+docker_image:
+	docker build -t api-ratelimit-tpu:latest .
+
+clean:
+	rm -rf api_ratelimit_tpu/_native build dist
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

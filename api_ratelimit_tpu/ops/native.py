@@ -7,10 +7,11 @@ the per-call overhead amortizes the way the reference's pipelining amortizes
 Redis RTTs (src/redis/driver_impl.go:153-164).
 
 Loading is best-effort with a pure-Python fallback: `lib()` returns None
-when the shared object is absent and cannot be built, and every caller in
-the package degrades to the Python implementation (ops/hashing.py,
-limiter/cache_key.py). `ensure_built()` compiles it on demand with g++ —
-no pip, no pybind11, just the baked-in toolchain.
+when the shared object is absent and cannot be built, and both callers
+degrade to the Python implementation — ops/hashing.py `fingerprint_many`
+(-> fingerprint64) and limiter/base_limiter.py `generate_cache_keys`
+(-> cache_key.generate_cache_key). `ensure_built()` compiles it on demand
+with g++ — no pip, no pybind11, just the baked-in toolchain.
 """
 
 from __future__ import annotations
@@ -60,18 +61,28 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def ensure_built() -> bool:
-    """Compile the shared object if it is missing. Safe to call repeatedly."""
-    if os.path.exists(_SO_PATH):
-        return True
-    if not os.path.exists(_SRC):
-        return False
-    os.makedirs(_OUT_DIR, exist_ok=True)
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", _SO_PATH, _SRC,
-    ]
+    """Compile the shared object if it is missing or older than its source.
+    Best-effort and safe to call repeatedly/concurrently: builds go to a
+    per-pid temp path then atomically rename into place, and every failure
+    mode (no toolchain, read-only install, ...) returns False so callers
+    fall back to the Python path."""
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        if not os.path.exists(_SRC):
+            return os.path.exists(_SO_PATH)
+        if (
+            os.path.exists(_SO_PATH)
+            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)
+        ):
+            return True  # up to date; stale .so rebuilds below
+        os.makedirs(_OUT_DIR, exist_ok=True)
+        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO_PATH)
     except (OSError, subprocess.SubprocessError) as e:
         logger.warning("native codec build failed (%s); using Python path", e)
         return False
@@ -165,6 +176,8 @@ def fingerprint_batch(records, seeds) -> np.ndarray:
     flat = _Flattened(records)
     n = len(flat.rec_off) - 1
     seeds_arr = np.asarray(seeds, dtype=np.uint64)
+    if seeds_arr.size != n:
+        raise ValueError(f"{seeds_arr.size} seeds for {n} records")
     out = np.empty(n, dtype=np.uint64)
     scratch = np.empty(max(1, flat.max_record_bytes), dtype=np.uint8)
     native.rl_fingerprint_batch(

@@ -177,11 +177,20 @@ int64_t rl_compose_keys(const uint8_t* blob, const uint64_t* str_off,
       n += len;
       out[n++] = '_';
     }
-    // decimal window start (non-negative in practice; handle 0 explicitly)
+    // decimal window start; negatives (pre-epoch/skewed clocks) must render
+    // exactly like Python's str() so keys stay byte-identical
     char digits[21];
     int nd = 0;
     int64_t w = window_starts[i];
-    if (w == 0) digits[nd++] = '0';
+    if (w < 0) {
+      out[n++] = '-';
+      while (w < 0) {
+        digits[nd++] = static_cast<char>('0' - (w % 10));
+        w /= 10;
+      }
+    } else if (w == 0) {
+      digits[nd++] = '0';
+    }
     while (w > 0) {
       digits[nd++] = static_cast<char>('0' + (w % 10));
       w /= 10;

@@ -131,6 +131,63 @@ class TestComposeKeysParity:
         got = native.compose_keys_batch([["d", "k", "v"]], [0])
         assert got == ["d_k_v_0"]
 
+    def test_window_negative_matches_python_str(self):
+        # pre-epoch/skewed clocks must render like Python's str()
+        got = native.compose_keys_batch(
+            [["d", "k", "v"], ["d", "k", "v"]], [-60, -9223372036854775808]
+        )
+        assert got == ["d_k_v_-60", "d_k_v_-9223372036854775808"]
+
+    def test_seed_count_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            native.fingerprint_batch([["d"], ["e"]], [1])
+
+    def test_generate_cache_keys_native_batch_parity(self, test_store):
+        # >=8 checked descriptors routes through the native composer; keys
+        # must match the per-descriptor Python codec exactly, with nil
+        # limits interleaved as empty keys
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+        from api_ratelimit_tpu.limiter.cache_key import generate_cache_key
+        from api_ratelimit_tpu.models.config import RateLimit, new_rate_limit_stats
+        from api_ratelimit_tpu.models.descriptors import (
+            Descriptor,
+            RateLimitRequest,
+        )
+        from api_ratelimit_tpu.models.response import RateLimitValue
+        from api_ratelimit_tpu.models.units import Unit
+        from api_ratelimit_tpu.utils.timeutil import FakeTimeSource
+
+        store, _ = test_store
+        scope = store.scope("t")
+        descriptors = []
+        limits = []
+        for i in range(12):
+            descriptors.append(Descriptor.of(("key", f"v{i}"), ("sub", "x")))
+            if i % 5 == 4:
+                limits.append(None)  # unchecked descriptor
+            else:
+                limits.append(
+                    RateLimit(
+                        full_key=f"k{i}",
+                        stats=new_rate_limit_stats(scope, f"k{i}"),
+                        limit=RateLimitValue(
+                            requests_per_unit=10,
+                            unit=Unit.SECOND if i % 2 else Unit.HOUR,
+                        ),
+                    )
+                )
+        ts = FakeTimeSource(987_654_321)
+        base = BaseRateLimiter(time_source=ts, jitter_rand=None)
+        request = RateLimitRequest(
+            domain="paritydom", descriptors=tuple(descriptors)
+        )
+        got = base.generate_cache_keys(request, limits, 1)
+        want = [
+            generate_cache_key("paritydom", d, lim, 987_654_321)
+            for d, lim in zip(descriptors, limits)
+        ]
+        assert got == want
+
     def test_output_buffer_growth(self):
         # force the retry path with a huge value string
         big = "v" * 100_000
