@@ -1,32 +1,73 @@
-"""Decisions/sec benchmark for the TPU slab engine (the un-skipped version of
-the reference's BenchmarkParallelDoLimit, test/redis/bench_test.go:20-94).
+"""Decisions/sec + p99 benchmark over the five BASELINE.json configs — the
+un-skipped version of the reference's BenchmarkParallelDoLimit
+(test/redis/bench_test.go:20-94), which was permanently skipped and never
+published numbers (BASELINE.md).
 
-Measures the batched device decision engine — probe + window increment +
-full on-device decide (Pallas kernel on TPU) — over a 10M-key Zipfian
-descriptor stream (BASELINE.json configs[4]). The key-id stream is staged in
-HBM before the timed region (a co-located production host feeds descriptors
-over PCIe at GB/s; this dev environment reaches its single chip through a
-network tunnel whose per-transfer cost would otherwise measure the tunnel,
-not the engine). Each timed step expands ids to 64-bit fingerprints on
-device, runs the full slab decision program, and ships the 1-byte decision
-code per item back to the host (ops/slab.py compact modes).
+Two tiers:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-vs_baseline is against the 10M decisions/sec north-star target — the
-reference publishes no numbers of its own (BASELINE.md).
+  * ENGINE (configs[4], the headline): the batched device decision program —
+    probe + window increment + full on-device decide (Pallas on TPU) — over a
+    10M-key Zipfian stream. Key ids are staged in HBM before the timed
+    region (a co-located production host feeds descriptors over PCIe at
+    GB/s; this dev environment reaches its chip through a network tunnel
+    whose per-transfer cost would otherwise measure the tunnel, not the
+    engine). Each timed step expands ids to 64-bit fingerprints on device,
+    runs the slab program, and ships 1 byte/decision back.
+
+  * SERVICE (configs[0..3]): the full host path end to end —
+    should_rate_limit -> config trie -> fingerprints -> micro-batcher ->
+    device -> decision math — driven by concurrent threads, measuring
+    per-request p99 alongside throughput: flat per-second rule, nested
+    tree, dual-window (second+hour), and near-limit with the local
+    over-limit cache.
+
+Prints ONE JSON line: the headline engine metric plus per-config results.
+vs_baseline is against the 10M decisions/sec north-star target.
 """
 
 from __future__ import annotations
 
 import functools
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 TARGET = 10_000_000.0
+
+
+def resolve_platform() -> str:
+    """Pick the JAX platform BEFORE importing jax in this process.
+
+    The TPU here sits behind a network tunnel; when the tunnel is down the
+    platform plugin hangs inside jax.devices() with no timeout. Probe device
+    init in a subprocess with a deadline and fall back to CPU so the bench
+    always produces its JSON line. BENCH_PLATFORM=cpu|tpu skips the probe.
+    """
+    forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
+    if forced:
+        if forced not in ("cpu", "tpu"):
+            raise SystemExit(f"BENCH_PLATFORM must be cpu|tpu, got {forced!r}")
+        return forced
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=120,
+            text=True,
+        )
+        lines = probe.stdout.strip().splitlines() if probe.stdout else []
+        platform = lines[-1] if lines else ""
+        if probe.returncode == 0 and platform:
+            return platform
+    except (subprocess.TimeoutExpired, OSError):
+        print("device probe timed out; falling back to cpu", file=sys.stderr)
+    return "cpu"
 
 
 def zipf_ids(n_keys: int, batch: int, n_batches: int, seed: int = 0) -> np.ndarray:
@@ -36,14 +77,13 @@ def zipf_ids(n_keys: int, batch: int, n_batches: int, seed: int = 0) -> np.ndarr
     return ids.reshape(n_batches, batch).astype(np.uint32)
 
 
-def main() -> None:
+def bench_engine_zipf(device, on_tpu: bool) -> dict:
+    """configs[4]: 10M-key Zipfian stream against the slab engine."""
     import jax
     import jax.numpy as jnp
 
     from api_ratelimit_tpu.ops.slab import SlabBatch, _slab_step_sorted, _unsort, make_slab
 
-    device = jax.devices()[0]
-    on_tpu = device.platform == "tpu"
     batch = (1 << 20) if on_tpu else (1 << 13)
     n_slots = (1 << 23) if on_tpu else (1 << 18)
     n_keys = 10_000_000 if on_tpu else 100_000
@@ -113,22 +153,212 @@ def main() -> None:
     elapsed = time.perf_counter() - t0
 
     decisions = n_batches * batch
-    rate = decisions / elapsed
     over_frac = float(np.mean([(f == 2).mean() for f in fetched]))
     print(
-        f"platform={device.platform} pallas={use_pallas} batch={batch} "
-        f"x{n_batches} slots={n_slots} keys={n_keys} elapsed={elapsed:.3f}s "
-        f"launch-dispatch p50={np.percentile(lat, 50):.2f}ms "
+        f"[engine] platform={device.platform} pallas={use_pallas} "
+        f"batch={batch} x{n_batches} slots={n_slots} keys={n_keys} "
+        f"elapsed={elapsed:.3f}s dispatch p50={np.percentile(lat, 50):.2f}ms "
         f"over_limit_frac={over_frac:.3f}",
         file=sys.stderr,
     )
+    return {
+        "rate": round(decisions / elapsed),
+        "batch": batch,
+        "pallas": use_pallas,
+    }
+
+
+# ---------------- service-level benches (configs[0..3]) ----------------
+
+_FLAT = """\
+domain: bench
+descriptors:
+  - key: api_key
+    rate_limit: {unit: second, requests_per_unit: 1000000000}
+"""
+
+_NESTED = """\
+domain: bench
+descriptors:
+  - key: source_cluster
+    value: proxy
+    descriptors:
+      - key: destination_cluster
+        descriptors:
+          - key: user
+            rate_limit: {unit: minute, requests_per_unit: 1000000000}
+"""
+
+_DUAL = """\
+domain: bench
+descriptors:
+  - key: per_sec
+    rate_limit: {unit: second, requests_per_unit: 1000000000}
+  - key: per_hour
+    rate_limit: {unit: hour, requests_per_unit: 1000000000}
+"""
+
+_NEARLIMIT = """\
+domain: bench
+descriptors:
+  - key: tight
+    rate_limit: {unit: hour, requests_per_unit: 5}
+"""
+
+
+class _StaticRuntime:
+    def __init__(self, yaml_text: str):
+        self._yaml = yaml_text
+
+    def snapshot(self):
+        outer = self
+
+        class Snap:
+            def keys(self):
+                return ["config.bench"]
+
+            def get(self, key):
+                return outer._yaml
+
+        return Snap()
+
+    def add_update_callback(self, cb):
+        pass
+
+
+def _requests_for(config_key: str, n: int):
+    from api_ratelimit_tpu.models.descriptors import Descriptor, RateLimitRequest
+
+    reqs = []
+    for i in range(n):
+        if config_key == "flat_per_second":
+            descs = (Descriptor.of(("api_key", f"k{i % 1024}")),)
+        elif config_key == "nested_tree":
+            descs = (
+                Descriptor.of(
+                    ("source_cluster", "proxy"),
+                    ("destination_cluster", f"c{i % 16}"),
+                    ("user", f"u{i % 1024}"),
+                ),
+            )
+        elif config_key == "dual_window":
+            descs = (
+                Descriptor.of(("per_sec", f"k{i % 1024}")),
+                Descriptor.of(("per_hour", f"k{i % 1024}")),
+            )
+        else:  # near_limit_local_cache: few hot keys, most already over
+            descs = (Descriptor.of(("tight", f"k{i % 8}")),)
+        reqs.append(RateLimitRequest(domain="bench", descriptors=descs))
+    return reqs
+
+
+def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
+    """One service-level scenario: threads driving should_rate_limit through
+    the micro-batched TPU backend."""
+    import random
+
+    from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+    from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+    from api_ratelimit_tpu.limiter.local_cache import LocalCache
+    from api_ratelimit_tpu.service.ratelimit import RateLimitService
+    from api_ratelimit_tpu.stats.sinks import NullSink
+    from api_ratelimit_tpu.stats.store import Store
+    from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+    n_threads = 8
+    per_thread = 400 if on_tpu else 100
+    store = Store(NullSink())
+    local_cache = (
+        LocalCache(max_entries=4096, time_source=RealTimeSource())
+        if config_key == "near_limit_local_cache"
+        else None
+    )
+    base = BaseRateLimiter(
+        time_source=RealTimeSource(),
+        jitter_rand=random.Random(0),
+        expiration_jitter_max_seconds=0,
+        local_cache=local_cache,
+    )
+    cache = TpuRateLimitCache(
+        base,
+        n_slots=1 << 18,
+        batch_window_seconds=0.002 if on_tpu else 0.0005,
+        max_batch=8192,
+    )
+    service = RateLimitService(
+        runtime=_StaticRuntime(yaml_text),
+        cache=cache,
+        stats_scope=store.scope("ratelimit").scope("service"),
+        time_source=RealTimeSource(),
+    )
+    reqs = _requests_for(config_key, 2048)
+    decisions_per_request = len(reqs[0].descriptors)
+
+    # warmup: compile the batcher's bucket shapes + prime the local cache
+    for r in reqs[:32]:
+        service.should_rate_limit(r)
+
+    lat: list[float] = []
+    lat_lock = threading.Lock()
+
+    def worker(tid: int) -> int:
+        my = reqs[tid::n_threads]
+        local = []
+        for i in range(per_thread):
+            r = my[i % len(my)]
+            s = time.perf_counter()
+            service.should_rate_limit(r)
+            local.append((time.perf_counter() - s) * 1e3)
+        with lat_lock:
+            lat.extend(local)
+        return per_thread
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(n_threads) as ex:
+        total = sum(ex.map(worker, range(n_threads)))
+    elapsed = time.perf_counter() - t0
+    cache.close()
+
+    result = {
+        # decisions/sec (a dual-window request makes 2 limit decisions)
+        "rate": round(total * decisions_per_request / elapsed),
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+    }
+    print(f"[service:{config_key}] {result}", file=sys.stderr)
+    return result
+
+
+def main() -> None:
+    platform = resolve_platform()
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+
+    engine = bench_engine_zipf(device, on_tpu)
+    configs = {
+        "flat_per_second": bench_service("flat_per_second", _FLAT, on_tpu),
+        "nested_tree": bench_service("nested_tree", _NESTED, on_tpu),
+        "dual_window": bench_service("dual_window", _DUAL, on_tpu),
+        "near_limit_local_cache": bench_service(
+            "near_limit_local_cache", _NEARLIMIT, on_tpu
+        ),
+        "zipf_10M_engine": engine,
+    }
+
+    rate = engine["rate"]
     print(
         json.dumps(
             {
                 "metric": "rate_limit_decisions_per_sec_zipf10M",
-                "value": round(rate),
+                "value": rate,
                 "unit": "decisions/sec",
                 "vs_baseline": round(rate / TARGET, 4),
+                "platform": device.platform,
+                "configs": configs,
             }
         )
     )
