@@ -12,10 +12,48 @@ a injectable clock. Not safe for production use — tests only.
 
 from __future__ import annotations
 
+import atexit
+import os
+import shutil
 import socket
+import ssl
+import subprocess
+import tempfile
 import threading
 import time
 from typing import Callable
+
+_TLS_CERT_DIR: str | None = None
+_tls_lock = threading.Lock()
+
+
+def _self_signed_context() -> ssl.SSLContext:
+    """Server-side TLS context with a lazily generated self-signed cert —
+    the stand-in for the reference's stunnel TLS proxies (Makefile:50-61).
+    One cert per process, cached on disk in a temp dir."""
+    global _TLS_CERT_DIR
+    with _tls_lock:
+        if _TLS_CERT_DIR is None:
+            d = tempfile.mkdtemp(prefix="fake-redis-tls-")
+            atexit.register(shutil.rmtree, d, ignore_errors=True)
+            subprocess.run(
+                [
+                    "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-keyout", os.path.join(d, "key.pem"),
+                    "-out", os.path.join(d, "cert.pem"),
+                    "-days", "1", "-nodes", "-subj", "/CN=localhost",
+                ],
+                check=True,
+                capture_output=True,
+                timeout=60,
+            )
+            _TLS_CERT_DIR = d
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(
+        os.path.join(_TLS_CERT_DIR, "cert.pem"),
+        os.path.join(_TLS_CERT_DIR, "key.pem"),
+    )
+    return ctx
 
 
 class FakeRedisServer:
@@ -24,12 +62,16 @@ class FakeRedisServer:
         password: str = "",
         clock: Callable[[], float] = time.time,
         sentinel_master: tuple[str, str, int] | None = None,
+        tls: bool = False,
     ):
         """sentinel_master: (name, host, port) this instance reports when
-        asked as a sentinel."""
+        asked as a sentinel. tls wraps every accepted connection with a
+        self-signed server cert (clients dial with verification off, like
+        the reference's local stunnel setup)."""
         self._password = password
         self._clock = clock
         self._sentinel_master = sentinel_master
+        self._tls_ctx = _self_signed_context() if tls else None
         self._data: dict[bytes, tuple[bytes, float | None]] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -62,6 +104,18 @@ class FakeRedisServer:
             if entry is None or entry[1] is None:
                 return None
             return entry[1] - self._clock()
+
+    def get_int_prefix(self, prefix: str) -> int | None:
+        """First live counter whose key starts with `prefix` — assertions
+        don't need to reconstruct the window suffix."""
+        p = prefix.encode()
+        with self._lock:
+            for k in list(self._data):
+                if k.startswith(p):
+                    entry = self._live(k)
+                    if entry is not None:
+                        return int(entry[0])
+        return None
 
     def flushall(self) -> None:
         with self._lock:
@@ -98,6 +152,16 @@ class FakeRedisServer:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls_ctx is not None:
+            # handshake on the connection thread so a bad client can't
+            # stall the accept loop
+            try:
+                conn.settimeout(5.0)
+                conn = self._tls_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except (OSError, ssl.SSLError):
+                conn.close()
+                return
         buf = b""
         authed = not self._password
         try:
