@@ -1,0 +1,84 @@
+# Production topology record for the TPU rate-limit service — the analog of
+# the reference's nomad deployment (nomad/apigw-ratelimit/*.hcl): N stateless
+# replicas behind a gRPC LB, health-checked on the HTTP port, drained via
+# SIGTERM (health flips NOT_SERVING before the gRPC server stops).
+#
+# Differences from the reference topology, by design:
+#   - replicas place onto TPU-equipped clients (constraint below) and carry
+#     their own HBM slab — there is no shared Redis to point at. Each
+#     replica enforces limits over the traffic it sees; for globally exact
+#     limits run the multi-chip mesh (TPU_MESH_DEVICES) behind one replica
+#     per host, or front replicas with descriptor-hash affinity at the LB.
+#   - MAX_SLEEPING_ROUTINES=64 carried over from the reference's production
+#     env (nomad/apigw-ratelimit/common.hcl:56-58).
+
+job "api-ratelimit-tpu" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "ratelimit" {
+    count = 2
+
+    constraint {
+      attribute = "${meta.tpu_accelerator}"
+      value     = "v5e"
+    }
+
+    network {
+      port "http" { static = 9483 }
+      port "grpc" { static = 9484 }
+      port "debug" { static = 9485 }
+    }
+
+    service {
+      name = "api-ratelimit-tpu"
+      port = "grpc"
+      check {
+        type     = "grpc"
+        interval = "5s"
+        timeout  = "2s"
+      }
+    }
+
+    service {
+      name = "api-ratelimit-tpu-admin"
+      port = "http"
+      check {
+        type     = "http"
+        path     = "/healthcheck"
+        interval = "5s"
+        timeout  = "2s"
+      }
+    }
+
+    task "server" {
+      driver = "docker"
+
+      config {
+        image = "api-ratelimit-tpu:latest"
+        ports = ["http", "grpc", "debug"]
+      }
+
+      env {
+        PORT                   = "${NOMAD_PORT_http}"
+        GRPC_PORT              = "${NOMAD_PORT_grpc}"
+        DEBUG_PORT             = "${NOMAD_PORT_debug}"
+        BACKEND_TYPE           = "tpu"
+        TPU_BATCH_WINDOW       = "200us"
+        RUNTIME_ROOT           = "/srv/runtime_data/current"
+        RUNTIME_SUBDIRECTORY   = "ratelimit"
+        RUNTIME_WATCH_ROOT     = "false"
+        USE_STATSD             = "true"
+        STATSD_HOST            = "localhost"
+        STATSD_PORT            = "8125"
+        LOG_FORMAT             = "json"
+        MAX_SLEEPING_ROUTINES  = "64"
+      }
+
+      resources {
+        cpu    = 4000
+        memory = 8192
+      }
+    }
+  }
+}
