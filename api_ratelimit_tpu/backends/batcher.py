@@ -33,11 +33,13 @@ class MicroBatcher:
         self._max_batch = int(max_batch)
         self._lock = threading.Lock()
         self._items: list = []
-        self._futures: list[tuple[Future, int, int]] = []  # (future, start, count)
+        # (future, start, count, enqueued_at)
+        self._futures: list[tuple[Future, int, int, float]] = []
         self._inflight = 0
         self._wakeup = threading.Condition(self._lock)
         self._direct_lock = threading.Lock()
         self._closed = False
+        self._last_end = float("-inf")  # monotonic end of the last execute
         self._idle = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
         if self._window > 0:
@@ -66,7 +68,7 @@ class MicroBatcher:
                 raise RuntimeError("batcher is closed")
             start = len(self._items)
             self._items.extend(items)
-            self._futures.append((future, start, len(items)))
+            self._futures.append((future, start, len(items), time.monotonic()))
             self._wakeup.notify()
         return future.result()
 
@@ -101,10 +103,15 @@ class MicroBatcher:
                 if self._closed and not self._items:
                     self._idle.notify_all()
                     return
-                # linger up to `window` for stragglers unless already full;
+                # linger up to `window` for stragglers unless already full.
+                # Warm pipeline: items enqueued while the previous batch was
+                # executing have already waited >= one launch — launch them
+                # immediately instead of adding the window on top (the device
+                # execute time is itself the coalescing window under load).
                 # submit() notifies on every enqueue, so wait on a deadline
                 # loop or the first straggler would end the window early
-                if len(self._items) < self._max_batch:
+                warm = self._futures and self._futures[0][3] <= self._last_end
+                if len(self._items) < self._max_batch and not warm:
                     deadline = time.monotonic() + self._window
                     while len(self._items) < self._max_batch and not self._closed:
                         remaining = deadline - time.monotonic()
@@ -117,7 +124,7 @@ class MicroBatcher:
                 # loops over buckets internally.
                 futures = []
                 taken = 0
-                for future, _start, count in self._futures:
+                for future, _start, count, _ts in self._futures:
                     if futures and taken + count > self._max_batch:
                         break
                     futures.append((future, taken, count))
@@ -125,8 +132,8 @@ class MicroBatcher:
                 items = self._items[:taken]
                 self._items = self._items[taken:]
                 self._futures = [
-                    (f, start - taken, count)
-                    for f, start, count in self._futures[len(futures) :]
+                    (f, start - taken, count, ts)
+                    for f, start, count, ts in self._futures[len(futures) :]
                 ]
                 self._inflight += 1
 
@@ -140,6 +147,7 @@ class MicroBatcher:
                         future.set_exception(e)
 
             with self._lock:
+                self._last_end = time.monotonic()
                 self._inflight -= 1
                 if not self._items and not self._futures and not self._inflight:
                     self._idle.notify_all()

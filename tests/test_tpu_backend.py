@@ -202,6 +202,49 @@ class TestMicroBatcher:
         assert sizes == [10]
         b.close()
 
+    def test_warm_pipeline_skips_linger(self):
+        # items enqueued while a batch is executing launch immediately after
+        # it, without waiting the window again
+        import time as _time
+
+        executing = threading.Event()
+        release = threading.Event()
+
+        def execute(items):
+            executing.set()
+            release.wait(2.0)
+            release.clear()
+            return items
+
+        b = MicroBatcher(execute, window_seconds=0.5, max_batch=100)
+        t1 = threading.Thread(target=lambda: b.submit([1]))
+        t1.start()
+        assert executing.wait(2.0)  # batch 1 on device
+        executing.clear()
+
+        got = []
+        t2 = threading.Thread(target=lambda: got.append(b.submit([2])))
+        t2.start()
+        # wait until item 2 is actually enqueued (mid-execute) — a fixed
+        # sleep would flake under scheduler delay
+        deadline = _time.monotonic() + 2.0
+        while _time.monotonic() < deadline:
+            with b._lock:
+                if b._futures:
+                    break
+            _time.sleep(0.005)
+        s = _time.monotonic()
+        release.set()  # batch 1 finishes now
+        assert executing.wait(2.0)  # batch 2 launched...
+        launched_after = _time.monotonic() - s
+        release.set()
+        t1.join(2.0)
+        t2.join(2.0)
+        b.close()
+        assert got == [[2]]
+        # ...well inside the 0.5s window it would otherwise linger
+        assert launched_after < 0.25, f"lingered {launched_after:.3f}s"
+
     def test_error_propagates_to_callers(self):
         def execute(items):
             raise RuntimeError("device on fire")
