@@ -56,6 +56,26 @@ class BaseRateLimiter:
         self.expiration_jitter_max_seconds = int(expiration_jitter_max_seconds)
         self.local_cache = local_cache
         self.near_limit_ratio = float(near_limit_ratio)
+        self._near_ratio_f32 = _f32(self.near_limit_ratio)
+        # rpu -> floor(f32(rpu) * f32(ratio)); the rule set is small and
+        # static between reloads, so this stays tiny
+        self._near_threshold_cache: dict[int, int] = {}
+
+    def _near_threshold(self, requests_per_unit: int) -> int:
+        """nearLimitThreshold (base_limiter.go:83-86): float32 multiply to
+        match the reference's float32 math, memoized per limit value."""
+        threshold = self._near_threshold_cache.get(requests_per_unit)
+        if threshold is None:
+            threshold = int(
+                math.floor(_f32(_f32(requests_per_unit) * self._near_ratio_f32))
+            )
+            # bound: requests_per_unit can be a client-supplied request-level
+            # override (config/loader.py get_limit), so the key space is
+            # attacker-controlled; dump and restart rather than grow forever
+            if len(self._near_threshold_cache) >= 4096:
+                self._near_threshold_cache.clear()
+            self._near_threshold_cache[requests_per_unit] = threshold
+        return threshold
 
     # -- key generation --
 
@@ -139,10 +159,7 @@ class BaseRateLimiter:
             )
 
         limit_info.over_threshold = limit.requests_per_unit
-        # float32 cast first to match the reference's float32 multiply.
-        limit_info.near_threshold = int(
-            math.floor(_f32(_f32(limit_info.over_threshold) * _f32(self.near_limit_ratio)))
-        )
+        limit_info.near_threshold = self._near_threshold(limit.requests_per_unit)
 
         if limit_info.after > limit_info.over_threshold:
             status = DescriptorStatus(

@@ -27,9 +27,12 @@ _DIVIDERS = {
 
 def unit_to_divider(unit: Unit) -> int:
     """Seconds per window for a unit. Raises on UNKNOWN (reference panics)."""
+    divider = _DIVIDERS.get(unit)  # fast path: already a Unit (hot loop)
+    if divider is not None:
+        return divider
     try:
         return _DIVIDERS[Unit(unit)]
-    except KeyError:
+    except (KeyError, ValueError):
         raise ValueError(f"no divider for unit {unit!r}")
 
 
