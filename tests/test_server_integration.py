@@ -461,6 +461,29 @@ class TestBackendMatrixTopologies:
         finally:
             server.close()
 
+    def test_redis_cluster_topology(self, tmp_path):
+        from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
+
+        node = FakeRedisServer()  # advertises itself for all 16384 slots
+        try:
+            runner = self._boot(
+                tmp_path,
+                backend_type="redis",
+                redis_socket_type="tcp",
+                redis_type="CLUSTER",
+                redis_url=node.addr,
+            )
+            codes = self._over_limit_sequence(runner)
+            assert codes == [
+                rls_v3.RateLimitResponse.OK,
+                rls_v3.RateLimitResponse.OVER_LIMIT,
+                rls_v3.RateLimitResponse.OVER_LIMIT,
+            ]
+            assert node.get_int_prefix("basic_one_per_minute_matrix") == 3
+            runner.stop()
+        finally:
+            node.close()
+
     def test_redis_sentinel_topology(self, tmp_path):
         from api_ratelimit_tpu.testing.fake_redis import FakeRedisServer
 
