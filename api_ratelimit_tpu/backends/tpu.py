@@ -97,6 +97,12 @@ class TpuRateLimitCache:
             self._state = jax.device_put(make_slab(n_slots), device)
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
+        # (domain, entries, divider) -> fingerprint. Rate-limit traffic is
+        # Zipfian (hot keys dominate), so memoizing descriptor hashes removes
+        # the hashing cost for the hot set; clear-on-full bounds a hostile
+        # key flood the same way the near-threshold memo does.
+        self._fp_cache: dict = {}
+        self._fp_cache_max = 1 << 17
         self._batcher = MicroBatcher(
             self._execute_batch,
             window_seconds=batch_window_seconds,
@@ -184,17 +190,36 @@ class TpuRateLimitCache:
             jitter = self._base.expiration_seconds(divider) - divider
             pending.append((i, divider, jitter))
 
-        # one batched fingerprint pass (native codec when available)
-        fps = fingerprint_many(
-            [
-                (request.domain, request.descriptors[i].entries)
-                for i, _, _ in pending
-            ],
-            [divider for _, divider, _ in pending],
-        )
+        # fingerprints: memo hit for hot keys, one batched pass (native
+        # codec when available) for the misses
+        fp_cache = self._fp_cache
+        fps: list[int] = [0] * len(pending)
+        miss_pos: list[int] = []
+        miss_keys: list[tuple] = []
+        miss_records = []
+        miss_seeds: list[int] = []
+        for pos, (i, divider, _jitter) in enumerate(pending):
+            entries = request.descriptors[i].entries
+            cache_key = (request.domain, entries, divider)
+            fp = fp_cache.get(cache_key)
+            if fp is None:
+                miss_pos.append(pos)
+                miss_keys.append(cache_key)
+                miss_records.append((request.domain, entries))
+                miss_seeds.append(divider)
+            else:
+                fps[pos] = fp
+        if miss_records:
+            if len(fp_cache) + len(miss_records) > self._fp_cache_max:
+                fp_cache.clear()
+            for pos, key, fp in zip(
+                miss_pos, miss_keys, fingerprint_many(miss_records, miss_seeds)
+            ):
+                fps[pos] = fp_cache[key] = int(fp)
+
         items = [
             _Item(
-                fp=int(fp),
+                fp=fp,
                 hits=hits_addend,
                 limit=limits[i].requests_per_unit,
                 divider=divider,
