@@ -329,8 +329,89 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
     return result
 
 
+def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
+    """configs[4] over the hash-sharded multi-chip engine (BENCH_MESH=N):
+    the same Zipfian stream against a mesh-wide program — counts combined
+    over ICI (real chips) or the virtual CPU mesh (shape validation)."""
+    from api_ratelimit_tpu.ops.slab import (
+        ROW_DIVIDER,
+        ROW_FP_HI,
+        ROW_FP_LO,
+        ROW_HITS,
+        ROW_JITTER,
+        ROW_LIMIT,
+        ROW_SCALARS,
+    )
+    from api_ratelimit_tpu.parallel.sharded_slab import ShardedSlabEngine, make_mesh
+
+    batch = (1 << 18) if on_tpu else (1 << 12)
+    n_keys = 10_000_000 if on_tpu else 100_000
+    n_batches = 8 if on_tpu else 3
+    now = int(time.time())
+
+    import jax
+
+    mesh = make_mesh(jax.devices()[:n_devices])
+    engine = ShardedSlabEngine(
+        mesh=mesh,
+        n_slots_global=n_devices * ((1 << 20) if on_tpu else (1 << 15)),
+        use_pallas=on_tpu,
+    )
+
+    def pack(ids: np.ndarray) -> np.ndarray:
+        packed = np.zeros((7, ids.size), dtype=np.uint32)
+        # two independent murmur-finalizer bijections (see bench_engine_zipf)
+        x = ids.astype(np.uint64)
+        lo = (x * 0x9E3779B185EBCA87) & 0xFFFFFFFF
+        hi = ((x ^ 0xA5A5A5A5) * 0xC2B2AE3D27D4EB4F) & 0xFFFFFFFF
+        packed[ROW_FP_LO] = lo
+        packed[ROW_FP_HI] = hi
+        packed[ROW_HITS] = 1
+        packed[ROW_LIMIT] = 100
+        packed[ROW_DIVIDER] = 1
+        packed[ROW_JITTER] = 0
+        packed[ROW_SCALARS, 0] = np.uint32(now)
+        packed[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+        return packed
+
+    host_ids = zipf_ids(n_keys, batch, n_batches + 1, seed=3)
+    # pre-stage the packed blocks on the mesh (replicated) so the timed loop
+    # doesn't measure the host->device link; step_packed's internal
+    # device_put is a no-op for an already-committed array. Readback stays
+    # synchronous per step (step_packed returns host numpy) — this bench
+    # validates the mesh program's shape/throughput, and its per-step sync
+    # makes the number conservative vs the overlapped single-chip bench.
+    blocks = [
+        jax.device_put(pack(host_ids[i]), engine._batch_sharding)
+        for i in range(n_batches + 1)
+    ]
+    for b in blocks:
+        jax.block_until_ready(b)
+    engine.step_packed(blocks[-1])  # warmup / compile
+
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        engine.step_packed(blocks[i])
+    elapsed = time.perf_counter() - t0
+
+    result = {
+        "rate": round(n_batches * batch / elapsed),
+        "devices": n_devices,
+        "batch": batch,
+    }
+    print(f"[engine-sharded x{n_devices}] {result}", file=sys.stderr)
+    return result
+
+
 def main() -> None:
     platform = resolve_platform()
+    n_mesh = int(os.environ.get("BENCH_MESH", "0") or 0)
+    if platform == "cpu" and n_mesh > 1:
+        # must land before jax's backend initializes
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_mesh}"
+        ).strip()
     import jax
 
     if platform == "cpu":
@@ -339,6 +420,10 @@ def main() -> None:
     on_tpu = device.platform == "tpu"
 
     engine = bench_engine_zipf(device, on_tpu)
+    if n_mesh > 1:
+        engine["sharded"] = bench_engine_sharded(
+            min(n_mesh, len(jax.devices())), on_tpu
+        )
     configs = {
         "flat_per_second": bench_service("flat_per_second", _FLAT, on_tpu),
         "nested_tree": bench_service("nested_tree", _NESTED, on_tpu),
