@@ -160,6 +160,73 @@ class TestTpuBackend:
         cache.close()
 
 
+class TestExactSlabOps:
+    """The §4.4 analog of the reference's exact-wire-command assertions
+    (test/redis/fixed_cache_impl_test.go:59-64 pins `INCRBY key hits` +
+    `EXPIRE key ttl` verbatim): capture the exact _Item batch the backend
+    submits to the device."""
+
+    def test_exact_items_submitted(self, test_store):
+        from api_ratelimit_tpu.ops.hashing import fingerprint64
+
+        store, _ = test_store
+        ts = FakeTimeSource(1234)
+        cache = make_tpu_cache(ts)
+        captured = []
+        real_execute = cache._batcher._execute
+
+        def spy(items):
+            captured.append(list(items))
+            return real_execute(items)
+
+        cache._batcher._execute = spy
+        limits = [
+            make_limit(store.scope("t"), 10, Unit.MINUTE, "k1"),
+            None,  # unchecked: must not reach the device
+            make_limit(store.scope("t"), 7, Unit.SECOND, "k3"),
+        ]
+        request = req(("k1", "a"), ("k2", "b"), ("k3", "c"), hits=2)
+        cache.do_limit(request, limits)
+        cache.close()
+
+        (batch,) = captured
+        assert len(batch) == 2  # nil-limit descriptor filtered out
+        it1, it3 = batch
+        # INCRBY-analog operands, pinned exactly
+        assert it1.fp == fingerprint64("domain", request.descriptors[0].entries, 60)
+        assert (it1.hits, it1.limit, it1.divider) == (2, 10, 60)
+        assert it3.fp == fingerprint64("domain", request.descriptors[2].entries, 1)
+        assert (it3.hits, it3.limit, it3.divider) == (2, 7, 1)
+        # EXPIRE-analog: no jitter configured => TTL exactly the unit window
+        assert it1.jitter == 0 and it3.jitter == 0
+
+    def test_jitter_rides_into_expiry(self, test_store):
+        store, _ = test_store
+        ts = FakeTimeSource(1234)
+        base = BaseRateLimiter(
+            ts,
+            jitter_rand=random.Random(42),
+            expiration_jitter_max_seconds=300,
+        )
+        cache = TpuRateLimitCache(
+            base, n_slots=1 << 12, buckets=(128,), max_batch=128, use_pallas=False
+        )
+        captured = []
+        real_execute = cache._batcher._execute
+        cache._batcher._execute = lambda items: (
+            captured.append(list(items)),
+            real_execute(items),
+        )[1]
+        limit = make_limit(store.scope("t"), 5, Unit.MINUTE, "k")
+        cache.do_limit(req(("k", "v")), [limit])
+        cache.close()
+        (batch,) = captured
+        # jittered TTL = unit + rand(max) (fixed_cache_impl.go:69-72);
+        # seeded rand pins the exact value
+        want = random.Random(42).randrange(300)
+        assert batch[0].jitter == want
+
+
 class TestMicroBatcher:
     def test_direct_mode(self):
         calls = []
