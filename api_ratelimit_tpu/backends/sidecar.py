@@ -1,0 +1,271 @@
+"""TPU slab sidecar: one device-owner process, many wire frontends.
+
+Why this exists: a single Python process tops out at a few thousand RPS of
+gRPC handling (GIL + per-RPC overhead), while the slab engine does millions
+of decisions per launch. The reference scales its wire layer by running
+2-3 stateless replicas against one shared Redis (nomad/apigw-ratelimit/
+common.hcl:2) — the Redis process is the shared single-writer state. Here
+the TPU chip plays Redis's role: ONE sidecar process owns the slab
+(SlabDeviceEngine, backends/tpu.py) and N frontend processes — each a full
+gRPC/HTTP server bound to the same ports via SO_REUSEPORT — ship item
+batches to it over a unix socket. The sidecar's micro-batcher coalesces
+across ALL frontends, so more frontends means BIGGER device batches, not
+contention. Limits stay globally exact because every increment serializes
+through the one slab, exactly like N replicas against one Redis.
+
+This is the "JAX/TPU sidecar" of the north star (BASELINE.json).
+
+Wire protocol (length-framed, little-endian, one in-flight request per
+connection; frontends pool connections for concurrency):
+
+  request:  u32 magic 'RLSC' | u8 version=1 | u8 op | u16 reserved
+            op 1 SUBMIT: u32 n | uint32[6, n] C-order
+                         rows: fp_lo, fp_hi, hits, limit, divider, jitter
+            op 2 PING:   empty
+  response: u8 status (0 ok / 1 error)
+            SUBMIT ok:   u32 n | uint32[n] post-increment counters
+            PING ok:     empty
+            error:       u32 len | utf-8 message
+
+`now` is stamped by the sidecar at launch time — one clock authority, so
+frontends never disagree about window boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from ..limiter.cache import CacheError
+
+logger = logging.getLogger("ratelimit.sidecar")
+
+MAGIC = 0x524C5343  # 'RLSC'
+VERSION = 1
+OP_SUBMIT = 1
+OP_PING = 2
+
+_HDR = struct.Struct("<IBBH")  # magic, version, op, reserved
+_U32 = struct.Struct("<I")
+
+ITEM_ROWS = 6  # fp_lo, fp_hi, hits, limit, divider, jitter
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("sidecar connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def encode_items(items) -> bytes:
+    """uint32[6, n] block from a list of _Item (backends/tpu.py)."""
+    n = len(items)
+    block = np.empty((ITEM_ROWS, n), dtype=np.uint32)
+    fp = np.fromiter((it.fp for it in items), dtype=np.uint64, count=n)
+    block[0] = (fp & 0xFFFFFFFF).astype(np.uint32)
+    block[1] = (fp >> np.uint64(32)).astype(np.uint32)
+    block[2] = np.fromiter((it.hits for it in items), np.uint32, n)
+    block[3] = np.fromiter((it.limit for it in items), np.uint32, n)
+    block[4] = np.fromiter((it.divider for it in items), np.uint32, n)
+    block[5] = np.fromiter((it.jitter for it in items), np.uint32, n)
+    return _U32.pack(n) + block.tobytes()
+
+
+def decode_items(payload: bytes):
+    """Inverse of encode_items; returns a list of _Item."""
+    from .tpu import _Item
+
+    (n,) = _U32.unpack_from(payload)
+    block = np.frombuffer(
+        payload, dtype=np.uint32, count=ITEM_ROWS * n, offset=_U32.size
+    ).reshape(ITEM_ROWS, n)
+    fp = block[0].astype(np.uint64) | (block[1].astype(np.uint64) << np.uint64(32))
+    return [
+        _Item(
+            fp=int(fp[i]),
+            hits=int(block[2, i]),
+            limit=int(block[3, i]),
+            divider=int(block[4, i]),
+            jitter=int(block[5, i]),
+        )
+        for i in range(n)
+    ]
+
+
+class SlabSidecarServer:
+    """The device-owner process. Accepts frontend connections on a unix
+    socket; each SUBMIT runs through the engine's micro-batcher, which
+    coalesces items from every connected frontend into shared launches."""
+
+    def __init__(self, socket_path: str, engine):
+        self._engine = engine
+        self._path = socket_path
+        try:
+            os.unlink(socket_path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(socket_path)
+        self._sock.listen(128)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="sidecar-accept", daemon=True
+        )
+        self._accept_thread.start()
+        logger.info("slab sidecar listening on %s", socket_path)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    hdr = _recv_exact(conn, _HDR.size)
+                    magic, version, op, _ = _HDR.unpack(hdr)
+                    if magic != MAGIC or version != VERSION:
+                        conn.sendall(self._error(f"bad header {hdr!r}"))
+                        return
+                    if op == OP_PING:
+                        conn.sendall(b"\x00")
+                        continue
+                    if op != OP_SUBMIT:
+                        conn.sendall(self._error(f"bad op {op}"))
+                        return
+                    n_raw = _recv_exact(conn, _U32.size)
+                    (n,) = _U32.unpack(n_raw)
+                    payload = n_raw + _recv_exact(conn, ITEM_ROWS * n * 4)
+                    try:
+                        items = decode_items(payload)
+                        afters = self._engine.submit(items)
+                        out = np.asarray(afters, dtype=np.uint32)
+                        conn.sendall(
+                            b"\x00" + _U32.pack(len(out)) + out.tobytes()
+                        )
+                    except Exception as e:  # noqa: BLE001 - surface to client
+                        logger.exception("sidecar submit failed")
+                        conn.sendall(self._error(str(e)))
+        except (ConnectionError, OSError):
+            return  # frontend went away
+
+    @staticmethod
+    def _error(message: str) -> bytes:
+        raw = message.encode()
+        return b"\x01" + _U32.pack(len(raw)) + raw
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+        self._engine.close()
+
+
+class SidecarEngineClient:
+    """Frontend-side device driver: same submit/flush/close verbs as
+    SlabDeviceEngine, executed by the sidecar process over the socket.
+    Connections are pooled so frontend threads overlap their RPCs — the
+    sidecar's batcher turns that concurrency into bigger launches."""
+
+    def __init__(self, socket_path: str, pool_size: int = 8, timeout: float = 30.0):
+        self._path = socket_path
+        self._timeout = timeout
+        self._pool: list[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = pool_size
+        self._closed = False
+        # fail fast like the reference's startup PING (driver_impl.go:124-128)
+        conn = self._dial()
+        conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
+        if _recv_exact(conn, 1) != b"\x00":
+            raise CacheError(f"sidecar ping failed on {socket_path}")
+        self._release(conn)
+
+    def _dial(self) -> socket.socket:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(self._timeout)
+        try:
+            conn.connect(self._path)
+        except OSError as e:
+            conn.close()
+            raise CacheError(f"cannot reach slab sidecar at {self._path}: {e}")
+        return conn
+
+    def _acquire(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._dial()
+
+    def _release(self, conn: socket.socket) -> None:
+        with self._pool_lock:
+            if not self._closed and len(self._pool) < self._pool_size:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def submit(self, items) -> list[int]:
+        if not items:
+            return []
+        conn = self._acquire()
+        try:
+            conn.sendall(
+                _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+            )
+            status = _recv_exact(conn, 1)
+            if status == b"\x01":
+                (ln,) = _U32.unpack(_recv_exact(conn, _U32.size))
+                message = _recv_exact(conn, ln).decode()
+                self._release(conn)
+                raise CacheError(f"sidecar error: {message}")
+            (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
+            out = np.frombuffer(_recv_exact(conn, 4 * n), dtype=np.uint32)
+            self._release(conn)
+            return out.tolist()
+        except CacheError:
+            raise
+        except (OSError, ConnectionError) as e:
+            conn.close()
+            raise CacheError(f"sidecar transport failure: {e}") from e
+
+    def flush(self) -> None:
+        pass  # submits are synchronous end to end
+
+    def close(self) -> None:
+        with self._pool_lock:
+            self._closed = True
+            for conn in self._pool:
+                conn.close()
+            self._pool.clear()
+
+
+def new_sidecar_cache_from_settings(settings, base_limiter):
+    """BACKEND_TYPE=tpu-sidecar factory: a TpuRateLimitCache whose device
+    driver is the remote sidecar (runner.py backend switch)."""
+    from .tpu import TpuRateLimitCache
+
+    return TpuRateLimitCache(
+        base_limiter,
+        engine=SidecarEngineClient(settings.sidecar_socket),
+    )

@@ -57,12 +57,19 @@ class _Item:
     jitter: int
 
 
-class TpuRateLimitCache:
-    """limiter.RateLimitCache implementation backed by the TPU slab."""
+class SlabDeviceEngine:
+    """The device driver: owns the slab state (single-chip or mesh-sharded)
+    and the micro-batcher, and turns item batches into post-increment
+    counters via one launch per batch. The narrow `submit(items) -> afters`
+    verb set is the device analog of the reference's redis.Client interface
+    (SURVEY.md §2.9); TpuRateLimitCache drives it in-process and the sidecar
+    server (backends/sidecar.py) exposes the same verb over a local socket
+    so many frontend processes can share ONE global slab."""
 
     def __init__(
         self,
-        base_limiter: BaseRateLimiter,
+        time_source,
+        near_limit_ratio: float = 0.8,
         n_slots: int = 1 << 22,
         batch_window_seconds: float = 0.0,
         max_batch: int = 65536,
@@ -71,12 +78,8 @@ class TpuRateLimitCache:
         use_pallas: bool | None = None,
         mesh=None,
     ):
-        self._base = base_limiter
-        # Prewarm the native host codec so the first request never pays the
-        # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
-        from ..ops import native
-
-        native.available()
+        self._time_source = time_source
+        self._near_limit_ratio = float(near_limit_ratio)
         if device is None:
             device = jax.devices()[0]
         self._device = device
@@ -97,17 +100,22 @@ class TpuRateLimitCache:
             self._state = jax.device_put(make_slab(n_slots), device)
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
-        # (domain, entries, divider) -> fingerprint. Rate-limit traffic is
-        # Zipfian (hot keys dominate), so memoizing descriptor hashes removes
-        # the hashing cost for the hot set; clear-on-full bounds a hostile
-        # key flood the same way the near-threshold memo does.
-        self._fp_cache: dict = {}
-        self._fp_cache_max = 1 << 17
         self._batcher = MicroBatcher(
             self._execute_batch,
             window_seconds=batch_window_seconds,
             max_batch=max_batch,
         )
+
+    def submit(self, items: list[_Item]) -> list[int]:
+        """Batched fixed-window increment; returns each item's
+        post-increment counter."""
+        return self._batcher.submit(items)
+
+    def flush(self) -> None:
+        self._batcher.flush()
+
+    def close(self) -> None:
+        self._batcher.close()
 
     # -- device execution (dispatcher thread / direct-mode caller only) --
 
@@ -159,9 +167,59 @@ class TpuRateLimitCache:
         packed[3, :n] = np.fromiter((it.limit for it in items), np.uint32, n)
         packed[4, :n] = np.fromiter((it.divider for it in items), np.uint32, n)
         packed[5, :n] = np.fromiter((it.jitter for it in items), np.uint32, n)
-        packed[6, 0] = np.uint32(self._base.time_source.unix_now())
-        packed[6, 1] = np.float32(self._base.near_limit_ratio).view(np.uint32)
+        packed[6, 0] = np.uint32(self._time_source.unix_now())
+        packed[6, 1] = np.float32(self._near_limit_ratio).view(np.uint32)
         return packed
+
+
+class TpuRateLimitCache:
+    """limiter.RateLimitCache implementation backed by the TPU slab."""
+
+    def __init__(
+        self,
+        base_limiter: BaseRateLimiter,
+        n_slots: int = 1 << 22,
+        batch_window_seconds: float = 0.0,
+        max_batch: int = 65536,
+        buckets: Sequence[int] = (1024, 8192, 65536),
+        device=None,
+        use_pallas: bool | None = None,
+        mesh=None,
+        engine=None,
+    ):
+        """engine: anything with submit(items)->afters / flush / close —
+        defaults to an in-process SlabDeviceEngine; the sidecar frontend
+        passes a socket client instead (backends/sidecar.py)."""
+        self._base = base_limiter
+        # Prewarm the native host codec so the first request never pays the
+        # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
+        from ..ops import native
+
+        native.available()
+        if engine is None:
+            engine = SlabDeviceEngine(
+                time_source=base_limiter.time_source,
+                near_limit_ratio=base_limiter.near_limit_ratio,
+                n_slots=n_slots,
+                batch_window_seconds=batch_window_seconds,
+                max_batch=max_batch,
+                buckets=buckets,
+                device=device,
+                use_pallas=use_pallas,
+                mesh=mesh,
+            )
+        self._engine_core = engine
+        # (domain, entries, divider) -> fingerprint. Rate-limit traffic is
+        # Zipfian (hot keys dominate), so memoizing descriptor hashes removes
+        # the hashing cost for the hot set; clear-on-full bounds a hostile
+        # key flood the same way the near-threshold memo does.
+        self._fp_cache: dict = {}
+        self._fp_cache_max = 1 << 17
+
+    @property
+    def _batcher(self):
+        """Test seam: the in-process engine's micro-batcher."""
+        return self._engine_core._batcher
 
     # -- RateLimitCache interface --
 
@@ -231,7 +289,7 @@ class TpuRateLimitCache:
 
         if span is not None:
             span.log_kv(event="lookup.start", batch_items=len(items))
-        for after, i in zip(self._batcher.submit(items), item_slots):
+        for after, i in zip(self._engine_core.submit(items), item_slots):
             results[i] = after
         if span is not None:
             span.log_kv(event="tpu.lookup.done", client="slab")
@@ -271,7 +329,7 @@ class TpuRateLimitCache:
         return response
 
     def flush(self) -> None:
-        self._batcher.flush()
+        self._engine_core.flush()
 
     def close(self) -> None:
-        self._batcher.close()
+        self._engine_core.close()

@@ -1,0 +1,66 @@
+"""Slab sidecar entry point: the device-owner process.
+
+Run ONE of these per TPU host, then any number of frontend servers with
+BACKEND_TYPE=tpu-sidecar sharing the same SIDECAR_SOCKET — they bind the
+serving ports together via SO_REUSEPORT and the kernel load-balances
+connections across them, while every rate-limit increment serializes
+through this process's slab (backends/sidecar.py).
+
+Honors the same TPU_* env knobs as the in-process backend: TPU_SLAB_SLOTS,
+TPU_BATCH_WINDOW (recommended: 100-500us — the cross-frontend coalescing
+window), TPU_BATCH_LIMIT, TPU_MESH_DEVICES, TPU_USE_PALLAS.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+from ..backends.sidecar import SlabSidecarServer
+from ..backends.tpu import SlabDeviceEngine
+from ..runner import setup_logging
+from ..settings import new_settings
+from ..utils.timeutil import RealTimeSource
+
+logger = logging.getLogger("ratelimit.sidecar.main")
+
+
+def main() -> None:
+    settings = new_settings()
+    setup_logging(settings)
+
+    mesh = None
+    if settings.tpu_mesh_devices > 1:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()[: settings.tpu_mesh_devices]
+        mesh = Mesh(np.array(devices), ("shard",))
+
+    engine = SlabDeviceEngine(
+        time_source=RealTimeSource(),
+        near_limit_ratio=settings.near_limit_ratio,
+        n_slots=settings.tpu_slab_slots,
+        batch_window_seconds=settings.tpu_batch_window,
+        max_batch=settings.tpu_batch_limit,
+        use_pallas=None if settings.tpu_use_pallas else False,
+        mesh=mesh,
+    )
+    server = SlabSidecarServer(settings.sidecar_socket, engine)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        logger.warning("got signal %s, shutting down sidecar", signum)
+        stop.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+        signal.signal(sig, on_signal)
+    stop.wait()
+    server.close()
+
+
+if __name__ == "__main__":
+    main()

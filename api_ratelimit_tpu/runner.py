@@ -105,6 +105,10 @@ def create_limiter(
             use_pallas=None if settings.tpu_use_pallas else False,
             mesh=mesh,
         )
+    if backend == "tpu-sidecar":
+        from .backends.sidecar import new_sidecar_cache_from_settings
+
+        return new_sidecar_cache_from_settings(settings, base)
     if backend == "memory":
         return MemoryRateLimitCache(base)
     if backend == "redis":

@@ -87,6 +87,9 @@ class Settings:
     tpu_batch_limit: int = 65536
     tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
     tpu_use_pallas: bool = True
+    # BACKEND_TYPE=tpu-sidecar: unix socket of the device-owner process
+    # (cmd/sidecar_cmd.py); lets N SO_REUSEPORT frontends share one slab
+    sidecar_socket: str = "/tmp/api-ratelimit-tpu-sidecar.sock"
 
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
@@ -138,6 +141,7 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_batch_limit", "TPU_BATCH_LIMIT", int),
     ("tpu_mesh_devices", "TPU_MESH_DEVICES", int),
     ("tpu_use_pallas", "TPU_USE_PALLAS", _parse_bool),
+    ("sidecar_socket", "SIDECAR_SOCKET", str),
 ]
 
 

@@ -114,16 +114,16 @@ class TestTpuBackend:
         for _ in range(3):
             resp = cache.do_limit(request, [limit])
         assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
-        launches_before = cache._state.count is not None  # state handle
+        launches_before = cache._engine_core._state.count is not None  # state handle
 
         # next over-limit request must come from the local cache: the slab
         # count stays at 3
         import numpy as np
 
-        count_sum_before = int(np.asarray(cache._state.count).sum())
+        count_sum_before = int(np.asarray(cache._engine_core._state.count).sum())
         resp = cache.do_limit(request, [limit])
         assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
-        assert int(np.asarray(cache._state.count).sum()) == count_sum_before
+        assert int(np.asarray(cache._engine_core._state.count).sum()) == count_sum_before
         assert limit.stats.over_limit_with_local_cache.value() == 1
 
     def test_unchecked_descriptor(self):
