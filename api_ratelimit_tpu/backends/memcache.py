@@ -181,7 +181,7 @@ class MemcacheRateLimitCache:
         for i, cache_key in enumerate(cache_keys):
             if cache_key.key == "":
                 continue
-            if self._base.is_over_limit_with_local_cache(cache_key.key):
+            if self._base.is_over_limit_with_local_cache(cache_key.key, limits[i]):
                 over_local[i] = True
                 continue
             to_fetch.append(cache_key.key)

@@ -241,7 +241,7 @@ class TpuRateLimitCache:
         for i, cache_key in enumerate(cache_keys):
             if cache_key.key == "":
                 continue
-            if self._base.is_over_limit_with_local_cache(cache_key.key):
+            if self._base.is_over_limit_with_local_cache(cache_key.key, limits[i]):
                 over_local[i] = True
                 continue
             divider = unit_to_divider(limits[i].unit)
@@ -308,6 +308,7 @@ class TpuRateLimitCache:
                 and not over_local[i]
                 and self._base.local_cache is not None
                 and limit is not None
+                and not limit.shadow_mode
                 and results[i] > limit.requests_per_unit
             ):
                 # The batched decision may have landed in a LATER fixed

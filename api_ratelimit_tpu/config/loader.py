@@ -48,6 +48,7 @@ _VALID_KEYS = frozenset(
         "requests_per_unit",
         "sleep_on_throttle",
         "report_details",
+        "shadow_mode",
     }
 )
 
@@ -86,6 +87,9 @@ def _error(file: ConfigFile, message: str) -> ConfigError:
     return ConfigError(f"{file.name}: {message}")
 
 
+_RATE_LIMIT_KEYS = frozenset({"unit", "requests_per_unit"})
+
+
 def _validate_keys(file: ConfigFile, node) -> None:
     """Generic-pass strict validation (config_impl.go:169-209)."""
     if not isinstance(node, dict):
@@ -95,6 +99,24 @@ def _validate_keys(file: ConfigFile, node) -> None:
             raise _error(file, f"config error, key is not of type string: {key}")
         if key not in _VALID_KEYS:
             raise _error(file, f"config error, unknown key '{key}'")
+        if key == "rate_limit" and isinstance(value, dict):
+            # Position-aware strictness: descriptor-level flags (shadow_mode,
+            # sleep_on_throttle, report_details) silently misplaced inside the
+            # rate_limit map would otherwise pass the flat whitelist and be
+            # ignored — an enforced rule the operator believes is staged.
+            # Genuinely unknown keys fall through to the recursive whitelist
+            # pass so they keep the reference's "unknown key" error.
+            for sub in value:
+                if (
+                    isinstance(sub, str)
+                    and sub in _VALID_KEYS
+                    and sub not in _RATE_LIMIT_KEYS
+                ):
+                    raise _error(
+                        file,
+                        f"config error, key '{sub}' is not valid inside "
+                        f"rate_limit (did you mean to put it on the descriptor?)",
+                    )
         if isinstance(value, list):
             for element in value:
                 if not isinstance(element, dict):
@@ -179,6 +201,7 @@ class RateLimitConfig:
                     new_parent_key,
                     sleep_on_throttle=bool(desc.get("sleep_on_throttle") or False),
                     report_details=bool(desc.get("report_details") or False),
+                    shadow_mode=bool(desc.get("shadow_mode") or False),
                 )
 
             child = _Node()
@@ -195,6 +218,7 @@ class RateLimitConfig:
         full_key: str,
         sleep_on_throttle: bool = False,
         report_details: bool = False,
+        shadow_mode: bool = False,
     ) -> RateLimit:
         return RateLimit(
             full_key=full_key,
@@ -202,6 +226,7 @@ class RateLimitConfig:
             limit=RateLimitValue(requests_per_unit=requests_per_unit, unit=unit),
             sleep_on_throttle=sleep_on_throttle,
             report_details=report_details,
+            shadow_mode=shadow_mode,
         )
 
     # -- lookup --

@@ -121,7 +121,13 @@ class BaseRateLimiter:
 
     # -- local cache --
 
-    def is_over_limit_with_local_cache(self, key: str) -> bool:
+    def is_over_limit_with_local_cache(self, key: str, limit: RateLimit | None = None) -> bool:
+        # A shadow-mode rule never consults the cache: an entry seeded while
+        # the rule was still enforced (then flipped by a hot reload) would
+        # otherwise short-circuit evaluation for up to a full window and
+        # fabricate the staging metrics the operator is watching.
+        if limit is not None and limit.shadow_mode:
+            return False
         return self.local_cache is not None and self.local_cache.contains(key)
 
     def expiration_seconds(self, divider: int) -> int:
@@ -152,7 +158,7 @@ class BaseRateLimiter:
             limit.stats.over_limit.add(hits_addend)
             limit.stats.over_limit_with_local_cache.add(hits_addend)
             return DescriptorStatus(
-                code=Code.OVER_LIMIT,
+                code=self._enforced_code(limit, hits_addend),
                 current_limit=limit.limit,
                 limit_remaining=0,
                 duration_until_reset=calculate_reset(limit.unit, now),
@@ -163,15 +169,17 @@ class BaseRateLimiter:
 
         if limit_info.after > limit_info.over_threshold:
             status = DescriptorStatus(
-                code=Code.OVER_LIMIT,
+                code=self._enforced_code(limit, hits_addend),
                 current_limit=limit.limit,
                 limit_remaining=0,
                 duration_until_reset=calculate_reset(limit.unit, now),
             )
             self._check_over_limit_threshold(limit_info, hits_addend)
-            if self.local_cache is not None:
+            if self.local_cache is not None and not limit.shadow_mode:
                 # TTL = the full unit duration; the window-stamped key ages out
-                # naturally at the window boundary.
+                # naturally at the window boundary. Shadow-mode rules skip the
+                # cache: its hits short-circuit evaluation, and a staged rule
+                # must keep counting real traffic.
                 self.local_cache.set(key, unit_to_divider(limit.unit))
         else:
             status = DescriptorStatus(
@@ -182,6 +190,15 @@ class BaseRateLimiter:
             )
             self._check_near_limit_threshold(limit_info, hits_addend, now, response)
         return status
+
+    @staticmethod
+    def _enforced_code(limit: RateLimit, hits_addend: int) -> Code:
+        """OVER_LIMIT, unless the rule is staged in shadow mode: then the
+        breach is counted (shadow_mode stat) but the caller is let through."""
+        if limit.shadow_mode:
+            limit.stats.shadow_mode.add(hits_addend)
+            return Code.OK
+        return Code.OVER_LIMIT
 
     @staticmethod
     def _check_over_limit_threshold(limit_info: LimitInfo, hits_addend: int) -> None:

@@ -21,12 +21,15 @@ class ConfigError(Exception):
 @dataclass(slots=True)
 class RateLimitStats:
     """Per-rule counters: total_hits / over_limit / near_limit /
-    over_limit_with_local_cache (src/config/config_impl.go:64-71)."""
+    over_limit_with_local_cache (src/config/config_impl.go:64-71), plus
+    shadow_mode — hits that would have been rejected but were let through
+    because the rule runs in shadow mode (BASELINE configs[3])."""
 
     total_hits: "Counter"
     over_limit: "Counter"
     near_limit: "Counter"
     over_limit_with_local_cache: "Counter"
+    shadow_mode: "Counter"
 
 
 def new_rate_limit_stats(scope, key: str) -> RateLimitStats:
@@ -35,6 +38,7 @@ def new_rate_limit_stats(scope, key: str) -> RateLimitStats:
         over_limit=scope.counter(key + ".over_limit"),
         near_limit=scope.counter(key + ".near_limit"),
         over_limit_with_local_cache=scope.counter(key + ".over_limit_with_local_cache"),
+        shadow_mode=scope.counter(key + ".shadow_mode"),
     )
 
 
@@ -45,6 +49,9 @@ class RateLimit:
     full_key is the dotted composite path (e.g. "domain.key_value.key2"),
     used both for stats attribution and debugging. sleep_on_throttle and
     report_details are Kentik fork extras (src/config/config.go:26-32).
+    shadow_mode evaluates and counts the rule but never enforces it: the
+    descriptor status is always OK, so operators can stage limits against
+    live traffic before turning them on.
     """
 
     full_key: str
@@ -52,6 +59,7 @@ class RateLimit:
     limit: RateLimitValue
     sleep_on_throttle: bool = False
     report_details: bool = False
+    shadow_mode: bool = False
 
     @property
     def requests_per_unit(self) -> int:

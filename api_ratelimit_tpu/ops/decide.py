@@ -16,6 +16,13 @@ branch never underflows (the unselected branch may wrap — it is discarded).
 This module is pure jnp (XLA fuses it into the surrounding program); the
 Pallas kernel in pallas_decide.py computes the identical function as a single
 VPU kernel and is used on TPU when enabled.
+
+Scope note: shadow_mode is a HOST-layer concept (limiter/base_limiter.py
+flips OVER_LIMIT to OK and counts the breach). The device decision never
+sees the flag — the production after-mode path only ships counters back and
+lets the host oracle decide, so shadow rules are handled there. Consumers of
+raw device codes (decided-mode bench, sharded step_packed) get the enforced
+code; they must not be used to serve shadow-mode rules directly.
 """
 
 from __future__ import annotations

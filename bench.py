@@ -203,6 +203,9 @@ domain: bench
 descriptors:
   - key: tight
     rate_limit: {unit: hour, requests_per_unit: 5}
+  - key: staged
+    rate_limit: {unit: hour, requests_per_unit: 5}
+    shadow_mode: true
 """
 
 
@@ -246,8 +249,13 @@ def _requests_for(config_key: str, n: int):
                 Descriptor.of(("per_sec", f"k{i % 1024}")),
                 Descriptor.of(("per_hour", f"k{i % 1024}")),
             )
-        else:  # near_limit_local_cache: few hot keys, most already over
-            descs = (Descriptor.of(("tight", f"k{i % 8}")),)
+        else:  # near_limit_local_cache (BASELINE configs[3]): few hot keys,
+            # most already over the enforced limit, plus a shadow-mode
+            # descriptor that is evaluated and counted but never enforced
+            descs = (
+                Descriptor.of(("tight", f"k{i % 8}")),
+                Descriptor.of(("staged", f"k{i % 8}")),
+            )
         reqs.append(RateLimitRequest(domain="bench", descriptors=descs))
     return reqs
 

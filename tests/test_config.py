@@ -194,6 +194,26 @@ descriptors:
     assert rl.report_details is True
 
 
+def test_shadow_mode_flag():
+    cfg, _ = make_config(
+        """
+domain: d
+descriptors:
+  - key: staged
+    rate_limit:
+      unit: minute
+      requests_per_unit: 5
+    shadow_mode: true
+  - key: live
+    rate_limit:
+      unit: minute
+      requests_per_unit: 5
+"""
+    )
+    assert cfg.get_limit("d", Descriptor.of(("staged", "x"))).shadow_mode is True
+    assert cfg.get_limit("d", Descriptor.of(("live", "x"))).shadow_mode is False
+
+
 @pytest.mark.parametrize(
     "contents,match",
     [
@@ -240,3 +260,15 @@ def test_duplicate_domain_across_files():
 def test_error_message_includes_file_name():
     with pytest.raises(ConfigError, match="error.yaml:"):
         make_config("descriptors:", name="error.yaml")
+
+
+def test_shadow_mode_misplaced_inside_rate_limit_rejected():
+    with pytest.raises(ConfigError, match="not valid inside"):
+        make_config(
+            """
+domain: d
+descriptors:
+  - key: k
+    rate_limit: {unit: minute, requests_per_unit: 5, shadow_mode: true}
+"""
+        )

@@ -227,3 +227,77 @@ class TestLocalCache:
         assert sink.gauges["localcache.lookupCount"] == 3
         assert sink.gauges["localcache.expiredCount"] == 1
         assert sink.gauges["localcache.evacuateCount"] == 1
+
+
+class TestShadowMode:
+    """shadow_mode rules are evaluated and counted but never enforced
+    (BASELINE configs[3]): breaches return OK, increment the shadow_mode
+    counter, and skip the local over-limit cache so real traffic keeps
+    being measured."""
+
+    def test_breach_returns_ok_and_counts(self, store):
+        cache, _, _ = make_cache(store)
+        limit = make_limit(store, 2, Unit.HOUR, key="sh_v", shadow_mode=True)
+        request = req(("sh", "v"))
+        for _ in range(2):
+            resp = cache.do_limit(request, [limit])
+            assert resp.descriptor_statuses[0].code == Code.OK
+        assert limit.stats.shadow_mode.value() == 0
+
+        # 3rd..4th: would be OVER_LIMIT; shadow mode lets them through.
+        for i in range(2):
+            resp = cache.do_limit(request, [limit])
+            status = resp.descriptor_statuses[0]
+            assert status.code == Code.OK
+            assert status.limit_remaining == 0
+        # over-limit attribution still recorded, plus the shadow counter
+        assert limit.stats.over_limit.value() == 2
+        assert limit.stats.shadow_mode.value() == 2
+        assert limit.stats.total_hits.value() == 4
+
+    def test_local_cache_not_poisoned(self, store):
+        cache, _, _ = make_cache(store, local_cache_size=100)
+        limit = make_limit(store, 1, Unit.HOUR, key="sh2_v", shadow_mode=True)
+        request = req(("sh2", "v"))
+        cache.do_limit(request, [limit])
+        resp = cache.do_limit(request, [limit])  # breach, shadowed
+        assert resp.descriptor_statuses[0].code == Code.OK
+        # the breach must NOT have seeded the over-limit cache: the next
+        # call still reaches the backend and still evaluates
+        assert limit.stats.over_limit_with_local_cache.value() == 0
+        resp = cache.do_limit(request, [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        assert limit.stats.over_limit_with_local_cache.value() == 0
+        assert limit.stats.shadow_mode.value() == 2
+
+    def test_enforced_rule_unaffected(self, store):
+        cache, _, _ = make_cache(store)
+        shadowed = make_limit(store, 1, Unit.HOUR, key="s_v", shadow_mode=True)
+        enforced = make_limit(store, 1, Unit.HOUR, key="e_v")
+        request = req(("s", "v"), ("e", "v"))
+        cache.do_limit(request, [shadowed, enforced])
+        resp = cache.do_limit(request, [shadowed, enforced])
+        codes = [s.code for s in resp.descriptor_statuses]
+        assert codes == [Code.OK, Code.OVER_LIMIT]
+
+    def test_reload_flip_ignores_stale_local_cache_entry(self, store):
+        # A rule enforced long enough to seed the local over-limit cache,
+        # then hot-reloaded to shadow_mode, must NOT keep short-circuiting:
+        # the staged rule has to keep evaluating real traffic.
+        cache, _, _ = make_cache(store, local_cache_size=100)
+        enforced = make_limit(store, 1, Unit.HOUR, key="flip_v")
+        request = req(("flip", "v"))
+        cache.do_limit(request, [enforced])
+        cache.do_limit(request, [enforced])  # breach -> cache seeded
+        assert enforced.stats.over_limit.value() == 1
+
+        # same rule, reloaded with shadow_mode on (new stats object, same key)
+        staged = make_limit(store, 1, Unit.HOUR, key="flip_v", shadow_mode=True)
+        resp = cache.do_limit(request, [staged])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        # evaluated for real: backend counter advanced, no local-cache hit
+        assert staged.stats.over_limit_with_local_cache.value() == 0
+        assert staged.stats.shadow_mode.value() == 1
+        # counters are shared by stats path: 1 from the enforced breach +
+        # 1 from the freshly evaluated (not cache-served) staged breach
+        assert staged.stats.over_limit.value() == 2
