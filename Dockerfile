@@ -11,13 +11,14 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
     rm -rf /var/lib/apt/lists/*
 
 WORKDIR /src
-COPY pyproject.toml README.md Makefile ./
+COPY pyproject.toml README.md Makefile requirements.txt ./
 COPY native/ native/
 COPY proto/ proto/
 COPY api_ratelimit_tpu/ api_ratelimit_tpu/
 
-# CPU wheels by default; swap for `pip install 'jax[tpu]'` on TPU hosts.
-RUN pip install --no-cache-dir jax flax optax numpy xxhash grpcio protobuf pyyaml && \
+# Pinned CPU wheels (requirements.txt is the single source CI shares);
+# swap jax for `pip install 'jax[tpu]'` on TPU hosts.
+RUN pip install --no-cache-dir -r requirements.txt && \
     make native
 
 FROM python:3.12-slim

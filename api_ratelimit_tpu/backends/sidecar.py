@@ -55,6 +55,13 @@ _U32 = struct.Struct("<I")
 
 ITEM_ROWS = 6  # fp_lo, fp_hi, hits, limit, divider, jitter
 
+# Hard protocol cap on items per SUBMIT frame. The u32 count is
+# client-supplied; without a bound a single bad frame (n=0xFFFFFFFF) would
+# make the device-owner process try to buffer ~100 GB. Anything a frontend
+# legitimately sends fits well under this (requests are a handful of items;
+# the engine's own max_batch is 64k).
+MAX_SUBMIT_ITEMS = 1 << 20
+
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -114,7 +121,15 @@ class SlabSidecarServer:
         except FileNotFoundError:
             pass
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(socket_path)
+        # Owner-only: any local process that can connect can drive arbitrary
+        # counter increments, so don't leave the default world-connectable
+        # mode. umask covers the bind itself; chmod pins the final mode.
+        prev_umask = os.umask(0o077)
+        try:
+            self._sock.bind(socket_path)
+        finally:
+            os.umask(prev_umask)
+        os.chmod(socket_path, 0o600)
         self._sock.listen(128)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
@@ -150,6 +165,14 @@ class SlabSidecarServer:
                         return
                     n_raw = _recv_exact(conn, _U32.size)
                     (n,) = _U32.unpack(n_raw)
+                    if n > MAX_SUBMIT_ITEMS:
+                        # reject BEFORE buffering the payload
+                        conn.sendall(
+                            self._error(
+                                f"submit count {n} exceeds cap {MAX_SUBMIT_ITEMS}"
+                            )
+                        )
+                        return
                     payload = n_raw + _recv_exact(conn, ITEM_ROWS * n * 4)
                     try:
                         items = decode_items(payload)

@@ -30,6 +30,10 @@ def main() -> None:
     settings = new_settings()
     setup_logging(settings)
 
+    from ..utils.jaxsetup import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
     mesh = None
     if settings.tpu_mesh_devices > 1:
         import jax

@@ -87,10 +87,28 @@ def _error(file: ConfigFile, message: str) -> ConfigError:
     return ConfigError(f"{file.name}: {message}")
 
 
+# Position-aware key sets (the strict-unmarshal analog of the reference's
+# per-struct yaml tags, config_impl.go:169-209): a KNOWN key in the WRONG
+# position — shadow_mode inside rate_limit, or unit floated up to the
+# descriptor — would silently be ignored by the loader, leaving the operator
+# with a rule that doesn't do what the file says. Unknown keys keep the
+# reference's "unknown key" error.
+_ROOT_KEYS = frozenset({"domain", "descriptors"})
+_DESCRIPTOR_KEYS = frozenset(
+    {
+        "key",
+        "value",
+        "descriptors",
+        "rate_limit",
+        "sleep_on_throttle",
+        "report_details",
+        "shadow_mode",
+    }
+)
 _RATE_LIMIT_KEYS = frozenset({"unit", "requests_per_unit"})
 
 
-def _validate_keys(file: ConfigFile, node) -> None:
+def _validate_keys(file: ConfigFile, node, allowed=_ROOT_KEYS, ctx="the file root") -> None:
     """Generic-pass strict validation (config_impl.go:169-209)."""
     if not isinstance(node, dict):
         return
@@ -99,24 +117,10 @@ def _validate_keys(file: ConfigFile, node) -> None:
             raise _error(file, f"config error, key is not of type string: {key}")
         if key not in _VALID_KEYS:
             raise _error(file, f"config error, unknown key '{key}'")
-        if key == "rate_limit" and isinstance(value, dict):
-            # Position-aware strictness: descriptor-level flags (shadow_mode,
-            # sleep_on_throttle, report_details) silently misplaced inside the
-            # rate_limit map would otherwise pass the flat whitelist and be
-            # ignored — an enforced rule the operator believes is staged.
-            # Genuinely unknown keys fall through to the recursive whitelist
-            # pass so they keep the reference's "unknown key" error.
-            for sub in value:
-                if (
-                    isinstance(sub, str)
-                    and sub in _VALID_KEYS
-                    and sub not in _RATE_LIMIT_KEYS
-                ):
-                    raise _error(
-                        file,
-                        f"config error, key '{sub}' is not valid inside "
-                        f"rate_limit (did you mean to put it on the descriptor?)",
-                    )
+        if key not in allowed:
+            raise _error(
+                file, f"config error, key '{key}' is not valid in {ctx}"
+            )
         if isinstance(value, list):
             for element in value:
                 if not isinstance(element, dict):
@@ -124,9 +128,9 @@ def _validate_keys(file: ConfigFile, node) -> None:
                         file,
                         f"config error, yaml file contains list of type other than map: {element}",
                     )
-                _validate_keys(file, element)
+                _validate_keys(file, element, _DESCRIPTOR_KEYS, "a descriptor")
         elif isinstance(value, dict):
-            _validate_keys(file, value)
+            _validate_keys(file, value, _RATE_LIMIT_KEYS, "rate_limit")
         elif isinstance(value, (str, bool, int, float)) or value is None:
             pass
         else:

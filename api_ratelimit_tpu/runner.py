@@ -152,6 +152,22 @@ class Runner:
         self.tracer = tracer_from_env()
         set_global_tracer(self.tracer)
 
+        # An explicitly pinned JAX_PLATFORMS (e.g. cpu for a host-only
+        # deployment) must beat any site-wide accelerator plugin override.
+        from .utils.jaxsetup import respect_jax_platforms_env
+
+        respect_jax_platforms_env()
+
+        # Prewarm the native host codec here, at startup, for EVERY backend:
+        # generate_cache_keys lazily triggers its build (a synchronous g++
+        # compile, up to ~2min) and the redis/memcache/memory backends would
+        # otherwise pay it inside the first large request, blowing upstream
+        # gRPC deadlines. The TPU backend prewarms in its own constructor too;
+        # available() memoizes so the second call is free.
+        from .ops import native
+
+        native.available()
+
         local_cache = None
         if settings.local_cache_size_in_bytes > 0:
             # freecache is sized in bytes; entries here are (key -> expiry)

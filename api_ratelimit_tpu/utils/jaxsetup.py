@@ -1,0 +1,27 @@
+"""Make the JAX_PLATFORMS env var authoritative.
+
+Some managed environments register a site-wide PJRT plugin from
+sitecustomize and programmatically force `jax_platforms` at import time,
+overriding the operator's JAX_PLATFORMS env var. A process the operator
+explicitly pinned to `cpu` would then still try to claim an accelerator —
+and hang if the device tunnel is down. Re-asserting the env var after
+import makes the operator's choice win.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_jax_platforms_env() -> None:
+    """If JAX_PLATFORMS is set, re-apply it over any sitecustomize override.
+
+    Call before the first jax.devices() / device_put. No-op when the env
+    var is unset (the site default — here the TPU — stays in charge).
+    """
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not want:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", want)

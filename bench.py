@@ -328,10 +328,13 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
     cache.close()
 
     result = {
-        # decisions/sec (a dual-window request makes 2 limit decisions)
+        # decisions/sec (a multi-descriptor request makes several decisions;
+        # descriptors_per_request makes cross-round workload changes visible
+        # — round 2 added the shadow descriptor to near_limit_local_cache)
         "rate": round(total * decisions_per_request / elapsed),
         "p50_ms": round(float(np.percentile(lat, 50)), 3),
         "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "descriptors_per_request": decisions_per_request,
     }
     print(f"[service:{config_key}] {result}", file=sys.stderr)
     return result

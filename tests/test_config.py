@@ -263,12 +263,28 @@ def test_error_message_includes_file_name():
 
 
 def test_shadow_mode_misplaced_inside_rate_limit_rejected():
-    with pytest.raises(ConfigError, match="not valid inside"):
+    with pytest.raises(ConfigError, match="not valid in rate_limit"):
         make_config(
             """
 domain: d
 descriptors:
   - key: k
     rate_limit: {unit: minute, requests_per_unit: 5, shadow_mode: true}
+"""
+        )
+
+
+def test_limit_keys_misplaced_on_descriptor_rejected():
+    # the mirror direction: unit/requests_per_unit floated up to the
+    # descriptor (rate_limit map omitted) must not silently load a rule
+    # with no limit at all
+    with pytest.raises(ConfigError, match="not valid in a descriptor"):
+        make_config(
+            """
+domain: d
+descriptors:
+  - key: k
+    unit: minute
+    requests_per_unit: 5
 """
         )

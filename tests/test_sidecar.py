@@ -256,3 +256,41 @@ class TestRunnerIntegration:
         finally:
             runner.stop()
             server.close()
+
+
+def test_oversized_submit_rejected_before_buffering(tmp_path):
+    """A hostile/corrupt u32 count must be refused without allocating."""
+    import os
+    import socket as socket_mod
+    import struct
+
+    from api_ratelimit_tpu.backends import sidecar as sc
+
+    class _NoopEngine:
+        def submit(self, items):
+            return [0] * len(items)
+
+        def close(self):
+            pass
+
+    path = str(tmp_path / "slab.sock")
+    server = sc.SlabSidecarServer(path, _NoopEngine())
+    try:
+        # socket is owner-only
+        assert (os.stat(path).st_mode & 0o777) == 0o600
+
+        conn = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        conn.settimeout(5)
+        conn.connect(path)
+        conn.sendall(
+            sc._HDR.pack(sc.MAGIC, sc.VERSION, sc.OP_SUBMIT, 0)
+            + struct.pack("<I", 0xFFFFFFFF)
+        )
+        status = conn.recv(1)
+        assert status == b"\x01"
+        (ln,) = struct.unpack("<I", sc._recv_exact(conn, 4))
+        message = sc._recv_exact(conn, ln).decode()
+        assert "exceeds cap" in message
+        conn.close()
+    finally:
+        server.close()
