@@ -7,8 +7,8 @@ PY ?= python
 NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
-.PHONY: all compile native proto tests tests_unit tests_integration bench \
-        serve check_config clean docker_image
+.PHONY: all compile native proto tests tests_unit tests_integration \
+        tests_with_redis bench serve check_config clean docker_image
 
 all: compile
 
@@ -34,6 +34,13 @@ tests_unit:
 # Full suite; the in-process fake Redis/Memcache servers play the role the
 # reference's local redis fleet plays (Makefile:91-125).
 tests: tests_unit
+
+# Integration tier against REAL redis-server processes (single, auth,
+# sentinel, 3-node cluster, full runner) — the analog of the reference's
+# local redis fleet (Makefile:91-125, Dockerfile.integration). Requires
+# redis-server on PATH; the module skips itself otherwise.
+tests_with_redis:
+	$(PY) -m pytest tests/test_real_redis.py -v -rs
 
 # Decisions/sec + p99 benchmark; prints one JSON line. Run on TPU.
 bench:
