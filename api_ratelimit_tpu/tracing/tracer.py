@@ -35,6 +35,9 @@ import queue
 import socket
 import threading
 import time
+import urllib.error
+import urllib.parse
+import urllib.request
 from dataclasses import dataclass, field
 
 logger = logging.getLogger("ratelimit.tracing")
@@ -45,6 +48,7 @@ TRACING_ENABLED_ENV = "K_TRACING_ENABLED"
 TRACING_HOST_ENV = "K_TRACING_HOST"
 TRACING_PORT_ENV = "K_TRACING_PORT"
 TRACING_TOKEN_ENV = "K_TRACING_TOKEN"
+TRACING_ZIPKIN_URL_ENV = "K_TRACING_ZIPKIN_URL"
 LIGHTSTEP_ENABLED_ENV = "K_TRACING_LIGHTSTEP_ENABLED"
 LIGHTSTEP_HOST_ENV = "K_TRACING_LIGHTSTEP_HOST"
 LIGHTSTEP_PORT_ENV = "K_TRACING_LIGHTSTEP_PORT"
@@ -380,6 +384,26 @@ class CollectorTracer(Tracer):
         spans = self._drain()
         if not spans:
             return
+        try:
+            self._export(spans)
+            self._warned = False  # re-arm warning after a good flush
+        except Exception as e:  # noqa: BLE001 - the flush thread must survive
+            # any exporter failure (e.g. http.client.HTTPException from a
+            # malformed collector response); tracing never takes the
+            # process — or its own flusher — down
+            if not self._warned:
+                self._warned = True
+                logger.warning(
+                    "trace export to %s failed (%s); dropping spans",
+                    self._destination(),
+                    e,
+                )
+
+    def _destination(self) -> str:
+        """Export target for operator-facing failure logs."""
+        return f"{self._host}:{self._port}"
+
+    def _export(self, spans: list[Span]) -> None:
         payload = b"".join(
             (
                 json.dumps(
@@ -400,27 +424,86 @@ class CollectorTracer(Tracer):
                     (self._host, self._port), timeout=1.0
                 )
             self._conn.sendall(payload)
-            self._warned = False  # re-arm warning after a good flush
-        except OSError as e:
+        except OSError:
             if self._conn is not None:
                 try:
                     self._conn.close()
                 except OSError:
                     pass
                 self._conn = None
-            if not self._warned:
-                self._warned = True
-                logger.warning(
-                    "trace export to %s:%d failed (%s); dropping spans",
-                    self._host,
-                    self._port,
-                    e,
-                )
+            raise
 
     def close(self, timeout: float = 1.0) -> None:
         """Bounded shutdown flush (lightstep.go:97-105, runner.go:91)."""
         self._stop.set()
         self._thread.join(timeout)
+
+
+def _zipkin_json(span: Span, service_name: str) -> dict:
+    """Zipkin v2 span JSON — the lingua franca every mainstream collector
+    ingests (zipkin, jaeger, otel-collector, tempo), standing in for the
+    reference's Lightstep satellite protocol (lightstep.go:64-77)."""
+    out = {
+        "traceId": f"{span.context.trace_id:032x}",
+        "id": f"{span.context.span_id:016x}",
+        "name": span.operation_name,
+        "timestamp": int(span.start_time * 1e6),
+        "duration": max(1, int(span.duration * 1e6)),
+        "localEndpoint": {"serviceName": service_name},
+        "tags": {k: str(v) for k, v in span.tags.items()},
+        "annotations": [
+            {
+                "timestamp": int(ts * 1e6),
+                "value": ", ".join(f"{k}={v}" for k, v in fields.items()),
+            }
+            for ts, fields in span.logs
+        ],
+    }
+    if span.parent_id:
+        out["parentId"] = f"{span.parent_id:016x}"
+    return out
+
+
+class ZipkinTracer(CollectorTracer):
+    """HTTP exporter: POSTs finished spans as Zipkin v2 JSON batches to a
+    collector endpoint (default path /api/v2/spans). Same queue / bounded
+    flush / drop-under-pressure behavior as CollectorTracer."""
+
+    def __init__(
+        self,
+        url: str,
+        token: str = "",
+        version: str = "dev",
+        max_queue: int = 4096,
+        flush_interval: float = 1.0,
+    ):
+        if "://" not in url:
+            url = "http://" + url
+        if not urllib.parse.urlparse(url).path.strip("/"):
+            url = url.rstrip("/") + "/api/v2/spans"
+        self._url = url
+        super().__init__(
+            host="",
+            port=0,
+            token=token,
+            version=version,
+            max_queue=max_queue,
+            flush_interval=flush_interval,
+        )
+
+    def _destination(self) -> str:
+        return self._url
+
+    def _export(self, spans: list[Span]) -> None:
+        payload = json.dumps(
+            [_zipkin_json(s, self._component) for s in spans]
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        request = urllib.request.Request(self._url, data=payload, headers=headers)
+        with urllib.request.urlopen(request, timeout=2.0) as resp:
+            resp.read()
 
 
 _global_tracer: Tracer = NoopTracer()
@@ -477,6 +560,14 @@ def tracer_from_env(version: str = "dev") -> Tracer:
     )
     if not enabled:
         return NoopTracer()
+    zipkin_url = os.environ.get(TRACING_ZIPKIN_URL_ENV, "").strip()
+    if zipkin_url:
+        logger.info("tracing enabled, zipkin export to %s", zipkin_url)
+        return ZipkinTracer(
+            zipkin_url,
+            token=_getenv_fallback(TRACING_TOKEN_ENV, LIGHTSTEP_TOKEN_ENV),
+            version=version,
+        )
     host = _getenv_fallback(TRACING_HOST_ENV, LIGHTSTEP_HOST_ENV)
     port = parse_int_default(
         _getenv_fallback(TRACING_PORT_ENV, LIGHTSTEP_PORT_ENV), 0
