@@ -10,8 +10,12 @@ Debug port (DEBUG_PORT=6070) mirrors server_impl.go:217-250:
   - GET /            endpoint index
   - GET /stats       current stat values (expvar equivalent)
   - GET /rlconfig    running config dump (runner.go:108-113)
-  - GET /debug/pprof/ profiling: thread stack dump (the Python analog of
-    goroutine profiles; CPU profiles come from py-spy/perf externally)
+  - GET /debug/pprof/        thread stack dump (goroutine-profile analog)
+  - GET /debug/pprof/profile?seconds=N&hz=F  on-demand CPU profile: an
+    all-thread statistical sampler in collapsed-stack format (loadable by
+    flamegraph.pl / speedscope / pprof's collapsed importer)
+  - GET /debug/pprof/heap[?top=N]  tracemalloc heap snapshot (first call
+    starts tracing)
 
 Both are stdlib ThreadingHTTPServer instances with SO_REUSEPORT, matching
 the reference's go_reuseport listeners (server_impl.go:115,131,141).
@@ -25,7 +29,9 @@ import socket
 import socketserver
 import sys
 import threading
+import time
 import traceback
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -216,8 +222,109 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
             content_type="application/json",
         )
 
+    def handle_profile(h: _Handler) -> None:
+        """On-demand CPU profile (the pprof /debug/pprof/profile analog,
+        server_impl.go:219-224): a statistical sampler over ALL threads for
+        ?seconds=N at ?hz=F, emitted in collapsed-stack ("folded") format —
+        one `frame;frame;frame count` line per distinct stack, loadable by
+        flamegraph.pl / speedscope / pprof's collapsed importer. A sampler
+        (not cProfile) because the hot path runs on worker threads, which
+        deterministic profilers can't attach to retroactively."""
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(h.path).query)
+        try:
+            seconds = min(float(query.get("seconds", ["5"])[0]), 60.0)
+            hz = min(float(query.get("hz", ["100"])[0]), 1000.0)
+        except ValueError as e:
+            h._write(400, f"bad query parameter: {e}\n".encode())
+            return
+        interval = 1.0 / max(hz, 1.0)
+        me = threading.get_ident()
+        counts: dict[tuple, int] = {}
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = []
+                while frame is not None:
+                    code = frame.f_code
+                    stack.append(
+                        f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{frame.f_lineno}:{code.co_name}"
+                    )
+                    frame = frame.f_back
+                key = tuple(reversed(stack))
+                counts[key] = counts.get(key, 0) + 1
+            time.sleep(interval)
+        body = "".join(
+            ";".join(stack) + f" {n}\n"
+            for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
+        )
+        h._write(200, body.encode())
+
+    def handle_heap(h: _Handler) -> None:
+        """Heap snapshot (the pprof /debug/pprof/heap analog) via
+        tracemalloc. First call starts tracing (near-zero baseline cost
+        until then); subsequent calls return the top allocation sites.
+        ?stop=1 turns tracing back off — allocation tracking costs real
+        throughput, so it must not stay armed forever on production
+        instances."""
+        import tracemalloc
+
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(h.path).query)
+        if query.get("stop", ["0"])[0] in ("1", "true"):
+            tracemalloc.stop()
+            h._write(
+                200,
+                json.dumps({"status": "tracemalloc stopped"}).encode(),
+                content_type="application/json",
+            )
+            return
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(10)
+            h._write(
+                200,
+                json.dumps(
+                    {
+                        "status": "tracemalloc started; call again for a "
+                        "snapshot, ?stop=1 to disarm"
+                    }
+                ).encode(),
+                content_type="application/json",
+            )
+            return
+        try:
+            top_n = min(int(query.get("top", ["50"])[0]), 500)
+        except ValueError as e:
+            h._write(400, f"bad query parameter: {e}\n".encode())
+            return
+        current, peak = tracemalloc.get_traced_memory()
+        stats = tracemalloc.take_snapshot().statistics("lineno")[:top_n]
+        h._write(
+            200,
+            json.dumps(
+                {
+                    "traced_current_bytes": current,
+                    "traced_peak_bytes": peak,
+                    "top": [
+                        {
+                            "file": s.traceback[0].filename,
+                            "line": s.traceback[0].lineno,
+                            "size_bytes": s.size,
+                            "allocations": s.count,
+                        }
+                        for s in stats
+                    ],
+                },
+                indent=2,
+            ).encode(),
+            content_type="application/json",
+        )
+
     server.add_get("/stats", handle_stats)
     server.add_get("/debug/pprof/", handle_pprof)
+    server.add_get("/debug/pprof/profile", handle_profile)
+    server.add_get("/debug/pprof/heap", handle_heap)
     server.add_get("/debug/traces", handle_traces)
     server.add_get("/", handle_index)
     return server

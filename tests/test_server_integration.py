@@ -249,6 +249,37 @@ def test_debug_endpoints(running_server):
     assert status == 200
     assert "thread" in text
 
+    # CPU profile: short sample window; collapsed-stack lines "a;b;c N".
+    # Other live threads (grpc workers, watchers) are guaranteed samples.
+    status, text = http_get(port, "/debug/pprof/profile?seconds=0.3&hz=200")
+    assert status == 200
+    lines = [line for line in text.splitlines() if line.strip()]
+    assert lines, "profiler sampled no stacks"
+    frames, count = lines[0].rsplit(" ", 1)
+    assert int(count) >= 1
+    assert ":" in frames  # file:line:func frames
+
+    # heap: first call arms tracemalloc, second returns a snapshot
+    status, text = http_get(port, "/debug/pprof/heap")
+    assert status == 200
+    if "started" in text:
+        status, text = http_get(port, "/debug/pprof/heap?top=5")
+        assert status == 200
+    snap = json.loads(text)
+    assert snap["traced_current_bytes"] >= 0
+    assert isinstance(snap["top"], list)
+
+    # bad params -> 400, not a dropped connection
+    assert http_get(port, "/debug/pprof/profile?seconds=abc")[0] == 400
+    assert http_get(port, "/debug/pprof/heap?top=x")[0] == 400
+
+    # disarm tracemalloc (it must not stay on for the process lifetime)
+    status, text = http_get(port, "/debug/pprof/heap?stop=1")
+    assert status == 200 and "stopped" in text
+    import tracemalloc
+
+    assert not tracemalloc.is_tracing()
+
     assert http_get(port, "/nope")[0] == 404
 
 
