@@ -28,6 +28,7 @@ variant (hash-sharded slab, decisions combined over ICI) behind `mesh=`.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Sequence
 
 import jax
@@ -43,7 +44,7 @@ from ..models.descriptors import RateLimitRequest
 from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint_many, split_fingerprints
-from ..ops.slab import make_slab, slab_step_after
+from ..ops.slab import make_slab, slab_live_slots, slab_step_after
 from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
 
@@ -100,11 +101,46 @@ class SlabDeviceEngine:
             self._state = jax.device_put(make_slab(n_slots), device)
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
+        self._n_slots = n_slots
+        # lossy-event counters (probe steals / in-batch contention drops):
+        # per-launch device health vectors are parked un-fetched (reading 8
+        # bytes inline would add a D2H round trip to every launch) and
+        # drained on the stats-flush cadence. _state_lock serializes state
+        # rebinds (the steps donate their input state) against the
+        # occupancy read from the stats thread.
+        self._steals_total = 0
+        self._drops_total = 0
+        self._pending_health: list = []
+        self._state_lock = threading.Lock()
         self._batcher = MicroBatcher(
             self._execute_batch,
             window_seconds=batch_window_seconds,
             max_batch=max_batch,
         )
+
+    def _drain_health_locked(self) -> None:
+        pending, self._pending_health = self._pending_health, []
+        for health in pending:
+            steals, drops = (int(v) for v in np.asarray(health))
+            self._steals_total += steals
+            self._drops_total += drops
+
+    def health_snapshot(self) -> dict:
+        """Slab health for the stats tree (VERDICT round 1 weak #5): the two
+        documented fail-open behaviors plus occupancy. live_slots is an
+        O(n_slots) device reduction — called on the stats-flush cadence."""
+        now = int(self._time_source.unix_now())
+        if self._engine is not None:
+            return self._engine.health_snapshot(now)
+        with self._state_lock:
+            self._drain_health_locked()
+            live = int(slab_live_slots(self._state, now))
+            return {
+                "steals": self._steals_total,
+                "drops": self._drops_total,
+                "live_slots": live,
+                "occupancy": live / self._n_slots,
+            }
 
     def submit(self, items: list[_Item]) -> list[int]:
         """Batched fixed-window increment; returns each item's
@@ -151,9 +187,13 @@ class SlabDeviceEngine:
             dtype = jnp.uint16
         else:
             dtype = jnp.uint32
-        self._state, after_dev = slab_step_after(
-            self._state, jax.device_put(packed, self._device), out_dtype=dtype
-        )
+        with self._state_lock:
+            self._state, after_dev, health = slab_step_after(
+                self._state, jax.device_put(packed, self._device), out_dtype=dtype
+            )
+            self._pending_health.append(health)
+            if len(self._pending_health) > 4096:
+                self._drain_health_locked()
         return np.asarray(after_dev)[:n].tolist()
 
     def _pack(self, items: list[_Item]) -> np.ndarray:
@@ -170,6 +210,36 @@ class SlabDeviceEngine:
         packed[6, 0] = np.uint32(self._time_source.unix_now())
         packed[6, 1] = np.float32(self._near_limit_ratio).view(np.uint32)
         return packed
+
+
+class SlabHealthStats:
+    """StatGenerator exporting the slab's health on every stats flush:
+
+        ratelimit.slab.steals      cumulative live-victim displacements
+        ratelimit.slab.drops       cumulative in-batch contention drops
+        ratelimit.slab.live_slots  currently live (unexpired) slots
+        ratelimit.slab.occupancy   live fraction x 1e6 (gauges are ints)
+
+    Both lossy behaviors fail open (ops/slab.py:30-39); these gauges make
+    the loss rate operable instead of silent. Works for the in-process
+    engine and the mesh-sharded engine alike (both expose
+    health_snapshot())."""
+
+    def __init__(self, engine, scope):
+        self._engine = engine
+        self._gauges = {
+            "steals": scope.gauge("steals"),
+            "drops": scope.gauge("drops"),
+            "live_slots": scope.gauge("live_slots"),
+            "occupancy": scope.gauge("occupancy"),
+        }
+
+    def generate_stats(self) -> None:
+        snap = self._engine.health_snapshot()
+        self._gauges["steals"].set(snap["steals"])
+        self._gauges["drops"].set(snap["drops"])
+        self._gauges["live_slots"].set(snap["live_slots"])
+        self._gauges["occupancy"].set(int(snap["occupancy"] * 1_000_000))
 
 
 class TpuRateLimitCache:
@@ -215,6 +285,13 @@ class TpuRateLimitCache:
         # key flood the same way the near-threshold memo does.
         self._fp_cache: dict = {}
         self._fp_cache_max = 1 << 17
+
+    @property
+    def engine(self):
+        """The device driver (SlabDeviceEngine, ShardedSlabEngine via its
+        wrapper, or a SidecarEngineClient) — the runner hangs slab health
+        stats off it when it exposes health_snapshot()."""
+        return self._engine_core
 
     @property
     def _batcher(self):

@@ -35,6 +35,8 @@ parity tests certify the sharded path as well.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.slab import (
+    COL_EXPIRE,
     PACKED_OUT_ROWS,
     ROW_WIDTH,
     SlabState,
@@ -77,13 +80,13 @@ def _owner_mask(fp_lo, fp_hi, axis: str):
 def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
     """Per-device body under shard_map. table: local shard [n_local, ROW_WIDTH];
     packed: replicated uint32[7, b]. Returns (new local shard, replicated
-    uint32[8, b] results in arrival order)."""
+    uint32[8, b] results in arrival order, uint32[2] mesh-wide health)."""
     batch, now, near_ratio = _unpack(packed)
 
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
-    state, s_before, s_after, d, order = _slab_step_sorted(
+    state, s_before, s_after, d, order, health = _slab_step_sorted(
         SlabState(table=table), batch, now, near_ratio, n_probes, use_pallas
     )
 
@@ -103,18 +106,21 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
     )
     out = _unsort(out.T, order).T
     out = jnp.where(owned[None, :], out, jnp.uint32(0))
-    return state.table, jax.lax.psum(out, axis)
+    # non-owned lanes ride through with hits=0 (invalid), so each shard's
+    # health already counts only its own keys; psum = mesh-wide totals
+    return state.table, jax.lax.psum(out, axis), jax.lax.psum(health, axis)
 
 
 def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
     """after-mode per-device body: stateful update only; psum the single
-    saturating-cast post-increment row (see ops/slab.py compact modes)."""
+    saturating-cast post-increment row (see ops/slab.py compact modes) and
+    the uint32[2] health vector."""
     batch, now, _near = _unpack(packed)
 
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
-    state, _before, s_after, _inputs, order = _slab_update_sorted(
+    state, _before, s_after, _inputs, order, health = _slab_update_sorted(
         SlabState(table=table), batch, now, n_probes
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
@@ -123,11 +129,12 @@ def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
     # smallest dtype cap fits so the host readback ships 1-2 bytes/item like
     # the single-chip path (ops/slab.py compact modes).
     summed = jax.lax.psum(after, axis)
+    health = jax.lax.psum(health, axis)
     if cap <= 0xFF:
-        return state.table, summed.astype(jnp.uint8)
+        return state.table, summed.astype(jnp.uint8), health
     if cap <= 0xFFFF:
-        return state.table, summed.astype(jnp.uint16)
-    return state.table, summed
+        return state.table, summed.astype(jnp.uint16), health
+    return state.table, summed, health
 
 
 def _build_step(mesh: Mesh, body, out_spec: P, **kw):
@@ -136,7 +143,7 @@ def _build_step(mesh: Mesh, body, out_spec: P, **kw):
         functools.partial(body, axis=axis, **kw),
         mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
-        out_specs=(P(axis, None), out_spec),
+        out_specs=(P(axis, None), out_spec, P(None)),
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -192,12 +199,31 @@ class ShardedSlabEngine:
         self._n_probes = n_probes
         self._step = sharded_slab_step(mesh, n_probes=n_probes, use_pallas=use_pallas)
         self._after_steps: dict[int, object] = {}
+        self.steals_total = 0
+        self.drops_total = 0
+        axis_name = axis
+        self._live_slots = jax.jit(
+            jax.shard_map(
+                lambda table, now: jax.lax.psum(
+                    live_slot_count(table, now), axis_name
+                ),
+                mesh=mesh,
+                in_specs=(P(axis_name, None), P()),
+                out_specs=P(),
+            )
+        )
+        # Serializes state rebinds (donating steps) against the occupancy
+        # read — without it the stats thread can hit a donated buffer.
+        self._state_lock = threading.Lock()
+        self._pending_health: list = []
 
     def step_packed(self, packed: np.ndarray) -> np.ndarray:
         """One mesh-wide launch. packed: uint32[7, b] -> uint32[8, b] results
         in arrival order (no permutation row: unsorted on device pre-psum)."""
         packed_dev = jax.device_put(packed, self._batch_sharding)
-        self._state, out = self._step(self._state, packed_dev)
+        with self._state_lock:
+            self._state, out, health = self._step(self._state, packed_dev)
+            self._note_health(health)
         return np.asarray(out)
 
     def step_after(self, packed: np.ndarray, cap: int = 0xFFFFFFFF) -> np.ndarray:
@@ -209,8 +235,42 @@ class ShardedSlabEngine:
             step = sharded_slab_step_after(self.mesh, cap, n_probes=self._n_probes)
             self._after_steps[cap] = step
         packed_dev = jax.device_put(packed, self._batch_sharding)
-        self._state, after = step(self._state, packed_dev)
+        with self._state_lock:
+            self._state, after, health = step(self._state, packed_dev)
+            self._note_health(health)
         return np.asarray(after)
+
+    def _note_health(self, health) -> None:
+        """Defer the tiny health readback off the hot path: park the device
+        array; drain when the stats flush asks (the launches are long done
+        by then, so asarray is a copy, not a sync)."""
+        self._pending_health.append(health)
+        if len(self._pending_health) > 4096:
+            self._drain_health_locked()
+
+    def _drain_health_locked(self) -> None:
+        pending, self._pending_health = self._pending_health, []
+        for health in pending:
+            steals, drops = (int(v) for v in np.asarray(health))
+            self.steals_total += steals
+            self.drops_total += drops
+
+    def health_snapshot(self, now: int | None = None) -> dict:
+        """Cumulative mesh-wide lossy-event counters + live-slot occupancy
+        (an O(n_slots) mesh reduction — stats-flush cadence only). `now` is
+        the caller's clock authority (the backend's time_source); wall clock
+        is only the fallback for direct/bench use."""
+        if now is None:
+            now = int(time.time())
+        with self._state_lock:
+            self._drain_health_locked()
+            live = int(self._live_slots(self._state, now))
+            return {
+                "steals": self.steals_total,
+                "drops": self.drops_total,
+                "live_slots": live,
+                "occupancy": live / self.n_slots_global,
+            }
 
     # Matches TpuRateLimitCache._launch_packed's contract (rows 0..7, already
     # in arrival order) so the backend can swap engines transparently.

@@ -191,6 +191,17 @@ class Runner:
         )
         cache = create_limiter(settings, base, self.stats_store)
 
+        # Slab health gauges (ratelimit.slab.*) for engines that expose a
+        # snapshot — the in-process single-chip and mesh-sharded engines do;
+        # sidecar frontends don't (the device-owner process owns the slab).
+        engine = getattr(cache, "engine", None)
+        if engine is not None and hasattr(engine, "health_snapshot"):
+            from .backends.tpu import SlabHealthStats
+
+            self.stats_store.add_stat_generator(
+                SlabHealthStats(engine, self.scope.scope("slab"))
+            )
+
         self.runtime = DirectoryRuntimeLoader(
             runtime_path=settings.runtime_path,
             runtime_subdirectory=settings.runtime_subdirectory,

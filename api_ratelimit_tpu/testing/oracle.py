@@ -1,0 +1,56 @@
+"""Exact fixed-window oracle for at-scale parity measurement.
+
+For a single-window stream of key ids with one shared limit, the exact
+decision for the i-th occurrence of a key is OVER_LIMIT iff its occurrence
+rank + 1 > limit — the slab engine's duplicate serialization makes a batch
+equivalent to sequential execution, so the cumulative occurrence rank IS
+the reference count (src/redis/fixed_cache_impl.go:26-29 semantics with a
+fixed clock).
+
+BASELINE's correctness metric is OVER_LIMIT agreement on the Zipf-10M
+stream (BASELINE.md); collisions make the slab lose counts (probe steals,
+in-batch contention drops — ops/slab.py:30-39), and every loss fails OPEN,
+so disagreements must be one-sided: the slab may say OK where the oracle
+says OVER_LIMIT, never the reverse. parity_report() measures both.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def occurrence_rank(ids: np.ndarray) -> np.ndarray:
+    """rank[i] = how many earlier stream positions hold the same id.
+    Vectorized (argsort + run detection); O(n log n)."""
+    n = ids.shape[0]
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    starts = np.r_[0, np.flatnonzero(sorted_ids[1:] != sorted_ids[:-1]) + 1]
+    run_marker = np.zeros(n, dtype=np.int64)
+    run_marker[starts] = 1
+    run_id = np.cumsum(run_marker) - 1
+    rank_sorted = np.arange(n, dtype=np.int64) - starts[run_id]
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = rank_sorted
+    return rank
+
+
+def parity_report(
+    ids: np.ndarray, got_codes: np.ndarray, limit: int, code_over: int = 2
+) -> dict:
+    """Compare engine codes against the exact oracle for a single-window
+    uniform-limit stream. Returns agreement rate plus the one-sided error
+    split (false_over MUST be 0 — the slab's losses all fail open)."""
+    want_over = occurrence_rank(ids) + 1 > limit
+    got_over = np.asarray(got_codes) == code_over
+    agree = got_over == want_over
+    n = ids.shape[0]
+    return {
+        "decisions": int(n),
+        "agreement": float(np.mean(agree)),
+        # engine said OVER where oracle says OK — must never happen
+        "false_over": int(np.sum(got_over & ~want_over)),
+        # engine failed open where oracle says OVER — the lossy-collision cost
+        "false_ok": int(np.sum(~got_over & want_over)),
+        "oracle_over_frac": float(np.mean(want_over)),
+    }

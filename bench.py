@@ -112,7 +112,7 @@ def bench_engine_zipf(device, on_tpu: bool) -> dict:
             divider=jnp.full_like(ids, 1).astype(jnp.int32),  # unit=SECOND
             jitter=jnp.zeros_like(ids).astype(jnp.int32),
         )
-        state, _before, _after, d, order = _slab_step_sorted(
+        state, _before, _after, d, order, _health = _slab_step_sorted(
             state,
             b,
             jnp.int32(now),
@@ -128,15 +128,16 @@ def bench_engine_zipf(device, on_tpu: bool) -> dict:
     for s in staged:
         s.block_until_ready()
 
-    # warmup / compile on a spare batch
+    # warmup / compile on a spare batch (its writes persist, so the parity
+    # oracle below includes it at the head of the stream)
     try:
         state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
-        np.asarray(out)
+        warm_codes = np.asarray(out)
     except Exception as e:  # pallas unavailable on this platform
         print(f"pallas path failed ({e}); jnp decide fallback", file=sys.stderr)
         use_pallas = False
         state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
-        np.asarray(out)
+        warm_codes = np.asarray(out)
 
     # timed region: launch the chain (async dispatch), overlap the 1-byte/item
     # readbacks — production hosts overlap decode with the next launch too
@@ -154,17 +155,34 @@ def bench_engine_zipf(device, on_tpu: bool) -> dict:
 
     decisions = n_batches * batch
     over_frac = float(np.mean([(f == 2).mean() for f in fetched]))
+
+    # OVER_LIMIT parity vs the exact oracle — BASELINE's correctness metric.
+    # Stream order: warmup batch first (it mutated the slab), then the timed
+    # batches; the report covers the timed decisions.
+    from api_ratelimit_tpu.testing.oracle import parity_report
+
+    stream = np.concatenate([host_ids[n_batches]] + [host_ids[i] for i in range(n_batches)])
+    codes = np.concatenate([warm_codes] + fetched)
+    full = parity_report(stream, codes, limit=100)
+    parity = {
+        "agreement": round(full["agreement"], 6),
+        "false_over": full["false_over"],
+        "false_ok": full["false_ok"],
+        "oracle_over_frac": round(full["oracle_over_frac"], 4),
+    }
+
     print(
         f"[engine] platform={device.platform} pallas={use_pallas} "
         f"batch={batch} x{n_batches} slots={n_slots} keys={n_keys} "
         f"elapsed={elapsed:.3f}s dispatch p50={np.percentile(lat, 50):.2f}ms "
-        f"over_limit_frac={over_frac:.3f}",
+        f"over_limit_frac={over_frac:.3f} parity={parity}",
         file=sys.stderr,
     )
     return {
         "rate": round(decisions / elapsed),
         "batch": batch,
         "pallas": use_pallas,
+        "parity": parity,
     }
 
 

@@ -1,0 +1,99 @@
+"""At-scale OVER_LIMIT parity: the slab engine vs the exact oracle under a
+Zipfian stream at a load factor matching the BASELINE Zipf-10M config
+(10M keys on a 2^23-slot slab ~= 1.2 keys/slot). Collision quality is a
+correctness issue at this density (SURVEY.md §7): probe steals and in-batch
+drops erode parity, and this test pins (a) a floor on agreement and (b) the
+fail-open invariant — the slab must NEVER reject a request the oracle
+would allow.
+
+The full-size run (10M keys, measured on the real stream) lives in
+bench.py's parity entry; this scaled twin keeps the same density so the
+collision behavior it certifies transfers.
+"""
+
+from __future__ import annotations
+
+import time
+
+import functools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from api_ratelimit_tpu.ops.slab import (  # noqa: E402
+    SlabBatch,
+    _slab_step_sorted,
+    _unsort,
+    make_slab,
+)
+from api_ratelimit_tpu.testing.oracle import occurrence_rank, parity_report  # noqa: E402
+
+LIMIT = 20
+BATCH = 1 << 12
+N_BATCHES = 12
+N_KEYS = 400_000
+N_SLOTS = 1 << 15  # ~1.2x denser than keys-touched; matches 10M/2^23 stress
+
+
+def _fmix(x):
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def _step(state, ids, now):
+    batch = SlabBatch(
+        fp_lo=_fmix(ids),
+        fp_hi=_fmix(ids ^ jnp.uint32(0x9E3779B9)),
+        hits=jnp.ones_like(ids),
+        limit=jnp.full_like(ids, LIMIT),
+        divider=jnp.full_like(ids, 3600).astype(jnp.int32),
+        jitter=jnp.zeros_like(ids).astype(jnp.int32),
+    )
+    state, _b, _a, d, order, health = _slab_step_sorted(
+        state, batch, now, jnp.float32(0.8), n_probes=4, use_pallas=False
+    )
+    return state, _unsort(d.code, order).astype(jnp.uint8), health
+
+
+def test_zipf_parity_at_baseline_density():
+    rng = np.random.RandomState(11)
+    ids = (rng.zipf(1.1, size=BATCH * N_BATCHES).astype(np.uint64) % N_KEYS).astype(
+        np.uint32
+    )
+    now = jnp.int32(int(time.time()))
+
+    state = make_slab(N_SLOTS)
+    codes = []
+    steals = drops = 0
+    for i in range(N_BATCHES):
+        state, out, health = _step(state, jnp.asarray(ids[i * BATCH : (i + 1) * BATCH]), now)
+        codes.append(np.asarray(out))
+        s, d = (int(v) for v in np.asarray(health))
+        steals += s
+        drops += d
+
+    report = parity_report(ids, np.concatenate(codes), LIMIT)
+    # the fail-open invariant is absolute: losses may under-count, never over
+    assert report["false_over"] == 0
+    # the oracle must actually exercise the over-limit branch for this to
+    # certify anything
+    assert report["oracle_over_frac"] > 0.1
+    # pinned floor at BASELINE density (observed ~0.999+; drops/steals at
+    # this load cost well under 1%)
+    assert report["agreement"] >= 0.995, (report, steals, drops)
+    # health counters must be consistent with any disagreement observed:
+    # every false_ok requires at least one lost write somewhere before it
+    if report["false_ok"]:
+        assert steals + drops > 0
+
+
+def test_oracle_occurrence_rank_is_exact():
+    ids = np.array([5, 5, 7, 5, 7, 9, 5], dtype=np.uint32)
+    assert occurrence_rank(ids).tolist() == [0, 1, 0, 2, 1, 0, 3]

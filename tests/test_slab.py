@@ -257,11 +257,11 @@ class TestCompactReadbackModes:
         state = make_slab(N_SLOTS)
         # limit 2/second: hits 1,1,1 in one batch -> OK, OK, OVER
         items = [(KEY_A, 1, 2, 1)] * 3 + [(KEY_B, 1, 100, 1)]
-        state, codes = slab_step_decided(state, self._packed(items, now=5_000))
+        state, codes, _health = slab_step_decided(state, self._packed(items, now=5_000))
         assert codes.dtype == jnp.uint8
         assert codes.tolist()[:4] == [1, 1, 2, 1]
         # next batch: still over for A within the window
-        state, codes = slab_step_decided(state, self._packed(items[:1], now=5_000))
+        state, codes, _health = slab_step_decided(state, self._packed(items[:1], now=5_000))
         assert codes.tolist()[:1] == [2]
 
     def test_after_mode_saturating_cast(self):
@@ -269,15 +269,63 @@ class TestCompactReadbackModes:
 
         state = make_slab(N_SLOTS)
         items = [(KEY_A, 300, 100, 1)]
-        state, after = slab_step_after(
+        state, after, _health = slab_step_after(
             state, self._packed(items, now=5_000), out_dtype=jnp.uint8
         )
         # 300 saturates the u8 cast; exactness holds because the caller only
         # picks u8 when cap > limit + hits
         assert after.dtype == jnp.uint8
         assert after.tolist()[:1] == [255]
-        state, after = slab_step_after(
+        state, after, _health = slab_step_after(
             state, self._packed([(KEY_B, 3, 100, 1)], now=5_000), out_dtype=jnp.uint16
         )
         assert after.dtype == jnp.uint16
         assert after.tolist()[:1] == [3]
+
+
+class TestSlabHealth:
+    """The slab's two documented fail-open lossy behaviors must be counted,
+    not silent (ops/slab.py:30-39): probe steals and within-batch
+    contention drops, plus the live-slot occupancy gauge."""
+
+    def test_no_loss_on_clean_traffic(self):
+        state = make_slab(N_SLOTS)
+        state, res = run(state, [(KEY_A, 1, 10, 60), (KEY_B, 1, 10, 60)], now=1000)
+        steals, drops = (int(v) for v in res.health)
+        assert (steals, drops) == (0, 0)
+
+    def test_within_batch_contention_drop_counted(self):
+        # empty 4-slot table: first probe lands on fp_lo & 3, so three
+        # distinct keys with equal fp_lo mod 4 fight for one slot; one
+        # write wins, two drop (and fail open — their counts restart)
+        state = make_slab(4)
+        keys = [(0x0 << 32) | 0x10, (0x1 << 32) | 0x20, (0x2 << 32) | 0x30]
+        state, res = run(state, [(k, 1, 10, 60) for k in keys], now=1000)
+        steals, drops = (int(v) for v in res.health)
+        assert drops == 2
+        assert steals == 0
+        # every item still got a decision (fail open)
+        assert [int(a) for a in res.after] == [1, 1, 1]
+
+    def test_probe_steal_counted(self):
+        # 2-slot table fully live with other keys: a third key finds every
+        # candidate live and non-matching -> displaces candidate 0's victim
+        state = make_slab(2)
+        state, res = run(state, [((0x5 << 32) | 0x0, 1, 10, 60)], now=1000)
+        state, res = run(state, [((0x6 << 32) | 0x1, 1, 10, 60)], now=1000)
+        assert tuple(int(v) for v in res.health) == (0, 0)
+        state, res = run(state, [((0x7 << 32) | 0x2, 1, 10, 60)], now=1000)
+        steals, drops = (int(v) for v in res.health)
+        assert steals == 1
+        assert drops == 0
+        assert int(res.after[0]) == 1  # the stealer starts fresh
+
+    def test_live_slots_occupancy(self):
+        from api_ratelimit_tpu.ops.slab import slab_live_slots
+
+        state = make_slab(N_SLOTS)
+        assert int(slab_live_slots(state, 1000)) == 0
+        state, _ = run(state, [(KEY_A, 1, 10, 60), (KEY_B, 1, 10, 60)], now=1000)
+        assert int(slab_live_slots(state, 1000)) == 2
+        # both windows expire (divider 60, no jitter): occupancy decays
+        assert int(slab_live_slots(state, 1061)) == 0

@@ -320,3 +320,35 @@ class TestMicroBatcher:
         with pytest.raises(RuntimeError, match="device on fire"):
             b.submit([1])
         b.close()
+
+
+class TestSlabHealthStats:
+    def test_health_gauges_reach_stats_tree(self, test_store):
+        from api_ratelimit_tpu.backends.tpu import SlabHealthStats
+        from api_ratelimit_tpu.models import Descriptor, RateLimitRequest
+
+        store, sink = test_store
+        ts = FakeTimeSource(1000)
+        cache = make_tpu_cache(ts)
+        limit = make_limit(store.scope("r"), 10, Unit.MINUTE, "h_v")
+        for i in range(4):
+            cache.do_limit(
+                RateLimitRequest(
+                    domain="d", descriptors=(Descriptor.of(("h", f"v{i}")),)
+                ),
+                [limit],
+            )
+        snap = cache.engine.health_snapshot()
+        assert snap["steals"] == 0 and snap["drops"] == 0
+        assert snap["live_slots"] == 4
+        assert 0 < snap["occupancy"] < 1
+
+        store.add_stat_generator(
+            SlabHealthStats(cache.engine, store.scope("ratelimit").scope("slab"))
+        )
+        store.flush()
+        assert sink.gauges["ratelimit.slab.steals"] == 0
+        assert sink.gauges["ratelimit.slab.drops"] == 0
+        assert sink.gauges["ratelimit.slab.live_slots"] == 4
+        assert sink.gauges["ratelimit.slab.occupancy"] == int(4 / (1 << 12) * 1e6)
+        cache.close()
