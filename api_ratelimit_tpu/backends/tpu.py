@@ -180,7 +180,9 @@ class SlabDeviceEngine:
         maxv = max(it.limit + it.hits for it in items)
         if self._engine is not None:
             cap = 0xFF if maxv < 255 else 0xFFFF if maxv < 65535 else 0xFFFFFFFF
-            return self._engine.step_after(packed, cap)[:n].tolist()
+            # compacted per-shard routing: each chip probes only the keys it
+            # owns (~n/n_dev items), nothing is replicated or psum'd
+            return self._engine.step_after_compact(packed, cap)[:n].tolist()
         if maxv < 255:
             dtype = jnp.uint8
         elif maxv < 65535:
