@@ -113,7 +113,12 @@ class SlabSidecarServer:
     socket; each SUBMIT runs through the engine's micro-batcher, which
     coalesces items from every connected frontend into shared launches."""
 
-    def __init__(self, socket_path: str, engine):
+    def __init__(self, socket_path: str, engine, socket_mode: int = 0o600):
+        """socket_mode: filesystem mode for the socket node. Default 0o600
+        restricts to same-UID frontends; pass 0o660 and place the socket in
+        a directory owned by a shared group for split-UID deployments. Any
+        process that can connect can drive arbitrary counter increments, so
+        never leave the default world-connectable mode."""
         self._engine = engine
         self._path = socket_path
         try:
@@ -121,15 +126,13 @@ class SlabSidecarServer:
         except FileNotFoundError:
             pass
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        # Owner-only: any local process that can connect can drive arbitrary
-        # counter increments, so don't leave the default world-connectable
-        # mode. umask covers the bind itself; chmod pins the final mode.
-        prev_umask = os.umask(0o077)
-        try:
-            self._sock.bind(socket_path)
-        finally:
-            os.umask(prev_umask)
-        os.chmod(socket_path, 0o600)
+        # bind-then-chmod (no umask games: umask is process-wide and would
+        # leak 0o077 onto files other threads create during the window).
+        # Linux checks AF_UNIX connect permissions at connect time against
+        # the current node mode, so the pre-chmod window is closed by the
+        # chmod landing before listen() accepts anyone.
+        self._sock.bind(socket_path)
+        os.chmod(socket_path, socket_mode)
         self._sock.listen(128)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(

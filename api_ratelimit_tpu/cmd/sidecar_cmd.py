@@ -52,7 +52,9 @@ def main() -> None:
         use_pallas=None if settings.tpu_use_pallas else False,
         mesh=mesh,
     )
-    server = SlabSidecarServer(settings.sidecar_socket, engine)
+    server = SlabSidecarServer(
+        settings.sidecar_socket, engine, socket_mode=settings.sidecar_socket_mode
+    )
 
     stop = threading.Event()
 

@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.slab import (
     PACKED_OUT_ROWS,
+    ROW_FP_HI,
+    ROW_FP_LO,
     ROW_HITS,
     ROW_SCALARS,
     ROW_WIDTH,
@@ -307,8 +309,12 @@ class ShardedSlabEngine:
         if valid_idx.size == 0:
             return out
 
+        # MUST mirror _owner_mask's device-side formula ((fp_lo ^ fp_hi) mod
+        # n_dev) exactly — a mismatch silently routes keys to shards that
+        # don't own them and corrupts counters.
         owner = (
-            (packed[0, valid_idx] ^ packed[1, valid_idx]) % np.uint32(n_dev)
+            (packed[ROW_FP_LO, valid_idx] ^ packed[ROW_FP_HI, valid_idx])
+            % np.uint32(n_dev)
         ).astype(np.int64)
         counts = np.bincount(owner, minlength=n_dev)
         # power-of-two bucket >= the fullest shard (>=128 for lane alignment)

@@ -14,8 +14,9 @@ Debug port (DEBUG_PORT=6070) mirrors server_impl.go:217-250:
   - GET /debug/pprof/profile?seconds=N&hz=F  on-demand CPU profile: an
     all-thread statistical sampler in collapsed-stack format (loadable by
     flamegraph.pl / speedscope / pprof's collapsed importer)
-  - GET /debug/pprof/heap[?top=N]  tracemalloc heap snapshot (first call
-    starts tracing)
+  - GET /debug/pprof/heap[?top=N]  tracemalloc heap snapshot. Arming is an
+    explicit opt-in: ?start=1 begins tracing, a later plain GET returns the
+    snapshot, ?stop=1 disarms; a bare GET never changes state
 
 Both are stdlib ThreadingHTTPServer instances with SO_REUSEPORT, matching
 the reference's go_reuseport listeners (server_impl.go:115,131,141).
@@ -222,6 +223,11 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
             content_type="application/json",
         )
 
+    # One profile at a time (pprof semantics): N concurrent sampling loops
+    # would each poll sys._current_frames() under the GIL, multiplying the
+    # serve-path cost of a single profile by N.
+    profile_running = threading.Lock()
+
     def handle_profile(h: _Handler) -> None:
         """On-demand CPU profile (the pprof /debug/pprof/profile analog,
         server_impl.go:219-224): a statistical sampler over ALL threads for
@@ -230,6 +236,15 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
         flamegraph.pl / speedscope / pprof's collapsed importer. A sampler
         (not cProfile) because the hot path runs on worker threads, which
         deterministic profilers can't attach to retroactively."""
+        if not profile_running.acquire(blocking=False):
+            h._write(429, b"a profile is already running; retry later\n")
+            return
+        try:
+            _run_profile(h)
+        finally:
+            profile_running.release()
+
+    def _run_profile(h: _Handler) -> None:
         query = urllib.parse.parse_qs(urllib.parse.urlparse(h.path).query)
         try:
             seconds = min(float(query.get("seconds", ["5"])[0]), 60.0)
@@ -264,30 +279,44 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
 
     def handle_heap(h: _Handler) -> None:
         """Heap snapshot (the pprof /debug/pprof/heap analog) via
-        tracemalloc. First call starts tracing (near-zero baseline cost
-        until then); subsequent calls return the top allocation sites.
-        ?stop=1 turns tracing back off — allocation tracking costs real
-        throughput, so it must not stay armed forever on production
-        instances."""
+        tracemalloc. Arming is an explicit opt-in — ?start=1 begins tracing,
+        a later plain GET returns the top allocation sites, ?stop=1 disarms.
+        A bare GET never changes state (a metrics scraper or the endpoint
+        index crawler hitting this URL must not leave allocation tracking —
+        which costs real throughput — armed forever)."""
         import tracemalloc
 
         query = urllib.parse.parse_qs(urllib.parse.urlparse(h.path).query)
         if query.get("stop", ["0"])[0] in ("1", "true"):
-            tracemalloc.stop()
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
             h._write(
                 200,
                 json.dumps({"status": "tracemalloc stopped"}).encode(),
                 content_type="application/json",
             )
             return
-        if not tracemalloc.is_tracing():
-            tracemalloc.start(10)
+        if query.get("start", ["0"])[0] in ("1", "true"):
+            if not tracemalloc.is_tracing():
+                tracemalloc.start(10)
             h._write(
                 200,
                 json.dumps(
                     {
-                        "status": "tracemalloc started; call again for a "
+                        "status": "tracemalloc armed; GET again for a "
                         "snapshot, ?stop=1 to disarm"
+                    }
+                ).encode(),
+                content_type="application/json",
+            )
+            return
+        if not tracemalloc.is_tracing():
+            h._write(
+                200,
+                json.dumps(
+                    {
+                        "status": "tracemalloc not armed; GET ?start=1 to "
+                        "begin tracing (read-only GETs never arm it)"
                     }
                 ).encode(),
                 content_type="application/json",

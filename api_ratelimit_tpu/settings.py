@@ -90,6 +90,9 @@ class Settings:
     # BACKEND_TYPE=tpu-sidecar: unix socket of the device-owner process
     # (cmd/sidecar_cmd.py); lets N SO_REUSEPORT frontends share one slab
     sidecar_socket: str = "/tmp/api-ratelimit-tpu-sidecar.sock"
+    # socket node mode (octal string, e.g. "0660" + a shared-group socket
+    # dir for frontends running under a different UID than the device owner)
+    sidecar_socket_mode: int = 0o600
 
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
@@ -142,6 +145,7 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_mesh_devices", "TPU_MESH_DEVICES", int),
     ("tpu_use_pallas", "TPU_USE_PALLAS", _parse_bool),
     ("sidecar_socket", "SIDECAR_SOCKET", str),
+    ("sidecar_socket_mode", "SIDECAR_SOCKET_MODE", lambda raw: int(raw, 8)),
 ]
 
 

@@ -259,12 +259,19 @@ def test_debug_endpoints(running_server):
     assert int(count) >= 1
     assert ":" in frames  # file:line:func frames
 
-    # heap: first call arms tracemalloc, second returns a snapshot
+    # heap: a bare GET is side-effect-free (scrapers must not arm
+    # tracemalloc); ?start=1 arms, a later GET returns the snapshot
+    import tracemalloc
+
+    if tracemalloc.is_tracing():  # PYTHONTRACEMALLOC pre-arms it
+        tracemalloc.stop()
     status, text = http_get(port, "/debug/pprof/heap")
+    assert status == 200 and "not armed" in text
+    assert not tracemalloc.is_tracing()
+    status, text = http_get(port, "/debug/pprof/heap?start=1")
+    assert status == 200 and "armed" in text
+    status, text = http_get(port, "/debug/pprof/heap?top=5")
     assert status == 200
-    if "started" in text:
-        status, text = http_get(port, "/debug/pprof/heap?top=5")
-        assert status == 200
     snap = json.loads(text)
     assert snap["traced_current_bytes"] >= 0
     assert isinstance(snap["top"], list)
