@@ -191,7 +191,10 @@ class SlabDeviceEngine:
             dtype = jnp.uint32
         with self._state_lock:
             self._state, after_dev, health = slab_step_after(
-                self._state, jax.device_put(packed, self._device), out_dtype=dtype
+                self._state,
+                jax.device_put(packed, self._device),
+                out_dtype=dtype,
+                use_pallas=self._use_pallas,
             )
             self._pending_health.append(health)
             if len(self._pending_health) > 4096:

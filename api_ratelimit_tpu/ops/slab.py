@@ -141,6 +141,10 @@ def _slab_update_sorted(
     now: jnp.ndarray,  # int32 scalar
     n_probes: int,
     count_health: bool = True,
+    use_pallas: bool = False,
+    near_ratio: jnp.ndarray | None = None,  # float32 scalar, fused decide only
+    fuse_decide: bool = False,
+    interpret: bool = False,
 ):
     """The stateful core: probe, serialize duplicates, window-reset,
     increment, one row-scatter. Returns sorted before/after counters, the
@@ -154,8 +158,16 @@ def _slab_update_sorted(
     making the cost explicit, not a hidden win. (Measured on 1-core CPU at
     2^13 batch: ~1.4% — the r2 "regression" was the bench's too-short timed
     region, fixed in bench.py.) Production after-mode keeps counting on.
-    No decision math — callers either decide on device (_slab_step_sorted)
-    or ship `after` to the host and reuse the BaseRateLimiter oracle."""
+    use_pallas=True swaps the update math between the gathers — the
+    segmented scans, window rollover, increment, and (with fuse_decide) the
+    decision — for the fused Pallas INCRBY kernel (ops/pallas_slab.py); the
+    probe gather, sort, stored-row gather, and row scatter stay XLA in both
+    paths (they compile to the TPU's native dynamic gather/scatter, which a
+    kernel cannot beat). Returns an extra trailing element: the fused
+    DecideResult (sorted order) when fuse_decide, else None.
+    Without fuse_decide there is no decision math — callers either decide on
+    device (_slab_step_sorted) or ship `after` to the host and reuse the
+    BaseRateLimiter oracle."""
     n = state.n_slots
     now = now.astype(jnp.int32)
 
@@ -178,31 +190,68 @@ def _slab_update_sorted(
         & (s_fp_hi[1:] == s_fp_hi[:-1])
     )
     seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
-    incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
-    excl = incl - s_hits
-    # forward-fill each segment's starting exclusive-sum (excl is
-    # nondecreasing, so a running max of masked values is a forward fill)
-    seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
-    prior_in_batch = excl - seg_base_excl
 
     # --- stored slot rows (clamped gather; padding reads are discarded) ---
     g_slot = jnp.minimum(s_slot, n - 1)
     st_rows = state.table[g_slot]  # (b, ROW_WIDTH) — one gather
-    st_count = st_rows[:, COL_COUNT]
-    st_window = st_rows[:, COL_WINDOW].astype(jnp.int32)
-    st_expire = st_rows[:, COL_EXPIRE].astype(jnp.int32)
-    st_fp_lo = st_rows[:, COL_FP_LO]
-    st_fp_hi = st_rows[:, COL_FP_HI]
 
-    safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
-    cur_window = (now // safe_div) * safe_div
-    slot_live = st_expire > now
-    fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
-    same_window = st_window == cur_window
-    base = jnp.where(fp_match & same_window, st_count, jnp.uint32(0))
+    decision = None
+    if use_pallas:
+        from .decide import DecideResult
+        from .pallas_slab import pallas_slab_apply
 
-    s_before = base + prior_in_batch
-    s_after = s_before + s_hits
+        st_t = st_rows[:, : COL_EXPIRE + 1].T  # (5, b): fp_lo/hi/count/win/exp
+        outs = pallas_slab_apply(
+            s_fp_lo,
+            s_fp_hi,
+            s_hits,
+            s_limit,
+            s_div,
+            s_jit,
+            seg_start,
+            st_t,
+            now,
+            jnp.float32(0.8) if near_ratio is None else near_ratio,
+            decide=fuse_decide,
+            interpret=interpret,
+        )
+        s_before = outs[0].astype(jnp.uint32)
+        s_after = outs[1].astype(jnp.uint32)
+        cur_window = outs[2]
+        expire_at = outs[3]
+        if fuse_decide:
+            decision = DecideResult(
+                code=outs[4],
+                limit_remaining=outs[5].astype(jnp.uint32),
+                duration_until_reset=outs[6],
+                throttle_millis=outs[7].astype(jnp.uint32),
+                near_delta=outs[8].astype(jnp.uint32),
+                over_delta=outs[9].astype(jnp.uint32),
+            )
+    else:
+        incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+        excl = incl - s_hits
+        # forward-fill each segment's starting exclusive-sum (excl is
+        # nondecreasing, so a running max of masked values is a forward fill)
+        seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+        prior_in_batch = excl - seg_base_excl
+
+        st_count = st_rows[:, COL_COUNT]
+        st_window = st_rows[:, COL_WINDOW].astype(jnp.int32)
+        st_expire = st_rows[:, COL_EXPIRE].astype(jnp.int32)
+        st_fp_lo = st_rows[:, COL_FP_LO]
+        st_fp_hi = st_rows[:, COL_FP_HI]
+
+        safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
+        cur_window = (now // safe_div) * safe_div
+        slot_live = st_expire > now
+        fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
+        same_window = st_window == cur_window
+        base = jnp.where(fp_match & same_window, st_count, jnp.uint32(0))
+
+        s_before = base + prior_in_batch
+        s_after = s_before + s_hits
+        expire_at = now + safe_div + s_jit
 
     # --- one row write per SLOT: the final item in the slot's run ---
     is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
@@ -232,7 +281,7 @@ def _slab_update_sorted(
             s_fp_hi,
             s_after,
             cur_window.astype(jnp.uint32),
-            (now + s_div + s_jit).astype(jnp.uint32),
+            expire_at.astype(jnp.uint32),
             jnp.zeros_like(s_fp_lo),
             jnp.zeros_like(s_fp_lo),
             jnp.zeros_like(s_fp_lo),
@@ -251,6 +300,7 @@ def _slab_update_sorted(
         (s_hits, s_limit, s_div),
         order,
         health,
+        decision,
     )
 
 
@@ -262,21 +312,31 @@ def _slab_step_sorted(
     n_probes: int,
     use_pallas: bool,
     count_health: bool = True,
+    interpret: bool = False,
 ):
     """Core step with on-device decision; returns results in slot-sorted
     order plus the permutation (callers unsort on device or on the host)
-    and the uint32[2] (steals, drops) health vector."""
+    and the uint32[2] (steals, drops) health vector. use_pallas=True runs
+    the fused Pallas INCRBY+decide kernel (ops/pallas_slab.py) for
+    everything between the gathers; False runs the XLA twin with the jnp
+    decide math."""
     now = now.astype(jnp.int32)
-    state, s_before, s_after, (s_hits, s_limit, s_div), order, health = (
-        _slab_update_sorted(state, batch, now, n_probes, count_health)
+    state, s_before, s_after, (s_hits, s_limit, s_div), order, health, fused = (
+        _slab_update_sorted(
+            state,
+            batch,
+            now,
+            n_probes,
+            count_health,
+            use_pallas=use_pallas,
+            near_ratio=near_ratio,
+            fuse_decide=use_pallas,
+            interpret=interpret,
+        )
     )
 
-    if use_pallas:
-        from .pallas_decide import pallas_decide
-
-        decision = pallas_decide(
-            s_before, s_after, s_hits, s_limit, s_div, now, near_ratio
-        )
+    if fused is not None:
+        decision = fused
     else:
         decision = decide(
             before=s_before,
@@ -409,20 +469,24 @@ def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray]:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_probes", "out_dtype"), donate_argnames=("state",)
+    jax.jit,
+    static_argnames=("n_probes", "out_dtype", "use_pallas"),
+    donate_argnames=("state",),
 )
 def slab_step_after(
     state: SlabState,
     packed: jnp.ndarray,  # uint32[7, b]
     n_probes: int = 4,
     out_dtype=jnp.uint32,
+    use_pallas: bool = False,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Stateful update only; returns (post-increment counters in arrival
     order, saturating-cast to out_dtype, uint32[2] health). The caller
-    guarantees max(limit) + max(hits) < dtype max."""
+    guarantees max(limit) + max(hits) < dtype max. use_pallas runs the
+    fused INCRBY kernel (no decide outputs) for the update math."""
     batch, now, _ = _unpack(packed)
-    state, _before, s_after, _inputs, order, health = _slab_update_sorted(
-        state, batch, now, n_probes
+    state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
+        state, batch, now, n_probes, use_pallas=use_pallas
     )
     after = _unsort(s_after, order)
     cap = jnp.uint32(jnp.iinfo(out_dtype).max)

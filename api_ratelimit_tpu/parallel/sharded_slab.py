@@ -115,7 +115,9 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
     return state.table, jax.lax.psum(out, axis), jax.lax.psum(health, axis)
 
 
-def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
+def _sharded_body_after(
+    table, packed, *, n_probes: int, cap: int, use_pallas: bool, axis: str
+):
     """after-mode per-device body: stateful update only; psum the single
     saturating-cast post-increment row (see ops/slab.py compact modes) and
     the uint32[2] health vector."""
@@ -124,8 +126,8 @@ def _sharded_body_after(table, packed, *, n_probes: int, cap: int, axis: str):
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
-    state, _before, s_after, _inputs, order, health = _slab_update_sorted(
-        SlabState(table=table), batch, now, n_probes
+    state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
+        SlabState(table=table), batch, now, n_probes, use_pallas=use_pallas
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     after = jnp.where(owned, after, jnp.uint32(0))
@@ -162,10 +164,19 @@ def sharded_slab_step(mesh: Mesh, n_probes: int = 4, use_pallas: bool = False):
     )
 
 
-def sharded_slab_step_after(mesh: Mesh, cap: int, n_probes: int = 4):
+def sharded_slab_step_after(
+    mesh: Mesh, cap: int, n_probes: int = 4, use_pallas: bool = False
+):
     """Build the jitted mesh-wide after-mode step: (state, packed) ->
     (state, after[b] saturated at cap), the production readback path."""
-    return _build_step(mesh, _sharded_body_after, P(None), n_probes=n_probes, cap=cap)
+    return _build_step(
+        mesh,
+        _sharded_body_after,
+        P(None),
+        n_probes=n_probes,
+        cap=cap,
+        use_pallas=use_pallas,
+    )
 
 
 # --- compacted per-shard mode ------------------------------------------------
@@ -186,13 +197,15 @@ def sharded_slab_step_after(mesh: Mesh, cap: int, n_probes: int = 4):
 # case b: one shard does all the work, which is what the data demanded).
 
 
-def _sharded_body_after_compact(table, block, *, n_probes: int, cap: int, axis: str):
+def _sharded_body_after_compact(
+    table, block, *, n_probes: int, cap: int, use_pallas: bool, axis: str
+):
     """block: [1, 7, bucket] — this device's own bucket only. No owner
     masking needed: the host routed every item here because this shard owns
     it. Returns ([1, bucket] saturated counters, mesh-summed health)."""
     batch, now, _near = _unpack(block[0])
-    state, _before, s_after, _inputs, order, health = _slab_update_sorted(
-        SlabState(table=table), batch, now, n_probes
+    state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
+        SlabState(table=table), batch, now, n_probes, use_pallas=use_pallas
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     health = jax.lax.psum(health, axis)
@@ -203,14 +216,20 @@ def _sharded_body_after_compact(table, block, *, n_probes: int, cap: int, axis: 
     return state.table, after[None, :], health
 
 
-def sharded_slab_step_after_compact(mesh: Mesh, cap: int, n_probes: int = 4):
+def sharded_slab_step_after_compact(
+    mesh: Mesh, cap: int, n_probes: int = 4, use_pallas: bool = False
+):
     """(state, blocks[n_dev, 7, bucket]) -> (state, after[n_dev, bucket],
     health[2]); state and blocks sharded on the leading axis, after sharded
     the same way (the host gathers and unscatters), health replicated."""
     axis = mesh.axis_names[0]
     mapped = jax.shard_map(
         functools.partial(
-            _sharded_body_after_compact, axis=axis, n_probes=n_probes, cap=cap
+            _sharded_body_after_compact,
+            axis=axis,
+            n_probes=n_probes,
+            cap=cap,
+            use_pallas=use_pallas,
         ),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None, None)),
@@ -252,6 +271,7 @@ class ShardedSlabEngine:
             self._state_sharding,
         )
         self._n_probes = n_probes
+        self._use_pallas = use_pallas
         self._step = sharded_slab_step(mesh, n_probes=n_probes, use_pallas=use_pallas)
         self._after_steps: dict[int, object] = {}
         self._compact_steps: dict[int, object] = {}
@@ -289,7 +309,9 @@ class ShardedSlabEngine:
         see ops/slab.py compact modes)."""
         step = self._after_steps.get(cap)
         if step is None:
-            step = sharded_slab_step_after(self.mesh, cap, n_probes=self._n_probes)
+            step = sharded_slab_step_after(
+                self.mesh, cap, n_probes=self._n_probes, use_pallas=self._use_pallas
+            )
             self._after_steps[cap] = step
         packed_dev = jax.device_put(packed, self._batch_sharding)
         with self._state_lock:
@@ -339,7 +361,10 @@ class ShardedSlabEngine:
         step = self._compact_steps.get(cap)
         if step is None:
             step = sharded_slab_step_after_compact(
-                self.mesh, cap, n_probes=self._n_probes
+                self.mesh,
+                cap,
+                n_probes=self._n_probes,
+                use_pallas=self._use_pallas,
             )
             self._compact_steps[cap] = step
         blocks_dev = jax.device_put(blocks, self._blocks_sharding)

@@ -161,36 +161,48 @@ def bench_engine_zipf(device, on_tpu: bool) -> dict:
         )
         return state, _unsort(d.code, order).astype(jnp.uint8)
 
-    state = jax.device_put(make_slab(n_slots), device)
     host_ids = zipf_ids(n_keys, batch, n_batches + 1)
     staged = [jax.device_put(host_ids[i], device) for i in range(n_batches + 1)]
     for s in staged:
         s.block_until_ready()
 
-    # warmup / compile on a spare batch (its writes persist, so the parity
-    # oracle below includes it at the head of the stream)
-    try:
-        state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
-        warm_codes = np.asarray(out)
-    except Exception as e:  # pallas unavailable on this platform
-        print(f"pallas path failed ({e}); jnp decide fallback", file=sys.stderr)
-        use_pallas = False
-        state, out = bench_step(state, staged[-1], use_pallas=use_pallas)
-        warm_codes = np.asarray(out)
+    def run_path(pallas_flag: bool):
+        """Fresh slab -> warmup batch -> timed chain. Returns (elapsed,
+        warm codes, per-batch codes, dispatch latencies)."""
+        state = jax.device_put(make_slab(n_slots), device)
+        state, out = bench_step(state, staged[-1], use_pallas=pallas_flag)
+        warm = np.asarray(out)
+        # timed region: launch the chain (async dispatch), overlap the
+        # 1-byte/item readbacks — production hosts overlap decode with the
+        # next launch too
+        t0 = time.perf_counter()
+        outs = []
+        lat = []
+        for i in range(n_batches):
+            s = time.perf_counter()
+            state, out = bench_step(state, staged[i], use_pallas=pallas_flag)
+            outs.append(out)
+            lat.append((time.perf_counter() - s) * 1e3)
+        with ThreadPoolExecutor(4) as ex:
+            fetched = list(ex.map(np.asarray, outs))
+        return time.perf_counter() - t0, warm, fetched, lat
 
-    # timed region: launch the chain (async dispatch), overlap the 1-byte/item
-    # readbacks — production hosts overlap decode with the next launch too
-    t0 = time.perf_counter()
-    outs = []
-    lat = []
-    for i in range(n_batches):
-        s = time.perf_counter()
-        state, out = bench_step(state, staged[i], use_pallas=use_pallas)
-        outs.append(out)
-        lat.append((time.perf_counter() - s) * 1e3)
-    with ThreadPoolExecutor(4) as ex:
-        fetched = list(ex.map(np.asarray, outs))
-    elapsed = time.perf_counter() - t0
+    pallas_error = None
+    if use_pallas:
+        try:
+            elapsed, warm_codes, fetched, lat = run_path(True)
+        except Exception as e:  # Mosaic/pallas unavailable on this platform
+            pallas_error = str(e)[-300:]
+            print(f"pallas path failed ({e}); XLA update fallback", file=sys.stderr)
+            use_pallas = False
+    if not use_pallas:
+        elapsed, warm_codes, fetched, lat = run_path(False)
+
+    # On the chip, also time the XLA-update twin so the kernel's win (or
+    # loss) vs the lax.sort+scan path is a recorded number (VERDICT r2 #2).
+    xla_elapsed = None
+    if use_pallas:
+        xla_elapsed, _, _, _ = run_path(False)
 
     decisions = n_batches * batch
     over_frac = float(np.mean([(f == 2).mean() for f in fetched]))
@@ -217,12 +229,17 @@ def bench_engine_zipf(device, on_tpu: bool) -> dict:
         f"over_limit_frac={over_frac:.3f} parity={parity}",
         file=sys.stderr,
     )
-    return {
+    result = {
         "rate": round(decisions / elapsed),
         "batch": batch,
         "pallas": use_pallas,
         "parity": parity,
     }
+    if xla_elapsed is not None:
+        result["rate_xla_update"] = round(decisions / xla_elapsed)
+    if pallas_error is not None:
+        result["pallas_error"] = pallas_error
+    return result
 
 
 # ---------------- service-level benches (configs[0..3]) ----------------
