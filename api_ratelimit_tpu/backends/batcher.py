@@ -11,14 +11,26 @@ caps the wait), executes the batch callback once, and distributes results.
 window=0 degenerates to direct mode: the caller executes its own items
 immediately under the dispatch lock — lowest latency, no cross-request
 amortization (exactly like an unset pipeline window in the reference).
+
+Double-buffered mode (execute_launch/execute_collect provided): the
+dispatcher splits each batch into a fast LAUNCH (pack + async device
+dispatch, returns a token) and a blocking COLLECT (device readback), and a
+separate collector thread drains collects. Launch k+1 thus overlaps batch
+k's readback — the TPU analog of the reference keeping the next pipeline
+writing while the previous one's replies drain off the wire
+(src/redis/driver_impl.go:84-90). max_inflight bounds queued collects so
+latency stays bounded under backpressure.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
+
+_CLOSE = object()
 
 
 class MicroBatcher:
@@ -27,6 +39,9 @@ class MicroBatcher:
         execute: Callable[[list], list],
         window_seconds: float = 0.0,
         max_batch: int = 8192,
+        execute_launch: Callable[[list], Any] | None = None,
+        execute_collect: Callable[[Any], list] | None = None,
+        max_inflight: int = 2,
     ):
         self._execute = execute
         self._window = float(window_seconds)
@@ -42,7 +57,18 @@ class MicroBatcher:
         self._last_end = float("-inf")  # monotonic end of the last execute
         self._idle = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
+        self._collector: threading.Thread | None = None
+        self._collect_q: queue.Queue | None = None
+        pipelined = execute_launch is not None and execute_collect is not None
+        self._execute_launch = execute_launch
+        self._execute_collect = execute_collect
         if self._window > 0:
+            if pipelined:
+                self._collect_q = queue.Queue(maxsize=max(1, int(max_inflight)))
+                self._collector = threading.Thread(
+                    target=self._collect_loop, name="tpu-collector", daemon=True
+                )
+                self._collector.start()
             self._thread = threading.Thread(
                 target=self._loop, name="tpu-batcher", daemon=True
             )
@@ -92,6 +118,8 @@ class MicroBatcher:
             self._wakeup.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
+        if self._collector is not None:
+            self._collector.join(timeout=1.0)
 
     # -- dispatcher --
 
@@ -102,7 +130,7 @@ class MicroBatcher:
                     self._wakeup.wait()
                 if self._closed and not self._items:
                     self._idle.notify_all()
-                    return
+                    break
                 # linger up to `window` for stragglers unless already full.
                 # Warm pipeline: items enqueued while the previous batch was
                 # executing have already waited >= one launch — launch them
@@ -137,6 +165,21 @@ class MicroBatcher:
                 ]
                 self._inflight += 1
 
+            if self._collect_q is not None:
+                # double-buffered: launch now (fast), hand the blocking
+                # readback to the collector; the bounded put is the
+                # backpressure that caps in-flight launches
+                try:
+                    token = self._execute_launch(items)
+                except BaseException as e:  # noqa: BLE001 - propagate
+                    for future, _, _ in futures:
+                        if not future.done():
+                            future.set_exception(e)
+                    self._finish_one()
+                else:
+                    self._collect_q.put((token, futures))
+                continue
+
             try:
                 results = self._execute(items)
                 for future, start, count in futures:
@@ -145,9 +188,34 @@ class MicroBatcher:
                 for future, _, _ in futures:
                     if not future.done():
                         future.set_exception(e)
+            self._finish_one()
 
-            with self._lock:
-                self._last_end = time.monotonic()
-                self._inflight -= 1
-                if not self._items and not self._futures and not self._inflight:
-                    self._idle.notify_all()
+        # shutdown: the _CLOSE put happens OUTSIDE self._lock — the bounded
+        # queue may be full, and the collector needs the lock (in
+        # _finish_one) to drain a slot; putting under the lock would
+        # deadlock close() with collects in flight.
+        if self._collect_q is not None:
+            self._collect_q.put(_CLOSE)
+
+    def _finish_one(self) -> None:
+        with self._lock:
+            self._last_end = time.monotonic()
+            self._inflight -= 1
+            if not self._items and not self._futures and not self._inflight:
+                self._idle.notify_all()
+
+    def _collect_loop(self) -> None:
+        while True:
+            entry = self._collect_q.get()
+            if entry is _CLOSE:
+                return
+            token, futures = entry
+            try:
+                results = self._execute_collect(token)
+                for future, start, count in futures:
+                    future.set_result(results[start : start + count])
+            except BaseException as e:  # noqa: BLE001 - propagate to callers
+                for future, _, _ in futures:
+                    if not future.done():
+                        future.set_exception(e)
+            self._finish_one()

@@ -174,11 +174,37 @@ def _slab_update_sorted(
     chosen, stolen = _choose_slots(state, batch, now, n_probes)
 
     b = chosen.shape[0]
-    (s_slot, s_fp_hi, s_fp_lo, order) = jax.lax.sort(
-        (chosen, batch.fp_hi, batch.fp_lo, jnp.arange(b, dtype=jnp.int32)),
-        num_keys=3,
-        is_stable=True,
+    # ONE packed uint32 sort key instead of a 3-key 4-operand variadic sort:
+    # slot in the high bits (padding's sentinel slot n sorts last), a
+    # fingerprint tiebreaker below so distinct keys contending for one slot
+    # still group their own duplicates contiguously. The sort is the hot
+    # path's most expensive op (every bitonic stage moves every operand),
+    # so everything not needed for ordering is gathered by the permutation
+    # afterwards. Stability keeps same-key items in arrival order —
+    # required for per-item parity at limit crossings. The tiebreaker must
+    # be independent of slot selection: every probe candidate is a function
+    # of (fp_lo mod n, fp_hi mod n), so bits >= log2(n) of fp_hi never
+    # influence which slot a key lands in — the TOP fp_bits of fp_hi are
+    # therefore uncorrelated with any contention event (low bits of fp_lo
+    # would be forced equal for exactly the probe-0 collisions that need
+    # the tiebreak). Two distinct keys sharing a slot AND these fp_bits top
+    # bits in one batch could interleave and split a segment; that
+    # undercounts (fails open, same class as the counted contention drop)
+    # with probability 2^-fp_bits per contending pair.
+    slot_bits = n.bit_length()  # chosen ranges 0..n inclusive
+    fp_bits = max(0, min(16, 32 - slot_bits))
+    if fp_bits:
+        key = (chosen.astype(jnp.uint32) << fp_bits) | (
+            batch.fp_hi >> jnp.uint32(32 - fp_bits)
+        )
+    else:  # slab so large the slot index fills the key
+        key = chosen.astype(jnp.uint32)
+    (_, order) = jax.lax.sort(
+        (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
     )
+    s_slot = chosen[order]
+    s_fp_lo = batch.fp_lo[order]
+    s_fp_hi = batch.fp_hi[order]
     s_hits = batch.hits[order]
     s_div = batch.divider[order]
     s_jit = batch.jitter[order]

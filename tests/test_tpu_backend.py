@@ -4,6 +4,7 @@ via bench.py / verify scripts."""
 
 import random
 import threading
+import time
 
 import pytest
 
@@ -319,6 +320,135 @@ class TestMicroBatcher:
         b = MicroBatcher(execute, window_seconds=0.01, max_batch=4)
         with pytest.raises(RuntimeError, match="device on fire"):
             b.submit([1])
+        b.close()
+
+
+class TestMicroBatcherPipelined:
+    """The double-buffered launch/collect mode (execute_launch +
+    execute_collect): launches overlap the previous batch's readback."""
+
+    @staticmethod
+    def _make(launch_log, collect_log, collect_gate=None, max_inflight=2):
+        def launch(items):
+            launch_log.append(list(items))
+            return list(items)
+
+        def collect(token):
+            if collect_gate is not None:
+                collect_gate.wait(2.0)
+            collect_log.append(list(token))
+            return [x * 10 for x in token]
+
+        return MicroBatcher(
+            lambda items: [x * 10 for x in items],
+            window_seconds=0.01,
+            max_batch=4,
+            execute_launch=launch,
+            execute_collect=collect,
+            max_inflight=max_inflight,
+        )
+
+    def test_results_and_order(self):
+        launches, collects = [], []
+        b = self._make(launches, collects)
+        out = []
+        threads = [
+            threading.Thread(target=lambda i=i: out.append(b.submit([i])))
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b.close()
+        assert sorted(x for [x] in out) == [i * 10 for i in range(8)]
+        assert launches == collects  # every launch collected, in order
+
+    def test_launch_overlaps_collect(self):
+        # while batch 1's collect is gated, batch 2's LAUNCH must happen —
+        # that overlap is the whole point of the mode
+        launches, collects = [], []
+        gate = threading.Event()
+        b = self._make(launches, collects, collect_gate=gate)
+        t1 = threading.Thread(target=lambda: b.submit([1]))
+        t1.start()
+        deadline = time.monotonic() + 2.0
+        while not launches and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t2 = threading.Thread(target=lambda: b.submit([2]))
+        t2.start()
+        deadline = time.monotonic() + 2.0
+        while len(launches) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(launches) == 2, "launch 2 did not overlap collect 1"
+        assert collects == []  # nothing collected yet: both in flight
+        gate.set()
+        t1.join(2.0)
+        t2.join(2.0)
+        b.close()
+        assert collects == [[1], [2]]
+
+    def test_close_with_collects_in_flight(self):
+        # regression: close() while the bounded collect queue is full must
+        # not deadlock (the _CLOSE put happens outside the dispatch lock)
+        launches, collects = [], []
+        gate = threading.Event()
+        b = self._make(launches, collects, collect_gate=gate, max_inflight=1)
+        results = []
+        threads = [
+            threading.Thread(target=lambda i=i: results.append(b.submit([i])))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2.0
+        while not launches and time.monotonic() < deadline:
+            time.sleep(0.005)
+        closer = threading.Thread(target=b.close)
+        closer.start()
+        gate.set()
+        closer.join(5.0)
+        assert not closer.is_alive(), "close() deadlocked"
+        for t in threads:
+            t.join(5.0)
+        assert sorted(x for [x] in results) == [0, 10, 20]
+
+    def test_collect_error_propagates(self):
+        def launch(items):
+            return list(items)
+
+        def collect(token):
+            raise RuntimeError("readback failed")
+
+        b = MicroBatcher(
+            lambda items: items,
+            window_seconds=0.01,
+            max_batch=4,
+            execute_launch=launch,
+            execute_collect=collect,
+        )
+        with pytest.raises(RuntimeError, match="readback failed"):
+            b.submit([1])
+        b.close()
+
+    def test_flush_waits_for_collects(self):
+        launches, collects = [], []
+        gate = threading.Event()
+        b = self._make(launches, collects, collect_gate=gate)
+        t = threading.Thread(target=lambda: b.submit([7]))
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not launches and time.monotonic() < deadline:
+            time.sleep(0.005)
+        flushed = threading.Event()
+        f = threading.Thread(target=lambda: (b.flush(), flushed.set()))
+        f.start()
+        time.sleep(0.05)
+        assert not flushed.is_set()  # collect still gated => not idle
+        gate.set()
+        f.join(2.0)
+        assert flushed.is_set()
+        t.join(2.0)
         b.close()
 
 
