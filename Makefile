@@ -8,7 +8,8 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_integration \
-        tests_with_redis bench serve check_config clean docker_image
+        tests_with_redis tests_tpu bench serve check_config clean \
+        docker_image docker_tests
 
 all: compile
 
@@ -42,6 +43,12 @@ tests: tests_unit
 tests_with_redis:
 	$(PY) -m pytest tests/test_real_redis.py -v -rs
 
+# On-hardware tier: the Pallas kernel differential suite COMPILED through
+# Mosaic on a real TPU (interpret mode certifies semantics; this certifies
+# the lowering). Run on a chip-attached host; skips cleanly elsewhere.
+tests_tpu:
+	TPU_TESTS=1 $(PY) -m pytest tests/test_pallas_tpu.py -v
+
 # Decisions/sec + p99 benchmark; prints one JSON line. Run on TPU.
 bench:
 	$(PY) bench.py
@@ -58,6 +65,13 @@ check_config:
 
 docker_image:
 	docker build -t api-ratelimit-tpu:latest .
+
+# Containerized integration tier: bakes redis-server so the real-redis
+# tests run anywhere (the reference's `make docker_tests`, Makefile:122-125
+# + Dockerfile.integration).
+docker_tests:
+	docker build -f Dockerfile.integration -t api-ratelimit-tpu-itest .
+	docker run --rm api-ratelimit-tpu-itest
 
 clean:
 	rm -rf api_ratelimit_tpu/_native build dist

@@ -29,6 +29,21 @@ connection; frontends pool connections for concurrency):
 
 `now` is stamped by the sidecar at launch time — one clock authority, so
 frontends never disagree about window boundaries.
+
+Transports (the address string selects one):
+
+  /path/to.sock        unix socket — same-host frontends (default)
+  tcp://host:port      TCP — frontends on OTHER hosts, the DCN analog of
+                       the reference's N replicas dialing one shared Redis
+                       over the network (src/redis/driver_impl.go:60-78,
+                       nomad/apigw-ratelimit/common.hcl:2)
+  tls://host:port      TCP + TLS: server presents cert/key; client verifies
+                       against a CA bundle and may present a client cert
+                       (mutual TLS), mirroring the reference's REDIS_TLS +
+                       auth dial options (driver_impl.go:60-78)
+
+TCP connections set TCP_NODELAY — the protocol is small length-framed RPCs
+and Nagle would add an RTT of latency to every decision.
 """
 
 from __future__ import annotations
@@ -36,6 +51,7 @@ from __future__ import annotations
 import logging
 import os
 import socket
+import ssl
 import struct
 import threading
 
@@ -61,6 +77,22 @@ ITEM_ROWS = 6  # fp_lo, fp_hi, hits, limit, divider, jitter
 # legitimately sends fits well under this (requests are a handful of items;
 # the engine's own max_batch is 64k).
 MAX_SUBMIT_ITEMS = 1 << 20
+
+
+def parse_sidecar_address(address: str) -> tuple[str, object]:
+    """("unix", path) | ("tcp"|"tls", (host, port)). Anything without a
+    tcp:// or tls:// scheme is a unix socket path (backward compatible)."""
+    for scheme in ("tcp", "tls"):
+        prefix = scheme + "://"
+        if address.startswith(prefix):
+            hostport = address[len(prefix):]
+            host, sep, port = hostport.rpartition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(
+                    f"sidecar address {address!r} must be {scheme}://host:port"
+                )
+            return scheme, (host or "127.0.0.1", int(port))
+    return "unix", address
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -110,36 +142,73 @@ def decode_items(payload: bytes):
 
 class SlabSidecarServer:
     """The device-owner process. Accepts frontend connections on a unix
-    socket; each SUBMIT runs through the engine's micro-batcher, which
-    coalesces items from every connected frontend into shared launches."""
+    socket or TCP(+TLS) listener; each SUBMIT runs through the engine's
+    micro-batcher, which coalesces items from every connected frontend into
+    shared launches."""
 
-    def __init__(self, socket_path: str, engine, socket_mode: int = 0o600):
-        """socket_mode: filesystem mode for the socket node. Default 0o600
-        restricts to same-UID frontends; pass 0o660 and place the socket in
-        a directory owned by a shared group for split-UID deployments. Any
-        process that can connect can drive arbitrary counter increments, so
-        never leave the default world-connectable mode."""
+    def __init__(
+        self,
+        address: str,
+        engine,
+        socket_mode: int = 0o600,
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_ca: str = "",
+    ):
+        """address: unix path, tcp://host:port, or tls://host:port.
+
+        socket_mode (unix only): filesystem mode for the socket node.
+        Default 0o600 restricts to same-UID frontends; pass 0o660 and place
+        the socket in a directory owned by a shared group for split-UID
+        deployments. Any process that can connect can drive arbitrary
+        counter increments, so never leave the default world-connectable
+        mode — and for tcp://, bind a private interface or use tls:// with
+        tls_ca (mutual TLS: only cert-holding frontends connect).
+
+        tls_cert/tls_key (tls only): server certificate + key, required.
+        tls_ca (tls only): when set, frontends must present a client
+        certificate signed by this CA."""
         self._engine = engine
-        self._path = socket_path
-        try:
-            os.unlink(socket_path)
-        except FileNotFoundError:
-            pass
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        # bind-then-chmod (no umask games: umask is process-wide and would
-        # leak 0o077 onto files other threads create during the window).
-        # Linux checks AF_UNIX connect permissions at connect time against
-        # the current node mode, so the pre-chmod window is closed by the
-        # chmod landing before listen() accepts anyone.
-        self._sock.bind(socket_path)
-        os.chmod(socket_path, socket_mode)
+        self._scheme, target = parse_sidecar_address(address)
+        self._path = address
+        self._tls_ctx = None
+        if self._scheme == "unix":
+            try:
+                os.unlink(target)
+            except FileNotFoundError:
+                pass
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            # bind-then-chmod (no umask games: umask is process-wide and
+            # would leak 0o077 onto files other threads create during the
+            # window). Linux checks AF_UNIX connect permissions at connect
+            # time against the current node mode, so the pre-chmod window
+            # is closed by the chmod landing before listen() accepts.
+            self._sock.bind(target)
+            os.chmod(target, socket_mode)
+        else:
+            if self._scheme == "tls":
+                if not tls_cert or not tls_key:
+                    raise ValueError("tls:// sidecar requires tls_cert + tls_key")
+                self._tls_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                self._tls_ctx.load_cert_chain(tls_cert, tls_key)
+                if tls_ca:
+                    self._tls_ctx.load_verify_locations(tls_ca)
+                    self._tls_ctx.verify_mode = ssl.CERT_REQUIRED
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind(target)
         self._sock.listen(128)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="sidecar-accept", daemon=True
         )
         self._accept_thread.start()
-        logger.info("slab sidecar listening on %s", socket_path)
+        logger.info("slab sidecar listening on %s", address)
+
+    @property
+    def port(self) -> int:
+        """Bound TCP port (tests bind port 0)."""
+        return self._sock.getsockname()[1]
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -153,6 +222,12 @@ class SlabSidecarServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
+            if self._scheme in ("tcp", "tls"):
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._tls_ctx is not None:
+                # handshake here, per-connection thread — a client stalling
+                # mid-handshake must not block the accept loop
+                conn = self._tls_ctx.wrap_socket(conn, server_side=True)
             with conn:
                 while not self._stop.is_set():
                     hdr = _recv_exact(conn, _HDR.size)
@@ -201,10 +276,11 @@ class SlabSidecarServer:
             self._sock.close()
         except OSError:
             pass
-        try:
-            os.unlink(self._path)
-        except OSError:
-            pass
+        if self._scheme == "unix":
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
         self._engine.close()
 
 
@@ -214,28 +290,76 @@ class SidecarEngineClient:
     Connections are pooled so frontend threads overlap their RPCs — the
     sidecar's batcher turns that concurrency into bigger launches."""
 
-    def __init__(self, socket_path: str, pool_size: int = 8, timeout: float = 30.0):
-        self._path = socket_path
+    def __init__(
+        self,
+        address: str,
+        pool_size: int = 8,
+        timeout: float = 30.0,
+        tls_ca: str = "",
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_server_name: str = "",
+    ):
+        """address: unix path, tcp://host:port, or tls://host:port.
+        tls_ca: CA bundle the server cert must chain to (defaults to the
+        system store when empty). tls_cert/tls_key: client certificate for
+        mutual TLS. tls_server_name: SNI/hostname override when the cert CN
+        doesn't match the dialed host (the reference's equivalent knob:
+        tls dial options, driver_impl.go:60-78)."""
+        self._path = address
+        self._scheme, self._target = parse_sidecar_address(address)
         self._timeout = timeout
+        self._tls_ctx = None
+        self._tls_server_name = tls_server_name
+        if self._scheme == "tls":
+            self._tls_ctx = ssl.create_default_context(
+                cafile=tls_ca or None
+            )
+            if tls_cert and tls_key:
+                self._tls_ctx.load_cert_chain(tls_cert, tls_key)
         self._pool: list[socket.socket] = []
         self._pool_lock = threading.Lock()
         self._pool_size = pool_size
         self._closed = False
-        # fail fast like the reference's startup PING (driver_impl.go:124-128)
+        # fail fast like the reference's startup PING (driver_impl.go:124-128).
+        # The read is part of the check: under TLS 1.3 a rejected client
+        # certificate only surfaces on the first read after the handshake.
         conn = self._dial()
-        conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
-        if _recv_exact(conn, 1) != b"\x00":
-            raise CacheError(f"sidecar ping failed on {socket_path}")
+        try:
+            conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
+            if _recv_exact(conn, 1) != b"\x00":
+                raise CacheError(f"sidecar ping failed on {address}")
+        except (OSError, ConnectionError) as e:
+            conn.close()
+            raise CacheError(f"sidecar ping failed on {address}: {e}") from e
         self._release(conn)
 
     def _dial(self) -> socket.socket:
-        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        conn.settimeout(self._timeout)
+        if self._scheme == "unix":
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(self._timeout)
+            try:
+                conn.connect(self._target)
+            except OSError as e:
+                conn.close()
+                raise CacheError(
+                    f"cannot reach slab sidecar at {self._path}: {e}"
+                )
+            return conn
         try:
-            conn.connect(self._path)
+            conn = socket.create_connection(self._target, timeout=self._timeout)
+        except OSError as e:
+            raise CacheError(f"cannot reach slab sidecar at {self._path}: {e}")
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._tls_ctx is not None:
+                conn = self._tls_ctx.wrap_socket(
+                    conn,
+                    server_hostname=self._tls_server_name or self._target[0],
+                )
         except OSError as e:
             conn.close()
-            raise CacheError(f"cannot reach slab sidecar at {self._path}: {e}")
+            raise CacheError(f"sidecar TLS handshake failed on {self._path}: {e}")
         return conn
 
     def _acquire(self) -> socket.socket:
@@ -293,5 +417,11 @@ def new_sidecar_cache_from_settings(settings, base_limiter):
 
     return TpuRateLimitCache(
         base_limiter,
-        engine=SidecarEngineClient(settings.sidecar_socket),
+        engine=SidecarEngineClient(
+            settings.sidecar_socket,
+            tls_ca=settings.sidecar_tls_ca,
+            tls_cert=settings.sidecar_tls_cert,
+            tls_key=settings.sidecar_tls_key,
+            tls_server_name=settings.sidecar_tls_server_name,
+        ),
     )

@@ -53,7 +53,12 @@ def main() -> None:
         mesh=mesh,
     )
     server = SlabSidecarServer(
-        settings.sidecar_socket, engine, socket_mode=settings.sidecar_socket_mode
+        settings.sidecar_socket,
+        engine,
+        socket_mode=settings.sidecar_socket_mode,
+        tls_cert=settings.sidecar_tls_cert,
+        tls_key=settings.sidecar_tls_key,
+        tls_ca=settings.sidecar_tls_ca,
     )
 
     stop = threading.Event()

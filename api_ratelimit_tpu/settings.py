@@ -87,12 +87,24 @@ class Settings:
     tpu_batch_limit: int = 65536
     tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
     tpu_use_pallas: bool = True
-    # BACKEND_TYPE=tpu-sidecar: unix socket of the device-owner process
-    # (cmd/sidecar_cmd.py); lets N SO_REUSEPORT frontends share one slab
+    # BACKEND_TYPE=tpu-sidecar: address of the device-owner process
+    # (cmd/sidecar_cmd.py) — a unix socket path for same-host frontends, or
+    # tcp://host:port / tls://host:port for frontends on other hosts (the
+    # DCN analog of N reference replicas dialing one shared Redis,
+    # src/redis/driver_impl.go:60-78)
     sidecar_socket: str = "/tmp/api-ratelimit-tpu-sidecar.sock"
     # socket node mode (octal string, e.g. "0660" + a shared-group socket
     # dir for frontends running under a different UID than the device owner)
     sidecar_socket_mode: int = 0o600
+    # tls:// transport material. Server side (sidecar_cmd): CERT + KEY
+    # required, CA optional (set => frontends must present a cert signed by
+    # it — mutual TLS). Client side (frontends): CA verifies the server
+    # (system store when empty), CERT + KEY presented when set,
+    # SERVER_NAME overrides SNI/hostname verification.
+    sidecar_tls_cert: str = ""
+    sidecar_tls_key: str = ""
+    sidecar_tls_ca: str = ""
+    sidecar_tls_server_name: str = ""
 
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
@@ -146,6 +158,10 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_use_pallas", "TPU_USE_PALLAS", _parse_bool),
     ("sidecar_socket", "SIDECAR_SOCKET", str),
     ("sidecar_socket_mode", "SIDECAR_SOCKET_MODE", lambda raw: int(raw, 8)),
+    ("sidecar_tls_cert", "SIDECAR_TLS_CERT", str),
+    ("sidecar_tls_key", "SIDECAR_TLS_KEY", str),
+    ("sidecar_tls_ca", "SIDECAR_TLS_CA", str),
+    ("sidecar_tls_server_name", "SIDECAR_TLS_SERVER_NAME", str),
 ]
 
 

@@ -920,9 +920,11 @@ def main() -> None:
         result["vs_baseline"] = round(engine["rate"] / TARGET, 4)
     except Exception as e:
         # the artifact must land even when the headline tier dies (OOM,
-        # Mosaic failure outside run_path's guard, tunnel loss mid-run)
-        engine = {"error": str(e)[-400:]}
-        configs["zipf_10M_engine"] = engine
+        # Mosaic failure outside run_path's guard, tunnel loss mid-run) —
+        # merged INTO whatever publish_engine already measured, never
+        # replacing it
+        engine = configs.setdefault("zipf_10M_engine", {})
+        engine["error"] = str(e)[-400:]
         import traceback
 
         traceback.print_exc()

@@ -3,25 +3,43 @@
 Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere, so
 sharding tests exercise real multi-device SPMD paths without TPU hardware
 (the driver separately dry-runs the multi-chip path; see __graft_entry__.py).
+
+TPU_TESTS=1 leaves the platform alone so the real chip stays visible — used
+by the @pytest.mark.tpu on-hardware suite (tests/test_pallas_tpu.py):
+
+    TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -v
+
+Run ONLY that module under TPU_TESTS: the rest of the suite expects the
+8-device CPU mesh.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+TPU_TESTS = os.environ.get("TPU_TESTS", "") == "1"
+
+if not TPU_TESTS:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # The axon site package (PYTHONPATH=/root/.axon_site) force-sets
 # jax_platforms=axon,cpu at jax import, overriding the env var — tests must
 # run on the virtual 8-device CPU mesh, so override it back post-import.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not TPU_TESTS:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: runs the Pallas kernel COMPILED on a real TPU"
+    )
 
 
 @pytest.fixture

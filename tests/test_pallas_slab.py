@@ -43,7 +43,7 @@ def random_batch(rng, b, n_keys, now_unused=None):
     )
 
 
-def test_update_matches_xla_over_stream():
+def run_update_matches_xla_over_stream(interpret: bool):
     """Same seed, two engines: XLA math vs the Pallas kernel. The whole
     table must stay equal after every step (scatter contents included),
     and each step's sorted before/after must agree exactly."""
@@ -63,7 +63,7 @@ def test_update_matches_xla_over_stream():
             jnp.int32(now),
             n_probes=4,
             use_pallas=True,
-            interpret=True,
+            interpret=interpret,
         )
         assert np.array_equal(np.asarray(bx), np.asarray(bp)), f"before step {step}"
         assert np.array_equal(np.asarray(ax), np.asarray(ap)), f"after step {step}"
@@ -74,7 +74,7 @@ def test_update_matches_xla_over_stream():
         ), f"table diverged at step {step}"
 
 
-def test_fused_decide_matches_xla_decide():
+def run_fused_decide_matches_xla_decide(interpret: bool):
     """use_pallas=True through _slab_step_sorted fuses the decision into
     the kernel; every decision field must equal the jnp decide() twin."""
     rng = np.random.RandomState(11)
@@ -99,7 +99,7 @@ def test_fused_decide_matches_xla_decide():
             jnp.float32(0.8),
             n_probes=4,
             use_pallas=True,
-            interpret=True,
+            interpret=interpret,
         )
         for field in dx._fields:
             got = np.asarray(_unsort(getattr(dp, field), op_))
@@ -122,7 +122,7 @@ def test_kernel_rejects_bad_shapes():
         )
 
 
-def test_in_batch_slot_collision_parity():
+def run_in_batch_slot_collision_parity(interpret: bool):
     """Two distinct keys forced into one slot in one batch (the documented
     contention-drop case): the pallas path must pick the same winner and
     count the same drop."""
@@ -148,8 +148,20 @@ def test_in_batch_slot_collision_parity():
     now = jnp.int32(1000)
     state_x, bx, ax, _, ox, hx, _ = _slab_update_sorted(state_x, batch, now, 2)
     state_p, bp, ap, _, op_, hp, _ = _slab_update_sorted(
-        state_p, batch, now, 2, use_pallas=True, interpret=True
+        state_p, batch, now, 2, use_pallas=True, interpret=interpret
     )
     assert np.array_equal(np.asarray(state_x.table), np.asarray(state_p.table))
     assert np.array_equal(np.asarray(bx), np.asarray(bp))
     assert np.array_equal(np.asarray(hx), np.asarray(hp))
+
+
+def test_update_matches_xla_over_stream():
+    run_update_matches_xla_over_stream(interpret=True)
+
+
+def test_fused_decide_matches_xla_decide():
+    run_fused_decide_matches_xla_decide(interpret=True)
+
+
+def test_in_batch_slot_collision_parity():
+    run_in_batch_slot_collision_parity(interpret=True)

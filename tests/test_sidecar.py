@@ -41,20 +41,30 @@ def req(*pairs, hits=1, domain="domain"):
     )
 
 
-@pytest.fixture
-def sidecar(tmp_path):
-    """A running sidecar (CPU engine, deterministic clock) + its socket."""
-    ts = FakeTimeSource(1_000_000)
-    engine = SlabDeviceEngine(
+def _make_engine(ts):
+    return SlabDeviceEngine(
         time_source=ts,
         n_slots=1 << 12,
         buckets=(128, 1024),
         max_batch=1024,
         use_pallas=False,
     )
-    path = str(tmp_path / "slab.sock")
-    server = SlabSidecarServer(path, engine)
-    yield path, ts
+
+
+@pytest.fixture(params=["unix", "tcp"])
+def sidecar(request, tmp_path):
+    """A running sidecar (CPU engine, deterministic clock) + its address.
+    Parametrized over the unix-socket and TCP transports so the whole
+    end-to-end matrix certifies both (TLS has its own dedicated test)."""
+    ts = FakeTimeSource(1_000_000)
+    engine = _make_engine(ts)
+    if request.param == "unix":
+        address = str(tmp_path / "slab.sock")
+        server = SlabSidecarServer(address, engine)
+    else:
+        server = SlabSidecarServer("tcp://127.0.0.1:0", engine)
+        address = f"tcp://127.0.0.1:{server.port}"
+    yield address, ts
     server.close()
 
 
@@ -155,6 +165,138 @@ class TestSidecarEndToEnd:
     def test_server_down_surfaces_cache_error(self, tmp_path):
         with pytest.raises(CacheError, match="cannot reach slab sidecar"):
             SidecarEngineClient(str(tmp_path / "nope.sock"))
+
+    def test_tcp_server_down_surfaces_cache_error(self):
+        with pytest.raises(CacheError, match="cannot reach slab sidecar"):
+            SidecarEngineClient("tcp://127.0.0.1:1")
+
+
+class TestAddressParsing:
+    def test_schemes(self):
+        from api_ratelimit_tpu.backends.sidecar import parse_sidecar_address
+
+        assert parse_sidecar_address("/tmp/x.sock") == ("unix", "/tmp/x.sock")
+        assert parse_sidecar_address("tcp://h:123") == ("tcp", ("h", 123))
+        assert parse_sidecar_address("tls://10.0.0.2:9") == (
+            "tls",
+            ("10.0.0.2", 9),
+        )
+        assert parse_sidecar_address("tcp://:80") == ("tcp", ("127.0.0.1", 80))
+        with pytest.raises(ValueError):
+            parse_sidecar_address("tcp://nohost")
+        with pytest.raises(ValueError):
+            parse_sidecar_address("tls://h:notaport")
+
+
+class TestTlsTransport:
+    """tls:// — the cross-host DCN transport with mutual TLS, mirroring the
+    reference's REDIS_TLS + auth dial options (driver_impl.go:60-78)."""
+
+    @pytest.fixture
+    def tls_material(self, tmp_path):
+        import shutil
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl binary not available")
+        ca_key, ca_crt = tmp_path / "ca.key", tmp_path / "ca.crt"
+        srv_key, srv_csr, srv_crt = (
+            tmp_path / "s.key",
+            tmp_path / "s.csr",
+            tmp_path / "s.crt",
+        )
+        cli_key, cli_csr, cli_crt = (
+            tmp_path / "c.key",
+            tmp_path / "c.csr",
+            tmp_path / "c.crt",
+        )
+
+        def run(*args, stdin: bytes | None = None):
+            subprocess.run(args, input=stdin, check=True, capture_output=True)
+
+        run(
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+            "-subj", "/CN=test-ca",
+        )
+        for key, csr, crt, cn, san in (
+            (srv_key, srv_csr, srv_crt, "localhost",
+             b"subjectAltName=DNS:localhost,IP:127.0.0.1"),
+            (cli_key, cli_csr, cli_crt, "frontend", None),
+        ):
+            run(
+                "openssl", "req", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={cn}",
+            )
+            sign = [
+                "openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+                "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+                "-out", str(crt),
+            ]
+            if san:
+                sign += ["-extfile", "/dev/stdin"]
+            run(*sign, stdin=san)
+        return {
+            "ca": str(ca_crt),
+            "srv_crt": str(srv_crt),
+            "srv_key": str(srv_key),
+            "cli_crt": str(cli_crt),
+            "cli_key": str(cli_key),
+        }
+
+    def test_mutual_tls_end_to_end(self, tls_material, test_store):
+        ts = FakeTimeSource(1_000_000)
+        server = SlabSidecarServer(
+            "tls://127.0.0.1:0",
+            _make_engine(ts),
+            tls_cert=tls_material["srv_crt"],
+            tls_key=tls_material["srv_key"],
+            tls_ca=tls_material["ca"],  # require client certs
+        )
+        try:
+            store, _ = test_store
+            base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+            cache = TpuRateLimitCache(
+                base,
+                engine=SidecarEngineClient(
+                    f"tls://127.0.0.1:{server.port}",
+                    tls_ca=tls_material["ca"],
+                    tls_cert=tls_material["cli_crt"],
+                    tls_key=tls_material["cli_key"],
+                    tls_server_name="localhost",
+                ),
+            )
+            limit = make_limit(store.scope("t"), 3, Unit.MINUTE, "k_v")
+            for want in [Code.OK, Code.OK, Code.OK, Code.OVER_LIMIT]:
+                resp = cache.do_limit(req(("k", "v")), [limit])
+                assert resp.descriptor_statuses[0].code == want
+            cache.close()
+        finally:
+            server.close()
+
+    def test_client_without_cert_rejected(self, tls_material):
+        ts = FakeTimeSource(1_000_000)
+        server = SlabSidecarServer(
+            "tls://127.0.0.1:0",
+            _make_engine(ts),
+            tls_cert=tls_material["srv_crt"],
+            tls_key=tls_material["srv_key"],
+            tls_ca=tls_material["ca"],  # mutual TLS required
+        )
+        try:
+            with pytest.raises(CacheError):
+                SidecarEngineClient(
+                    f"tls://127.0.0.1:{server.port}",
+                    tls_ca=tls_material["ca"],
+                    tls_server_name="localhost",
+                )
+        finally:
+            server.close()
+
+    def test_server_requires_cert_material(self):
+        ts = FakeTimeSource(1_000_000)
+        with pytest.raises(ValueError, match="requires tls_cert"):
+            SlabSidecarServer("tls://127.0.0.1:0", _make_engine(ts))
 
     def test_engine_failure_propagates_message(self, sidecar, test_store, tmp_path):
         path, ts = sidecar
