@@ -91,6 +91,9 @@ def parse_sidecar_address(address: str) -> tuple[str, object]:
                 raise ValueError(
                     f"sidecar address {address!r} must be {scheme}://host:port"
                 )
+            # [v6::literal]:port — strip the brackets for the socket APIs
+            if host.startswith("[") and host.endswith("]"):
+                host = host[1:-1]
             return scheme, (host or "127.0.0.1", int(port))
     return "unix", address
 
@@ -194,9 +197,13 @@ class SlabSidecarServer:
                 if tls_ca:
                     self._tls_ctx.load_verify_locations(tls_ca)
                     self._tls_ctx.verify_mode = ssl.CERT_REQUIRED
-            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            # family from getaddrinfo so v6 literals/AAAA-only hosts bind
+            info = socket.getaddrinfo(
+                target[0], target[1], type=socket.SOCK_STREAM
+            )[0]
+            self._sock = socket.socket(info[0], socket.SOCK_STREAM)
             self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            self._sock.bind(target)
+            self._sock.bind(info[4])
         self._sock.listen(128)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
@@ -222,15 +229,28 @@ class SlabSidecarServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
-            if self._scheme in ("tcp", "tls"):
+            net = self._scheme in ("tcp", "tls")
+            if net:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._tls_ctx is not None:
                 # handshake here, per-connection thread — a client stalling
-                # mid-handshake must not block the accept loop
+                # mid-handshake must not block the accept loop. The 10s
+                # timeout bounds the PRE-authentication window: an
+                # unauthenticated peer must not pin this thread/fd forever
+                # (slowloris) on a network-exposed listener.
+                conn.settimeout(10.0)
                 conn = self._tls_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
             with conn:
                 while not self._stop.is_set():
+                    # idle waits are unbounded (frontends pool connections
+                    # between requests) but once a frame STARTS it must
+                    # finish promptly — a half-sent frame holds the thread
+                    if net:
+                        conn.settimeout(None)
                     hdr = _recv_exact(conn, _HDR.size)
+                    if net:
+                        conn.settimeout(30.0)
                     magic, version, op, _ = _HDR.unpack(hdr)
                     if magic != MAGIC or version != VERSION:
                         conn.sendall(self._error(f"bad header {hdr!r}"))
@@ -327,11 +347,13 @@ class SidecarEngineClient:
         conn = self._dial()
         try:
             conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
-            if _recv_exact(conn, 1) != b"\x00":
-                raise CacheError(f"sidecar ping failed on {address}")
+            ok = _recv_exact(conn, 1) == b"\x00"
         except (OSError, ConnectionError) as e:
             conn.close()
             raise CacheError(f"sidecar ping failed on {address}: {e}") from e
+        if not ok:
+            conn.close()
+            raise CacheError(f"sidecar ping failed on {address}")
         self._release(conn)
 
     def _dial(self) -> socket.socket:

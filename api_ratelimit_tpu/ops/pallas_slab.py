@@ -151,8 +151,11 @@ def _slab_apply_kernel(
         & (st_fp_lo_ref[...] == fp_lo_ref[...])
         & (st_fp_hi_ref[...] == fp_hi_ref[...])
     )
+    # hits>0 gate: padding lanes may carry a real fingerprint whose probe
+    # row matches — the contract is before = after = 0 for them (same gate
+    # as the XLA twin in ops/slab.py)
     base = jnp.where(
-        fp_match & (st_window_ref[...] == cur_window),
+        (hits > jnp.int32(0)) & fp_match & (st_window_ref[...] == cur_window),
         st_count_ref[...],
         jnp.int32(0),
     )

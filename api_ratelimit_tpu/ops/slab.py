@@ -106,7 +106,10 @@ def make_slab(n_slots: int, device=None) -> SlabState:
 def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     """K-way probe; returns (int32[b] chosen slot — n_slots for padding,
     bool[b] stolen — every candidate was a live non-match, so candidate 0's
-    victim gets displaced)."""
+    victim gets displaced, uint32[b, ROW_WIDTH] the chosen slot's stored
+    row). Returning the row spares the caller a second random gather over
+    the whole table: the probe already fetched every candidate row, so the
+    chosen one is a cheap in-register select."""
     n = state.n_slots
     mask = jnp.uint32(n - 1)
 
@@ -129,10 +132,11 @@ def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     avail_first = jnp.argmax(avail, axis=1)
     pick = jnp.where(match_any, match_first, jnp.where(avail_any, avail_first, 0))
     chosen = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]
+    picked_rows = jnp.take_along_axis(rows, pick[:, None, None], axis=1)[:, 0]
 
     valid = batch.hits > 0
     stolen = valid & ~match_any & ~avail_any
-    return jnp.where(valid, chosen, jnp.int32(n)), stolen
+    return jnp.where(valid, chosen, jnp.int32(n)), stolen, picked_rows
 
 
 def _slab_update_sorted(
@@ -171,7 +175,7 @@ def _slab_update_sorted(
     n = state.n_slots
     now = now.astype(jnp.int32)
 
-    chosen, stolen = _choose_slots(state, batch, now, n_probes)
+    chosen, stolen, picked_rows = _choose_slots(state, batch, now, n_probes)
 
     b = chosen.shape[0]
     # ONE packed uint32 sort key instead of a 3-key 4-operand variadic sort:
@@ -217,9 +221,11 @@ def _slab_update_sorted(
     )
     seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
 
-    # --- stored slot rows (clamped gather; padding reads are discarded) ---
-    g_slot = jnp.minimum(s_slot, n - 1)
-    st_rows = state.table[g_slot]  # (b, ROW_WIDTH) — one gather
+    # --- stored slot rows: permute the probe's picked rows into sort order
+    # (a dense permute of the (b, ROW_WIDTH) intermediate instead of a
+    # second random gather over the whole table; padding rows are garbage
+    # but their results are discarded) ---
+    st_rows = picked_rows[order]
 
     decision = None
     if use_pallas:
@@ -273,7 +279,12 @@ def _slab_update_sorted(
         slot_live = st_expire > now
         fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
         same_window = st_window == cur_window
-        base = jnp.where(fp_match & same_window, st_count, jnp.uint32(0))
+        # the hits>0 gate keeps the padding contract (before = after = 0):
+        # a padding lane can carry a real fingerprint (e.g. a non-owned lane
+        # in the replicated mesh mode) and its probe row WOULD match
+        base = jnp.where(
+            (s_hits > 0) & fp_match & same_window, st_count, jnp.uint32(0)
+        )
 
         s_before = base + prior_in_batch
         s_after = s_before + s_hits

@@ -1,0 +1,7 @@
+# Production overlay — the analog of the reference's production.hcl
+# (nomad/apigw-ratelimit/production.hcl: app_count = 3).
+
+app_count = 3
+
+log_level  = "info"
+use_statsd = true

@@ -1,0 +1,11 @@
+# Staging overlay for deploy/nomad/ratelimit.nomad.hcl — the analog of the
+# reference's env-specific nomad variable files (nomad/apigw-ratelimit/
+# our1.hcl: app_count = 1 for the single-instance site). Apply with
+#   nomad job run -var-file=deploy/nomad/env/staging.hcl deploy/nomad/ratelimit.nomad.hcl
+# after parameterizing count, or use as the canonical per-env record.
+
+app_count = 1
+
+# staging soaks new configs with verbose logs and no statsd fan-in
+log_level  = "debug"
+use_statsd = false

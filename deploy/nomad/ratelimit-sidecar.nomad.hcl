@@ -1,0 +1,105 @@
+# Sidecar topology: ONE device-owner process per TPU host plus N stateless
+# wire frontends sharing its slab — the deployment that uses the sidecar's
+# TCP transport (backends/sidecar.py). This is the closest analog of the
+# reference's production shape (N replicas against one shared Redis,
+# nomad/apigw-ratelimit/common.hcl:2): the sidecar plays Redis's
+# single-writer role, frontends play the stateless replicas, and limits
+# stay globally exact because every increment serializes through the one
+# slab.
+#
+# Same-host frontends should prefer the unix socket (SIDECAR_SOCKET=
+# /run/ratelimit/slab.sock); the tcp:// stanza below is for frontends on
+# OTHER hosts riding DCN — add tls:// + SIDECAR_TLS_* for anything not on a
+# private fabric.
+
+job "api-ratelimit-tpu-sidecar" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "device-owner" {
+    count = 1 # exactly one slab owner per TPU host
+
+    constraint {
+      attribute = "${meta.tpu_accelerator}"
+      value     = "v5e"
+    }
+
+    network {
+      port "slab" { static = 9489 }
+    }
+
+    task "sidecar" {
+      driver = "docker"
+
+      config {
+        image   = "api-ratelimit-tpu:latest"
+        ports   = ["slab"]
+        command = "python"
+        args    = ["-m", "api_ratelimit_tpu.cmd.sidecar_cmd"]
+      }
+
+      env {
+        SIDECAR_SOCKET   = "tcp://0.0.0.0:${NOMAD_PORT_slab}"
+        TPU_SLAB_SLOTS   = "8388608"
+        TPU_BATCH_WINDOW = "200us" # the cross-frontend coalescing window
+        TPU_BATCH_LIMIT  = "65536"
+      }
+
+      resources {
+        cpu    = 4000
+        memory = 16384
+      }
+    }
+  }
+
+  group "frontend" {
+    count = 3 # scale the wire layer independently of the device owner
+
+    network {
+      port "http" { static = 9483 }
+      port "grpc" { static = 9484 }
+      port "debug" { static = 9485 }
+    }
+
+    service {
+      name = "api-ratelimit-tpu"
+      port = "grpc"
+      check {
+        type     = "grpc"
+        interval = "5s"
+        timeout  = "2s"
+      }
+    }
+
+    task "server" {
+      driver = "docker"
+
+      config {
+        image = "api-ratelimit-tpu:latest"
+        ports = ["http", "grpc", "debug"]
+      }
+
+      env {
+        PORT                  = "${NOMAD_PORT_http}"
+        GRPC_PORT             = "${NOMAD_PORT_grpc}"
+        DEBUG_PORT            = "${NOMAD_PORT_debug}"
+        BACKEND_TYPE          = "tpu-sidecar"
+        SIDECAR_SOCKET        = "tcp://ratelimit-sidecar.service.consul:9489"
+        JAX_PLATFORMS         = "cpu" # frontends never touch the device
+        RUNTIME_ROOT          = "/srv/runtime_data/current"
+        RUNTIME_SUBDIRECTORY  = "ratelimit"
+        RUNTIME_WATCH_ROOT    = "false"
+        USE_STATSD            = "true"
+        STATSD_HOST           = "localhost"
+        STATSD_PORT           = "8125"
+        LOG_FORMAT            = "json"
+        MAX_SLEEPING_ROUTINES = "64"
+      }
+
+      resources {
+        cpu    = 2000
+        memory = 4096
+      }
+    }
+  }
+}

@@ -282,6 +282,32 @@ class TestCompactReadbackModes:
         assert after.dtype == jnp.uint16
         assert after.tolist()[:1] == [3]
 
+    def test_padding_with_real_fp_reports_zero(self):
+        """hits == 0 marks padding and its before/after MUST be 0 even when
+        the lane carries a real fingerprint whose probe row matches a live
+        stored key (regression: the probe-row reuse briefly leaked the
+        stored count into such lanes, which the replicated mesh mode — its
+        non-owned lanes are exactly 'real fp, hits 0' — then psum'd into
+        other shards' results)."""
+        from api_ratelimit_tpu.ops.slab import slab_step_after
+
+        state = make_slab(N_SLOTS)
+        state, after, _health = slab_step_after(
+            state, self._packed([(KEY_A, 5, 100, 60)], now=5_000)
+        )
+        assert after.tolist()[0] == 5
+        # same key rides a padding lane (hits=0): must come back 0, and the
+        # stored counter must not advance
+        state, after, _health = slab_step_after(
+            state,
+            self._packed([(KEY_B, 1, 100, 60), (KEY_A, 0, 100, 60)], now=5_000),
+        )
+        assert after.tolist()[:2] == [1, 0]
+        state, after, _health = slab_step_after(
+            state, self._packed([(KEY_A, 1, 100, 60)], now=5_000)
+        )
+        assert after.tolist()[0] == 6  # 5 + 1, untouched by the padding lane
+
 
 class TestSlabHealth:
     """The slab's two documented fail-open lossy behaviors must be counted,
