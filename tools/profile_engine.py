@@ -1,0 +1,214 @@
+"""Per-stage breakdown of one slab engine step on the attached device.
+
+VERDICT r3 #2 asked for a recorded hardware profile of the engine hot path
+before optimizing further. Rather than a TensorBoard trace (unreadable in a
+JSON artifact), this times each pipeline stage as its own jitted program —
+probe gather, sort, permutation gathers, the update math (Pallas and XLA
+twins), scatter, unsort — plus the full fused step, so the dominant cost is
+a number in the output, not a guess. Stages are timed with donated inputs
+where the real step donates, a warmup call to exclude compile, and
+block_until_ready around a fixed repeat count.
+
+Usage (chip-attached host; CPU works too for smoke):
+
+    python tools/profile_engine.py [--batch 1048576] [--slots 8388608] \
+        [--repeats 8]
+
+Prints one JSON object. Stage times overlap (the full step is NOT the sum:
+XLA fuses across stage boundaries), so read them as attribution, not an
+exact partition.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        SlabBatch,
+        _choose_slots,
+        _slab_step_sorted,
+        _slab_update_sorted,
+        _unsort,
+        make_slab,
+    )
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if not on_tpu and args.batch > (1 << 14):
+        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+
+    rng = np.random.RandomState(0)
+    ids_np = (rng.zipf(1.1, size=args.batch).astype(np.uint64) % args.keys).astype(
+        np.uint32
+    )
+    ids = jax.device_put(ids_np, device)
+    now = jnp.int32(int(time.time()))
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    state0 = jax.device_put(make_slab(args.slots), device)
+    table0 = state0.table
+
+    def timeit(fn, *xs, repeats=args.repeats):
+        out = fn(*xs)  # warmup/compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / repeats * 1e3  # ms
+
+    results: dict = {
+        "platform": device.platform,
+        "batch": args.batch,
+        "n_slots": args.slots,
+        "repeats": args.repeats,
+    }
+
+    # --- stage: fingerprint expansion only ---
+    @jax.jit
+    def stage_expand(ids):
+        b = expand(ids)
+        return b.fp_lo, b.fp_hi
+
+    results["expand_ms"] = round(timeit(stage_expand, ids), 3)
+
+    # --- stage: probe (the (b, K, 8) table gather + selects) ---
+    @jax.jit
+    def stage_probe(table, ids):
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        return _choose_slots(SlabState(table=table), expand(ids), now, 4)
+
+    results["probe_ms"] = round(timeit(stage_probe, table0, ids), 3)
+
+    # --- stage: probe + packed single-key sort ---
+    @jax.jit
+    def stage_sort(table, ids):
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        batch = expand(ids)
+        chosen, stolen, rows = _choose_slots(SlabState(table=table), batch, now, 4)
+        n = table.shape[0]
+        fp_bits = max(0, min(16, 32 - n.bit_length()))
+        key = (chosen.astype(jnp.uint32) << fp_bits) | (
+            batch.fp_hi >> jnp.uint32(32 - fp_bits)
+        )
+        b = chosen.shape[0]
+        return jax.lax.sort(
+            (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
+        )
+
+    results["probe_plus_sort_ms"] = round(timeit(stage_sort, table0, ids), 3)
+
+    # --- full update, XLA math, no decide (after-mode compute) ---
+    @functools.partial(jax.jit, donate_argnames=("table",), static_argnames=("pallas",))
+    def stage_update(table, ids, pallas):
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        state, _b, s_after, _i, order, _h, _ = _slab_update_sorted(
+            SlabState(table=table), expand(ids), now, 4, use_pallas=pallas
+        )
+        return state.table, _unsort(s_after, order).astype(jnp.uint8)
+
+    # donation burns the buffer each call: re-donate a fresh copy per repeat
+    def timeit_donating(pallas):
+        tables = [jnp.array(table0) for _ in range(args.repeats + 1)]
+        jax.block_until_ready(tables)
+        out = stage_update(tables[-1], ids, pallas)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [stage_update(tables[i], ids, pallas) for i in range(args.repeats)]
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / args.repeats * 1e3
+
+    results["update_xla_ms"] = round(timeit_donating(False), 3)
+    if on_tpu:
+        try:
+            results["update_pallas_ms"] = round(timeit_donating(True), 3)
+        except Exception as e:
+            results["update_pallas_error"] = str(e)[-200:]
+
+    # --- full decided step (the bench headline program) ---
+    @functools.partial(jax.jit, donate_argnames=("table",), static_argnames=("pallas",))
+    def stage_full(table, ids, pallas):
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        state, _b, _a, d, order, _h = _slab_step_sorted(
+            SlabState(table=table),
+            expand(ids),
+            now,
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=pallas,
+            count_health=True,
+        )
+        return state.table, jnp.packbits(_unsort(d.code, order) == 2)
+
+    def timeit_full(pallas):
+        tables = [jnp.array(table0) for _ in range(args.repeats + 1)]
+        jax.block_until_ready(tables)
+        out = stage_full(tables[-1], ids, pallas)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [stage_full(tables[i], ids, pallas) for i in range(args.repeats)]
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / args.repeats * 1e3
+
+    results["full_decided_xla_ms"] = round(timeit_full(False), 3)
+    if on_tpu:
+        try:
+            results["full_decided_pallas_ms"] = round(timeit_full(True), 3)
+        except Exception as e:
+            results["full_decided_pallas_error"] = str(e)[-200:]
+
+    per_ms = args.batch / 1e3
+    best = min(
+        v
+        for k, v in results.items()
+        if k.startswith("full_decided") and isinstance(v, (int, float))
+    )
+    results["implied_decisions_per_sec"] = round(per_ms / best * 1e6)
+    print(json.dumps(results))
+    print(
+        f"[profile] batch={args.batch} best full step {best:.2f}ms -> "
+        f"{results['implied_decisions_per_sec']:,} dec/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
