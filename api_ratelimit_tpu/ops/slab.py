@@ -139,6 +139,21 @@ def _choose_slots(state: SlabState, batch: SlabBatch, now, n_probes: int):
     return jnp.where(valid, chosen, jnp.int32(n)), stolen, picked_rows
 
 
+def _sort_key(chosen: jnp.ndarray, fp_hi: jnp.ndarray, n: int) -> jnp.ndarray:
+    """The packed uint32 sort key: slot index in the high bits (the padding
+    sentinel n sorts last), top fingerprint bits below as the contention
+    tiebreaker (see the commentary at the call site in
+    _slab_update_sorted). Shared with tools/profile_engine.py so the
+    profiled sort is always the shipped sort."""
+    slot_bits = n.bit_length()  # chosen ranges 0..n inclusive
+    fp_bits = max(0, min(16, 32 - slot_bits))
+    if not fp_bits:  # slab so large the slot index fills the key
+        return chosen.astype(jnp.uint32)
+    return (chosen.astype(jnp.uint32) << fp_bits) | (
+        fp_hi >> jnp.uint32(32 - fp_bits)
+    )
+
+
 def _slab_update_sorted(
     state: SlabState,
     batch: SlabBatch,
@@ -195,14 +210,7 @@ def _slab_update_sorted(
     # bits in one batch could interleave and split a segment; that
     # undercounts (fails open, same class as the counted contention drop)
     # with probability 2^-fp_bits per contending pair.
-    slot_bits = n.bit_length()  # chosen ranges 0..n inclusive
-    fp_bits = max(0, min(16, 32 - slot_bits))
-    if fp_bits:
-        key = (chosen.astype(jnp.uint32) << fp_bits) | (
-            batch.fp_hi >> jnp.uint32(32 - fp_bits)
-        )
-    else:  # slab so large the slot index fills the key
-        key = chosen.astype(jnp.uint32)
+    key = _sort_key(chosen, batch.fp_hi, n)
     (_, order) = jax.lax.sort(
         (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
     )
@@ -484,11 +492,10 @@ def slab_step_packed(
 
 
 def _unsort(values: jnp.ndarray, order: jnp.ndarray) -> jnp.ndarray:
-    """Undo the slot sort on device (inverse permutation via scatter)."""
-    inv = jnp.zeros_like(order).at[order].set(
-        jnp.arange(order.shape[0], dtype=order.dtype), unique_indices=True
-    )
-    return values[inv]
+    """Undo the slot sort on device: out[order[i]] = values[i] — one direct
+    scatter (order is a permutation, so every slot is written exactly
+    once); works for (b,) and (b, k) values alike."""
+    return jnp.zeros_like(values).at[order].set(values, unique_indices=True)
 
 
 def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray]:

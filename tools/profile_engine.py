@@ -115,18 +115,16 @@ def main() -> None:
 
     results["probe_ms"] = round(timeit(stage_probe, table0, ids), 3)
 
-    # --- stage: probe + packed single-key sort ---
+    # --- stage: probe + packed single-key sort (the shipped _sort_key) ---
+    from api_ratelimit_tpu.ops.slab import _sort_key
+
     @jax.jit
     def stage_sort(table, ids):
         from api_ratelimit_tpu.ops.slab import SlabState
 
         batch = expand(ids)
         chosen, stolen, rows = _choose_slots(SlabState(table=table), batch, now, 4)
-        n = table.shape[0]
-        fp_bits = max(0, min(16, 32 - n.bit_length()))
-        key = (chosen.astype(jnp.uint32) << fp_bits) | (
-            batch.fp_hi >> jnp.uint32(32 - fp_bits)
-        )
+        key = _sort_key(chosen, batch.fp_hi, table.shape[0])
         b = chosen.shape[0]
         return jax.lax.sort(
             (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
@@ -145,20 +143,22 @@ def main() -> None:
         return state.table, _unsort(s_after, order).astype(jnp.uint8)
 
     # donation burns the buffer each call: re-donate a fresh copy per repeat
-    def timeit_donating(pallas):
+    def timeit_donating(fn, pallas):
         tables = [jnp.array(table0) for _ in range(args.repeats + 1)]
         jax.block_until_ready(tables)
-        out = stage_update(tables[-1], ids, pallas)
+        out = fn(tables[-1], ids, pallas)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        outs = [stage_update(tables[i], ids, pallas) for i in range(args.repeats)]
+        outs = [fn(tables[i], ids, pallas) for i in range(args.repeats)]
         jax.block_until_ready(outs)
         return (time.perf_counter() - t0) / args.repeats * 1e3
 
-    results["update_xla_ms"] = round(timeit_donating(False), 3)
+    results["update_xla_ms"] = round(timeit_donating(stage_update, False), 3)
     if on_tpu:
         try:
-            results["update_pallas_ms"] = round(timeit_donating(True), 3)
+            results["update_pallas_ms"] = round(
+                timeit_donating(stage_update, True), 3
+            )
         except Exception as e:
             results["update_pallas_error"] = str(e)[-200:]
 
@@ -178,20 +178,12 @@ def main() -> None:
         )
         return state.table, jnp.packbits(_unsort(d.code, order) == 2)
 
-    def timeit_full(pallas):
-        tables = [jnp.array(table0) for _ in range(args.repeats + 1)]
-        jax.block_until_ready(tables)
-        out = stage_full(tables[-1], ids, pallas)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        outs = [stage_full(tables[i], ids, pallas) for i in range(args.repeats)]
-        jax.block_until_ready(outs)
-        return (time.perf_counter() - t0) / args.repeats * 1e3
-
-    results["full_decided_xla_ms"] = round(timeit_full(False), 3)
+    results["full_decided_xla_ms"] = round(timeit_donating(stage_full, False), 3)
     if on_tpu:
         try:
-            results["full_decided_pallas_ms"] = round(timeit_full(True), 3)
+            results["full_decided_pallas_ms"] = round(
+                timeit_donating(stage_full, True), 3
+            )
         except Exception as e:
             results["full_decided_pallas_error"] = str(e)[-200:]
 
