@@ -166,6 +166,50 @@ class TestReloadUnderFire:
         assert len(set(results)) == total
         assert min(results) == 1_000_000 - total
 
+    def test_pipelined_batcher_concurrent_counts_exact(self, test_store):
+        """Same exactness through the DOUBLE-BUFFERED tpu backend: the
+        dispatcher launches batch k+1 while the collector drains batch k's
+        readback (backends/batcher.py), and no result may be lost,
+        duplicated, or misrouted across that handoff."""
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+
+        store, _ = test_store
+        base = BaseRateLimiter(time_source=FakeTimeSource(5000), jitter_rand=None)
+        cache = TpuRateLimitCache(
+            base, n_slots=1 << 12, batch_window_seconds=0.0005, max_batch=256
+        )
+        scope = store.scope("t")
+        limit = RateLimit(
+            full_key="k",
+            stats=new_rate_limit_stats(scope, "k"),
+            limit=RateLimitValue(requests_per_unit=1_000_000, unit=Unit.HOUR),
+        )
+        req = RateLimitRequest(
+            domain="c", descriptors=(Descriptor.of(("k", "v")),)
+        )
+        n_threads, per_thread = 8, 100
+        results: list[int] = []
+        lock = threading.Lock()
+
+        def worker():
+            local = []
+            for _ in range(per_thread):
+                resp = cache.do_limit(req, [limit])
+                local.append(resp.descriptor_statuses[0].limit_remaining)
+            with lock:
+                results.extend(local)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        cache.close()
+        total = n_threads * per_thread
+        assert len(results) == total
+        assert len(set(results)) == total
+        assert min(results) == 1_000_000 - total
+
 
 class TestSlabPropertyDifferential:
     """hypothesis-driven random op streams: the slab engine must agree with

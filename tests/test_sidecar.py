@@ -400,6 +400,40 @@ class TestRunnerIntegration:
             server.close()
 
 
+def test_malformed_frames_never_kill_the_server(test_store):
+    """A network-exposed listener must shrug off garbage: random bytes,
+    truncated frames, wrong magic — each bad connection dies alone and a
+    well-formed client keeps working afterward."""
+    import random as random_mod
+    import socket as socket_mod
+
+    ts = FakeTimeSource(1_000_000)
+    server = SlabSidecarServer("tcp://127.0.0.1:0", _make_engine(ts))
+    addr = ("127.0.0.1", server.port)
+    rng = random_mod.Random(0xBAD)
+    try:
+        for i in range(20):
+            conn = socket_mod.create_connection(addr, timeout=5)
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+            try:
+                conn.sendall(blob)
+                conn.settimeout(2)
+                conn.recv(64)  # error reply or server-side close; both fine
+            except OSError:
+                pass
+            finally:
+                conn.close()
+        # the server must still serve a real frontend
+        store, _ = test_store
+        cache = frontend(f"tcp://127.0.0.1:{server.port}", ts)
+        limit = make_limit(store.scope("t"), 3, Unit.MINUTE, "k_v")
+        resp = cache.do_limit(req(("k", "v")), [limit])
+        assert resp.descriptor_statuses[0].code == Code.OK
+        cache.close()
+    finally:
+        server.close()
+
+
 def test_oversized_submit_rejected_before_buffering(tmp_path):
     """A hostile/corrupt u32 count must be refused without allocating."""
     import os
