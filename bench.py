@@ -55,15 +55,17 @@ def resolve_platform() -> tuple[str, dict]:
     rc/stderr lands in the returned diagnostics dict, which main() embeds in
     the output JSON so a fallback round is diagnosable from the artifact.
 
-      BENCH_PROBE_TIMEOUT   per-attempt deadline seconds (default 90)
-      BENCH_PROBE_ATTEMPTS  max attempts (default 3, ~5min total budget)
+      BENCH_PROBE_TIMEOUT   per-attempt deadline seconds (default 150 —
+                            r4 observed multi-minute device inits through
+                            the tunnel even when it was healthy)
+      BENCH_PROBE_ATTEMPTS  max attempts (default 3)
     """
     forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
     if forced:
         if forced not in ("cpu", "tpu"):
             raise SystemExit(f"BENCH_PLATFORM must be cpu|tpu, got {forced!r}")
         return forced, {"forced": forced}
-    deadline = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    deadline = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
     max_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
     diag: dict = {"deadline_s": deadline, "attempts": []}
     for attempt in range(1, max_attempts + 1):
