@@ -155,6 +155,40 @@ def run_in_batch_slot_collision_parity(interpret: bool):
     assert np.array_equal(np.asarray(hx), np.asarray(hp))
 
 
+def test_multi_grid_step_carries():
+    """A batch spanning several kernel grid steps (BLOCK_ROWS x 128 items
+    each): the SMEM-carried running totals (hits cumsum, segment-base max)
+    must hand off across step boundaries exactly — compared against the
+    XLA twin on the full table, counters, and health."""
+    from api_ratelimit_tpu.ops.pallas_slab import BLOCK_ROWS, LANES
+
+    b = 2 * BLOCK_ROWS * LANES  # exactly 2 grid steps
+    rng = np.random.RandomState(3)
+    key = rng.randint(0, 2000, b).astype(np.uint64)
+    fp = key * np.uint64(0x9E3779B185EBCA87) + np.uint64(1)
+    hits = rng.randint(1, 3, b).astype(np.uint32)
+    hits[-64:] = 0  # padding tail
+    batch = SlabBatch(
+        fp_lo=jnp.asarray((fp & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+        fp_hi=jnp.asarray((fp >> np.uint64(32)).astype(np.uint32)),
+        hits=jnp.asarray(hits),
+        limit=jnp.asarray(np.full(b, 50, np.uint32)),
+        divider=jnp.asarray(np.full(b, 60, np.int32)),
+        jitter=jnp.asarray(np.zeros(b, np.int32)),
+    )
+    now = jnp.int32(1_000_000)
+    state_x = make_slab(1 << 14)
+    state_p = make_slab(1 << 14)
+    state_x, bx, ax, _, _, hx, _ = _slab_update_sorted(state_x, batch, now, 4)
+    state_p, bp, ap, _, _, hp, _ = _slab_update_sorted(
+        state_p, batch, now, 4, use_pallas=True, interpret=True
+    )
+    assert np.array_equal(np.asarray(bx), np.asarray(bp))
+    assert np.array_equal(np.asarray(ax), np.asarray(ap))
+    assert np.array_equal(np.asarray(hx), np.asarray(hp))
+    assert np.array_equal(np.asarray(state_x.table), np.asarray(state_p.table))
+
+
 def test_update_matches_xla_over_stream():
     run_update_matches_xla_over_stream(interpret=True)
 
