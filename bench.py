@@ -128,14 +128,18 @@ def measure_link(device) -> dict:
         t0 = time.perf_counter()
         np.asarray(jax.device_put(tiny, device))
         rtts.append((time.perf_counter() - t0) * 1e3)
-    big = jax.device_put(np.zeros(8 << 20, np.uint8), device)
+    big_host = np.zeros(8 << 20, np.uint8)
+    t0 = time.perf_counter()
+    big = jax.device_put(big_host, device)
     big.block_until_ready()
+    h2d_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     np.asarray(big)
     d2h_s = time.perf_counter() - t0
     link = {
         "rtt_ms_p50": round(float(np.percentile(rtts, 50)), 3),
         "rtt_ms_max": round(float(np.max(rtts)), 3),
+        "h2d_MBps": round(8.0 / h2d_s, 1),
         "d2h_MBps": round(8.0 / d2h_s, 1),
     }
     print(f"[link] {link}", file=sys.stderr)
