@@ -99,6 +99,7 @@ def _slab_apply_kernel(
     # ([0,0]=carry_sum, [0,1]=carry_max — persists across the sequential grid)
     *refs,
     decide: bool,
+    lean: bool,
     block_rows: int,
 ):
     fp_lo_ref, fp_hi_ref, hits_ref = refs[0], refs[1], refs[2]
@@ -178,12 +179,22 @@ def _slab_apply_kernel(
 
     # --- fused decision math (the pallas_decide formulas, same i32 rules) ---
     limit = limit_ref[...]
+    is_over = after > limit
+    valid = hits > jnp.int32(0)
+
+    out_refs[4][...] = jnp.where(
+        is_over & valid, jnp.int32(CODE_OVER_LIMIT), jnp.int32(CODE_OK)
+    )
+    if lean:
+        # decided-mode fire-and-forget callers read ONLY the code; the
+        # other five decision tiles would be written to HBM and dropped
+        # (an opaque kernel's outputs can't be dead-code-eliminated)
+        return
+
     near_threshold = jnp.floor(
         limit.astype(jnp.float32) * near_ratio
     ).astype(jnp.int32)
-    is_over = after > limit
     near_exceeded = after > near_threshold
-    valid = hits > jnp.int32(0)
 
     all_over = before >= limit
     over_delta_over = jnp.where(all_over, hits, after - limit)
@@ -203,9 +214,6 @@ def _slab_apply_kernel(
     calls_remaining = jnp.maximum(limit - after, jnp.int32(1))
     zero = jnp.int32(0)
 
-    out_refs[4][...] = jnp.where(
-        is_over & valid, jnp.int32(CODE_OVER_LIMIT), jnp.int32(CODE_OK)
-    )
     out_refs[5][...] = jnp.where(valid & ~is_over, limit - after, zero)
     out_refs[6][...] = jnp.where(valid, safe_div - now % safe_div, zero)
     out_refs[7][...] = jnp.where(
@@ -220,7 +228,7 @@ def _slab_apply_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("decide", "interpret")
+    jax.jit, static_argnames=("decide", "lean", "interpret")
 )
 def pallas_slab_apply(
     s_fp_lo: jnp.ndarray,  # uint32[b] slot-sorted
@@ -234,6 +242,7 @@ def pallas_slab_apply(
     now: jnp.ndarray,  # int32 scalar
     near_ratio: jnp.ndarray,  # float32 scalar
     decide: bool = True,
+    lean: bool = False,
     interpret: bool = False,
 ):
     """Run the fused INCRBY(+decide) kernel over a slot-sorted batch.
@@ -241,6 +250,8 @@ def pallas_slab_apply(
     Returns (before, after, new_window, new_expire[, code, remaining,
     duration, throttle, near_delta, over_delta]) — all uint32[b]/int32[b]
     in the SORTED order of the inputs; ops/slab.py unsorts and scatters.
+    lean=True (decide only): stop at the code — the five tiles after it
+    are neither computed nor written (fire-and-forget decided mode).
     """
     (b,) = s_hits.shape
     if b % LANES:
@@ -269,7 +280,7 @@ def pallas_slab_apply(
         as2d(st_rows_t[4]),  # expire
     )
 
-    n_out = 10 if decide else 4
+    n_out = (5 if lean else 10) if decide else 4
     block = pl.BlockSpec((block_rows, LANES), lambda i, *_: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -280,7 +291,7 @@ def pallas_slab_apply(
     )
     outs = pl.pallas_call(
         functools.partial(
-            _slab_apply_kernel, decide=decide, block_rows=block_rows
+            _slab_apply_kernel, decide=decide, lean=lean, block_rows=block_rows
         ),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(shape2d, jnp.int32)] * n_out,

@@ -163,6 +163,7 @@ def _slab_update_sorted(
     use_pallas: bool = False,
     near_ratio: jnp.ndarray | None = None,  # float32 scalar, fused decide only
     fuse_decide: bool = False,
+    lean_decide: bool = False,  # fused decide emits ONLY the code tile
     interpret: bool = False,
 ):
     """The stateful core: probe, serialize duplicates, window-reset,
@@ -253,6 +254,7 @@ def _slab_update_sorted(
             now,
             jnp.float32(0.8) if near_ratio is None else near_ratio,
             decide=fuse_decide,
+            lean=lean_decide,
             interpret=interpret,
         )
         s_before = outs[0].astype(jnp.uint32)
@@ -260,14 +262,28 @@ def _slab_update_sorted(
         cur_window = outs[2]
         expire_at = outs[3]
         if fuse_decide:
-            decision = DecideResult(
-                code=outs[4],
-                limit_remaining=outs[5].astype(jnp.uint32),
-                duration_until_reset=outs[6],
-                throttle_millis=outs[7].astype(jnp.uint32),
-                near_delta=outs[8].astype(jnp.uint32),
-                over_delta=outs[9].astype(jnp.uint32),
-            )
+            if lean_decide:
+                # code is the only real field; zero placeholders keep the
+                # DecideResult shape (the caller drops them, XLA DCEs them)
+                zeros_u = jnp.zeros_like(s_before)
+                zeros_i = jnp.zeros_like(outs[4])
+                decision = DecideResult(
+                    code=outs[4],
+                    limit_remaining=zeros_u,
+                    duration_until_reset=zeros_i,
+                    throttle_millis=zeros_u,
+                    near_delta=zeros_u,
+                    over_delta=zeros_u,
+                )
+            else:
+                decision = DecideResult(
+                    code=outs[4],
+                    limit_remaining=outs[5].astype(jnp.uint32),
+                    duration_until_reset=outs[6],
+                    throttle_millis=outs[7].astype(jnp.uint32),
+                    near_delta=outs[8].astype(jnp.uint32),
+                    over_delta=outs[9].astype(jnp.uint32),
+                )
     else:
         incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
         excl = incl - s_hits
@@ -357,6 +373,7 @@ def _slab_step_sorted(
     n_probes: int,
     use_pallas: bool,
     count_health: bool = True,
+    lean_decide: bool = False,
     interpret: bool = False,
 ):
     """Core step with on-device decision; returns results in slot-sorted
@@ -376,6 +393,7 @@ def _slab_step_sorted(
             use_pallas=use_pallas,
             near_ratio=near_ratio,
             fuse_decide=use_pallas,
+            lean_decide=lean_decide,
             interpret=interpret,
         )
     )
@@ -552,10 +570,13 @@ def slab_step_decided(
     """Full on-device decision; only the 1-byte code per item (1=OK,
     2=OVER_LIMIT, arrival order) plus the uint32[2] health come back.
     count_health=False skips the health reductions for fire-and-forget
-    callers that drop the vector (the bench)."""
+    callers that drop the vector (the bench). The pallas kernel runs lean:
+    only the code tile is computed and written (the XLA twin's unused
+    decision fields are dead-code-eliminated by the compiler anyway)."""
     batch, now, near_ratio = _unpack(packed)
     state, _before, _after, d, order, health = _slab_step_sorted(
-        state, batch, now, near_ratio, n_probes, use_pallas, count_health
+        state, batch, now, near_ratio, n_probes, use_pallas, count_health,
+        lean_decide=use_pallas,
     )
     return state, _unsort(d.code, order).astype(jnp.uint8), health
 

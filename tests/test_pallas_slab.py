@@ -107,6 +107,48 @@ def run_fused_decide_matches_xla_decide(interpret: bool):
             assert np.array_equal(got, want), f"{field} step {step}"
 
 
+def run_lean_decide_matches_full(interpret: bool):
+    """lean_decide (decided-mode fire-and-forget): the kernel writes only
+    the code tile, which must equal the full kernel's code and the XLA
+    twin's, with identical state evolution."""
+    rng = np.random.RandomState(23)
+    state_x = make_slab(N_SLOTS)
+    state_l = make_slab(N_SLOTS)
+    now = 2_000_000
+    for step in range(5):
+        batch = random_batch(rng, 384, n_keys=32)
+        now += rng.randint(0, 2)
+        state_x, _, _, dx, ox, hx = _slab_step_sorted(
+            state_x,
+            batch,
+            jnp.int32(now),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=False,
+        )
+        state_l, _, _, dl, ol, hl = _slab_step_sorted(
+            state_l,
+            batch,
+            jnp.int32(now),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=True,
+            lean_decide=True,
+            interpret=interpret,
+        )
+        got = np.asarray(_unsort(dl.code, ol))
+        want = np.asarray(_unsort(dx.code, ox))
+        assert np.array_equal(got, want), f"code step {step}"
+        assert np.array_equal(np.asarray(hx), np.asarray(hl))
+        assert np.array_equal(
+            np.asarray(state_x.table), np.asarray(state_l.table)
+        ), f"table diverged at step {step}"
+
+
+def test_lean_decide_matches_full():
+    run_lean_decide_matches_full(interpret=True)
+
+
 def test_kernel_rejects_bad_shapes():
     from api_ratelimit_tpu.ops.pallas_slab import pallas_slab_apply
 

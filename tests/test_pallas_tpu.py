@@ -41,6 +41,7 @@ if jax.devices()[0].platform != "tpu":
 from test_pallas_slab import (  # noqa: E402
     run_fused_decide_matches_xla_decide,
     run_in_batch_slot_collision_parity,
+    run_lean_decide_matches_full,
     run_update_matches_xla_over_stream,
 )
 
@@ -51,6 +52,10 @@ def test_update_matches_xla_on_chip():
 
 def test_fused_decide_matches_xla_on_chip():
     run_fused_decide_matches_xla_decide(interpret=False)
+
+
+def test_lean_decide_on_chip():
+    run_lean_decide_matches_full(interpret=False)
 
 
 def test_in_batch_slot_collision_on_chip():
