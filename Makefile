@@ -7,9 +7,9 @@ PY ?= python
 NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
-.PHONY: all compile native proto tests tests_unit tests_integration \
-        tests_with_redis tests_tpu bench serve check_config clean \
-        docker_image docker_tests
+.PHONY: all compile native proto tests tests_unit tests_artifact \
+        tests_integration tests_with_redis tests_tpu bench serve \
+        check_config clean docker_image docker_tests
 
 all: compile
 
@@ -30,11 +30,16 @@ proto:
 # (tests/conftest.py forces JAX_PLATFORMS=cpu; the reference's equivalent
 # is `go test -race ./...`, Makefile:83-85).
 tests_unit:
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# The multi-second bench-subprocess tests (artifact discipline): isolated
+# from tests_unit so a wall-clock hiccup can't -x-fail the whole stage.
+tests_artifact:
+	$(PY) -m pytest tests/ -q -m slow
 
 # Full suite; the in-process fake Redis/Memcache servers play the role the
 # reference's local redis fleet plays (Makefile:91-125).
-tests: tests_unit
+tests: tests_unit tests_artifact
 
 # Integration tier against REAL redis-server processes (single, auth,
 # sentinel, 3-node cluster, full runner) — the analog of the reference's

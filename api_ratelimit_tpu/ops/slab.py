@@ -263,27 +263,19 @@ def _slab_update_sorted(
         expire_at = outs[3]
         if fuse_decide:
             if lean_decide:
-                # code is the only real field; zero placeholders keep the
-                # DecideResult shape (the caller drops them, XLA DCEs them)
-                zeros_u = jnp.zeros_like(s_before)
+                # code is the only real tile; pad with zero placeholders so
+                # one constructor serves both modes (the caller drops them,
+                # XLA DCEs them)
                 zeros_i = jnp.zeros_like(outs[4])
-                decision = DecideResult(
-                    code=outs[4],
-                    limit_remaining=zeros_u,
-                    duration_until_reset=zeros_i,
-                    throttle_millis=zeros_u,
-                    near_delta=zeros_u,
-                    over_delta=zeros_u,
-                )
-            else:
-                decision = DecideResult(
-                    code=outs[4],
-                    limit_remaining=outs[5].astype(jnp.uint32),
-                    duration_until_reset=outs[6],
-                    throttle_millis=outs[7].astype(jnp.uint32),
-                    near_delta=outs[8].astype(jnp.uint32),
-                    over_delta=outs[9].astype(jnp.uint32),
-                )
+                outs = (*outs, zeros_i, zeros_i, zeros_i, zeros_i, zeros_i)
+            decision = DecideResult(
+                code=outs[4],
+                limit_remaining=outs[5].astype(jnp.uint32),
+                duration_until_reset=outs[6],
+                throttle_millis=outs[7].astype(jnp.uint32),
+                near_delta=outs[8].astype(jnp.uint32),
+                over_delta=outs[9].astype(jnp.uint32),
+            )
     else:
         incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
         excl = incl - s_hits

@@ -977,7 +977,9 @@ def main() -> None:
     # (MULTICHIP_r*.json is the real correctness gate) and must never
     # starve the tiers above (it burned round 3's artifact).
     try:
-        if max(n_mesh, len(jax.devices())) > 1:
+        if left() < 60:
+            engine["sharded"] = {"skipped": "budget"}
+        elif max(n_mesh, len(jax.devices())) > 1:
             engine["sharded"] = bench_engine_sharded(
                 min(n_mesh or len(jax.devices()), len(jax.devices())), on_tpu
             )

@@ -40,6 +40,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: runs the Pallas kernel COMPILED on a real TPU"
     )
+    config.addinivalue_line(
+        "markers", "slow: multi-second subprocess tests (bench artifact)"
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,132 @@
+"""bench.py artifact-machinery tests.
+
+Round 3's bench died to a driver timeout (rc=124) and lost every measured
+number because results only printed at the end (VERDICT r3 missing #1).
+The restructured bench emits a cumulative, complete JSON line after every
+tier — these tests pin that discipline, including the hard case: a SIGKILL
+mid-run must still leave a parseable final line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _bench_env() -> dict:
+    env = dict(os.environ)
+    env["BENCH_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    # the suite's conftest forces an 8-device virtual mesh via XLA_FLAGS;
+    # the bench subprocess must see the driver's single-device environment
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+class TestZipfIds:
+    def test_deterministic_and_in_range(self):
+        sys.path.insert(0, REPO)
+        try:
+            from bench import zipf_ids
+        finally:
+            sys.path.remove(REPO)
+        a = zipf_ids(1000, 64, 3, seed=7)
+        b = zipf_ids(1000, 64, 3, seed=7)
+        assert a.shape == (3, 64)
+        assert a.dtype == np.uint32
+        assert np.array_equal(a, b)
+        assert int(a.max()) < 1000
+        # Zipf: the head must dominate (mod-folding flattens it somewhat)
+        assert (a == 1).mean() > 0.05
+
+
+@pytest.mark.slow
+class TestArtifactDiscipline:
+    def test_sigkill_mid_run_leaves_parseable_artifact(self):
+        """SIGKILL while tiers are still running: stdout must already hold
+        at least one COMPLETE cumulative JSON line with the headline
+        fields (this is exactly the round-3 failure mode)."""
+        env = _bench_env()
+        env["BENCH_BUDGET_S"] = "400"
+        proc = subprocess.Popen(
+            [sys.executable, BENCH],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            cwd=REPO,
+        )
+        # wait for the first emitted line (engine headline), then kill hard
+        deadline = time.monotonic() + 120
+        lines: list[str] = []
+        os.set_blocking(proc.stdout.fileno(), False)
+        buf = b""
+        headline_seen = False
+        while time.monotonic() < deadline:
+            chunk = proc.stdout.read() or b""
+            buf += chunk
+            if b"\n" in buf and b'"rate"' in buf:
+                headline_seen = True
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        if not headline_seen and proc.poll() is None:
+            # environment too slow to reach the headline inside the window:
+            # killing now would assert on a run that never got its chance
+            proc.send_signal(signal.SIGKILL)
+            proc.communicate(timeout=30)
+            pytest.skip("engine headline not reached within 120s on this box")
+        proc.send_signal(signal.SIGKILL)
+        os.set_blocking(proc.stdout.fileno(), True)
+        rest, _ = proc.communicate(timeout=30)
+        buf += rest or b""
+        lines = [l for l in buf.decode().splitlines() if l.startswith("{")]
+        assert lines, "no JSON line emitted before the kill"
+        last = json.loads(lines[-1])
+        assert last["metric"] == "rate_limit_decisions_per_sec_zipf10M"
+        assert "configs" in last and "zipf_10M_engine" in last["configs"]
+        engine = last["configs"]["zipf_10M_engine"]
+        assert "rate" in engine or "error" in engine
+
+    def test_budget_exhaustion_marks_skips_and_exits_zero(self):
+        """A tiny budget: the run must still exit 0 with every tier present
+        or explicitly skip-marked in the final line."""
+        env = _bench_env()
+        env["BENCH_BUDGET_S"] = "1"
+        proc = subprocess.run(
+            [sys.executable, BENCH],
+            capture_output=True,
+            timeout=420,  # generous headroom over the engine tier's CPU time
+            env=env,
+            cwd=REPO,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        assert lines
+        last = json.loads(lines[-1])
+        configs = last["configs"]
+        # engine always runs; later tiers must be skip-marked, not absent
+        for tier in (
+            "flat_per_second",
+            "nested_tree",
+            "dual_window",
+            "near_limit_local_cache",
+            "shadow_mode",
+            "sidecar",
+        ):
+            assert tier in configs, f"{tier} missing from artifact"
+            assert configs[tier] == {"skipped": "budget"}, configs[tier]
+        assert configs["zipf_10M_engine"].get("sharded") == {
+            "skipped": "budget"
+        }
