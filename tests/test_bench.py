@@ -90,8 +90,12 @@ class TestArtifactDiscipline:
         os.set_blocking(proc.stdout.fileno(), True)
         rest, _ = proc.communicate(timeout=30)
         buf += rest or b""
-        lines = [l for l in buf.decode().splitlines() if l.startswith("{")]
-        assert lines, "no JSON line emitted before the kill"
+        # SIGKILL can land mid-write: a trailing fragment without its
+        # newline still startswith "{" but is truncated — only
+        # newline-terminated lines honor the "last complete line" contract
+        complete = buf.decode()[: buf.decode().rfind("\n") + 1]
+        lines = [l for l in complete.splitlines() if l.startswith("{")]
+        assert lines, "no complete JSON line emitted before the kill"
         last = json.loads(lines[-1])
         assert last["metric"] == "rate_limit_decisions_per_sec_zipf10M"
         assert "configs" in last and "zipf_10M_engine" in last["configs"]

@@ -1,0 +1,203 @@
+"""Bisect the slab step: time cumulative prefixes of the device program.
+
+The r4 microbench (tools/microbench_gather.py) showed every data-movement
+primitive of the step costs <0.4ms at batch 2^20 on the chip, yet the full
+step measures ~294ms (tools/profile_engine.py). Some specific composition is
+pathological; this times a chain of cumulative prefixes of the exact shipped
+program to find the first one that explodes. Each prefix returns reductions
+over everything it computed so XLA cannot dead-code-eliminate a stage while
+output-write costs stay negligible.
+
+Usage: python tools/bisect_step.py [--batch 1048576] [--slots 8388608]
+Prints one JSON object: prefix -> ms/call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        COL_COUNT,
+        COL_EXPIRE,
+        COL_FP_HI,
+        COL_FP_LO,
+        COL_WINDOW,
+        SlabBatch,
+        _sort_key,
+    )
+
+    device = jax.devices()[0]
+    if device.platform != "tpu" and args.batch > (1 << 14):
+        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+
+    b, n = args.batch, args.slots
+    rng = np.random.RandomState(0)
+    ids_np = (rng.zipf(1.1, size=b).astype(np.uint64) % args.keys).astype(np.uint32)
+    ids = jax.device_put(ids_np, device)
+    table = jax.device_put(np.zeros((n, 8), np.uint32), device)
+    now_i = jnp.int32(1_700_000_000)
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    def prefix(stop: str):
+        """Build a jitted fn computing the step up to `stop`, returning
+        cheap reductions of every live intermediate."""
+
+        def fn(table, ids):
+            outs = []
+            batch = expand(ids)
+            outs.append(batch.fp_lo.sum())
+            if stop == "expand":
+                return outs
+            mask = jnp.uint32(n - 1)
+            step = batch.fp_hi | jnp.uint32(1)
+            j = jnp.arange(4, dtype=jnp.uint32)
+            cand = ((batch.fp_lo[:, None] + j[None, :] * step[:, None]) & mask).astype(
+                jnp.int32
+            )
+            outs.append(cand.sum())
+            if stop == "cand":
+                return outs
+            rows = table[cand]
+            outs.append(rows.sum())
+            if stop == "gather":
+                return outs
+            live = rows[:, :, COL_EXPIRE].astype(jnp.int32) > now_i
+            match = (
+                live
+                & (rows[:, :, COL_FP_LO] == batch.fp_lo[:, None])
+                & (rows[:, :, COL_FP_HI] == batch.fp_hi[:, None])
+            )
+            avail = ~live
+            match_any = match.any(axis=1)
+            avail_any = avail.any(axis=1)
+            pick = jnp.where(
+                match_any,
+                jnp.argmax(match, axis=1),
+                jnp.where(avail_any, jnp.argmax(avail, axis=1), 0),
+            )
+            chosen = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]
+            outs.append(chosen.sum())
+            if stop == "choose":
+                return outs
+            picked_rows = jnp.take_along_axis(rows, pick[:, None, None], axis=1)[:, 0]
+            outs.append(picked_rows.sum())
+            if stop == "pickrows":
+                return outs
+            key = _sort_key(chosen, batch.fp_hi, n)
+            (_, order) = jax.lax.sort(
+                (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
+            )
+            outs.append(order.sum())
+            if stop == "sort":
+                return outs
+            s_slot = chosen[order]
+            s_fp_lo = batch.fp_lo[order]
+            s_fp_hi = batch.fp_hi[order]
+            s_hits = batch.hits[order]
+            st_rows = picked_rows[order]
+            outs.append(s_slot.sum() + s_fp_lo.sum() + st_rows.sum() + s_hits.sum())
+            if stop == "permute":
+                return outs
+            same_prev = (
+                (s_slot[1:] == s_slot[:-1])
+                & (s_fp_lo[1:] == s_fp_lo[:-1])
+                & (s_fp_hi[1:] == s_fp_hi[:-1])
+            )
+            seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
+            incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+            excl = incl - s_hits
+            seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+            prior = excl - seg_base_excl
+            st_count = st_rows[:, COL_COUNT]
+            st_window = st_rows[:, COL_WINDOW].astype(jnp.int32)
+            st_expire = st_rows[:, COL_EXPIRE].astype(jnp.int32)
+            fp_match = (
+                (st_expire > now_i)
+                & (st_rows[:, COL_FP_LO] == s_fp_lo)
+                & (st_rows[:, COL_FP_HI] == s_fp_hi)
+            )
+            base = jnp.where(
+                (s_hits > 0) & fp_match & (st_window == now_i), st_count, jnp.uint32(0)
+            )
+            s_after = base + prior + s_hits
+            outs.append(s_after.sum())
+            if stop == "update":
+                return outs
+            is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
+            write_idx = jnp.where(is_last, s_slot, jnp.int32(n))
+            new_rows = jnp.stack([s_fp_lo, s_fp_hi, s_after] + [s_fp_lo] * 5, axis=1)
+            t2 = table.at[write_idx].set(new_rows, mode="drop", unique_indices=True)
+            outs.append(t2[0].sum())
+            if stop == "scatter":
+                return outs
+            unsorted = jnp.zeros_like(s_after).at[order].set(
+                s_after, unique_indices=True
+            )
+            outs.append(unsorted.sum())
+            return outs
+
+        return jax.jit(fn)
+
+    def timeit(fn):
+        out = fn(table, ids)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.repeats):
+            out = fn(table, ids)
+        jax.block_until_ready(out)
+        return round((time.perf_counter() - t0) / args.repeats * 1e3, 3)
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+    for stop in (
+        "expand",
+        "cand",
+        "gather",
+        "choose",
+        "pickrows",
+        "sort",
+        "permute",
+        "update",
+        "scatter",
+        "unsort",
+    ):
+        results[stop + "_ms"] = timeit(prefix(stop))
+        print(f"[bisect] {stop}: {results[stop + '_ms']}ms", file=sys.stderr)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Where are compares fast? XLA elementwise vs Mosaic (Pallas) kernels.
+
+tools/microbench_isolate.py showed on this stack a bare XLA elementwise
+compare over 2^20 elements costs ~9-27ms (vs 0.03ms gathers, 0.37ms sort) —
+compare/select lowerings are the engine's real bottleneck, not data movement.
+This measures the same logic compiled through Mosaic, plus which XLA op
+classes exactly are slow (compare / select / int32 reduce / bool convert),
+all with varied inputs per repeat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    device = jax.devices()[0]
+    b = args.batch
+    if device.platform != "tpu" and b > (1 << 14):
+        b = 1 << 13
+
+    rng = np.random.RandomState(0)
+    xs = [
+        jax.device_put(
+            rng.randint(0, 1 << 31, size=b).astype(np.int32), device
+        )
+        for _ in range(args.repeats)
+    ]
+    now = jnp.int32(1 << 30)
+    results: dict = {"platform": device.platform, "batch": b}
+
+    def timeit(label, f):
+        out = f(xs[-1])
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [f(x) for x in xs]
+        jax.block_until_ready(outs)
+        ms = round((time.perf_counter() - t0) / len(xs) * 1e3, 3)
+        results[label] = ms
+        print(f"[cmp-paths] {label}: {ms}ms", file=sys.stderr)
+
+    # --- XLA op-class isolation ---
+    timeit("xla_sum_u32", jax.jit(lambda x: x.astype(jnp.uint32).sum()))
+    timeit("xla_sum_i32", jax.jit(lambda x: x.sum()))
+    timeit("xla_add_out", jax.jit(lambda x: x + jnp.int32(1)))  # no compare
+    timeit("xla_cmp_out", jax.jit(lambda x: (x > now).astype(jnp.int32)))
+    timeit("xla_sel_out", jax.jit(lambda x: jnp.where(x > now, x, -x)))
+    timeit("xla_min_out", jax.jit(lambda x: jnp.minimum(x, now)))
+    # arithmetic-only mask blend (the compare-free alternative)
+    timeit(
+        "xla_arith_mask_out",
+        jax.jit(lambda x: (x & ((now - x) >> 31)) | (-x & ~((now - x) >> 31))),
+    )
+
+    # --- the same compare+select through a Mosaic kernel ---
+    LANES = 128
+    rows = b // LANES
+
+    NOW = 1 << 30  # python literal: lowers as an immediate, no capture
+
+    def sel_kernel(x_ref, o_ref):
+        x = x_ref[...]
+        o_ref[...] = jnp.where(x > NOW, x, -x)
+
+    block = min(rows, 256)
+
+    @jax.jit
+    def pallas_sel(x):
+        x2 = x.reshape(rows, LANES)
+        return pl.pallas_call(
+            sel_kernel,
+            grid=(rows // block,),
+            in_specs=[pl.BlockSpec((block, LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        )(x2)
+
+    try:
+        timeit("pallas_sel_out", pallas_sel)
+    except Exception as e:
+        results["pallas_sel_error"] = str(e)[-200:]
+
+    # several compares + selects fused in one kernel (probe-select shape)
+    def chain_kernel(x_ref, o_ref):
+        x = x_ref[...]
+        m1 = x > NOW
+        m2 = (x & 7) == 3
+        m3 = x < (NOW >> 1)
+        r = jnp.where(m1, x, -x)
+        r = jnp.where(m2, r + 1, r)
+        r = jnp.where(m3 & m1, r ^ 21, r)
+        o_ref[...] = r
+
+    @jax.jit
+    def pallas_chain(x):
+        x2 = x.reshape(rows, LANES)
+        return pl.pallas_call(
+            chain_kernel,
+            grid=(rows // block,),
+            in_specs=[pl.BlockSpec((block, LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        )(x2)
+
+    try:
+        timeit("pallas_chain_out", pallas_chain)
+    except Exception as e:
+        results["pallas_chain_error"] = str(e)[-200:]
+
+    # XLA twin of the chain for the head-to-head
+    @jax.jit
+    def xla_chain(x):
+        m1 = x > now
+        m2 = (x & 7) == 3
+        m3 = x < (now >> 1)
+        r = jnp.where(m1, x, -x)
+        r = jnp.where(m2, r + 1, r)
+        r = jnp.where(m3 & m1, r ^ 21, r)
+        return r
+
+    timeit("xla_chain_out", xla_chain)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Cost-model microbench for the slab's data-movement primitives.
+
+The r4 hardware profile (tools/profile_engine.py) showed the engine step is
+dominated by gather/scatter, not the sort: probe gather ~131ms of a ~294ms
+step at batch 2^20 over a [2^23, 8] table. Before redesigning the slab
+layout, this measures each candidate primitive in isolation so the choice
+is driven by the chip's actual gather cost model (per-element overhead vs
+bytes moved), not guesses:
+
+  * flat u32 gather from [n]             (structure-of-arrays probe)
+  * row gather from [n, 8]               (current fused-row probe)
+  * 4-candidate row gather (b,4) idx     (current probe shape)
+  * bucket gather from [n/4, 32]         (4-way set-associative probe:
+                                          one 128B fetch covers 4 ways)
+  * bucket gather from [n/16, 128]       (16-way, one full 512B lane row)
+  * row scatter to [n, 8]                (current write-back)
+  * bucket scatter to [n/16, 128]
+  * 2-operand lax.sort at 2^20           (duplicate grouping)
+  * permutation gather (order apply)     (the post-sort operand permute)
+
+Usage:  python tools/microbench_gather.py [--batch 1048576] [--slots 8388608]
+Prints one JSON object of stage -> ms/call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--repeats", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    if device.platform != "tpu" and args.batch > (1 << 14):
+        args.batch, args.slots = 1 << 13, 1 << 18
+
+    b, n = args.batch, args.slots
+    rng = np.random.RandomState(0)
+    idx_np = rng.randint(0, n, size=b).astype(np.int32)
+    cand_np = rng.randint(0, n, size=(b, 4)).astype(np.int32)
+
+    idx = jax.device_put(idx_np, device)
+    cand = jax.device_put(cand_np, device)
+    tab1 = jax.device_put(np.zeros(n, np.uint32), device)
+    tab8 = jax.device_put(np.zeros((n, 8), np.uint32), device)
+    tab32 = jax.device_put(np.zeros((n // 4, 32), np.uint32), device)
+    tab128 = jax.device_put(np.zeros((n // 16, 128), np.uint32), device)
+    idx4 = jax.device_put((idx_np // 4).astype(np.int32), device)
+    idx16 = jax.device_put((idx_np // 16).astype(np.int32), device)
+    rows_np = np.zeros((b, 8), np.uint32)
+    rows = jax.device_put(rows_np, device)
+    rows128 = jax.device_put(np.zeros((b, 128), np.uint32), device)
+    key = jax.device_put(rng.randint(0, 1 << 31, size=b).astype(np.uint32), device)
+    vals = jax.device_put(rng.randint(0, 255, size=b).astype(np.uint32), device)
+    order = jax.device_put(rng.permutation(b).astype(np.int32), device)
+
+    def timeit(fn, *xs):
+        out = fn(*xs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.repeats):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return round((time.perf_counter() - t0) / args.repeats * 1e3, 3)
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+
+    results["gather_flat_u32_ms"] = timeit(jax.jit(lambda t, i: t[i]), tab1, idx)
+    results["gather_row8_ms"] = timeit(jax.jit(lambda t, i: t[i]), tab8, idx)
+    results["gather_row8_x4_ms"] = timeit(jax.jit(lambda t, c: t[c]), tab8, cand)
+    results["gather_bucket32_ms"] = timeit(jax.jit(lambda t, i: t[i]), tab32, idx4)
+    results["gather_bucket128_ms"] = timeit(
+        jax.jit(lambda t, i: t[i]), tab128, idx16
+    )
+    results["gather_flat_x4_ms"] = timeit(jax.jit(lambda t, c: t[c]), tab1, cand)
+
+    results["scatter_row8_ms"] = timeit(
+        jax.jit(lambda t, i, r: t.at[i].set(r, mode="drop", unique_indices=True)),
+        tab8,
+        idx,
+        rows,
+    )
+    results["scatter_bucket128_ms"] = timeit(
+        jax.jit(
+            lambda t, i, r: t.at[i].set(r, mode="drop", unique_indices=True)
+        ),
+        tab128,
+        idx16,
+        rows128,
+    )
+    results["scatter_flat_ms"] = timeit(
+        jax.jit(lambda t, i, v: t.at[i].set(v, mode="drop", unique_indices=True)),
+        tab1,
+        idx,
+        vals,
+    )
+
+    results["sort2_ms"] = timeit(
+        jax.jit(
+            lambda k: jax.lax.sort(
+                (k, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
+            )
+        ),
+        key,
+    )
+    results["perm_gather_u32_ms"] = timeit(jax.jit(lambda v, o: v[o]), vals, order)
+    results["perm_gather_row8_ms"] = timeit(jax.jit(lambda v, o: v[o]), rows, order)
+    results["cumsum_cummax_ms"] = timeit(
+        jax.jit(lambda v: (jnp.cumsum(v), jax.lax.cummax(v))), vals
+    )
+
+    print(json.dumps(results))
+    print(f"[microbench] {results}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

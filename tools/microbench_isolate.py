@@ -1,0 +1,103 @@
+"""Isolate WHICH op class makes the probe 1000x slower than its parts.
+
+tools/microbench_varied.py showed flat-vs-shaped probe layouts are equally
+slow (~110ms at b=2^20) while one flat gather is 0.03ms. Candidates:
+  * computed (derived) gather indices vs raw input indices
+  * gather count (2, 4, 12 independent gathers in one program)
+  * bool (i1) compares / logic / where vs arithmetic int32 masks
+  * select chains over gathered values
+Each variant runs with varied index inputs per repeat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    b, n = args.batch, args.slots
+    if device.platform != "tpu" and b > (1 << 14):
+        b, n = 1 << 13, 1 << 18
+
+    rng = np.random.RandomState(0)
+    idxs = [
+        jax.device_put(rng.randint(0, n, size=b).astype(np.uint32), device)
+        for _ in range(args.repeats)
+    ]
+    tab1 = jax.device_put(rng.randint(0, 1 << 31, size=n).astype(np.uint32), device)
+    now = jnp.int32(1 << 30)
+    mask = np.uint32(n - 1)
+
+    def timeit(label, fn):
+        f = jax.jit(fn)
+        out = f(tab1, idxs[-1])
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [f(tab1, x) for x in idxs]
+        jax.block_until_ready(outs)
+        ms = round((time.perf_counter() - t0) / len(idxs) * 1e3, 3)
+        results[label] = ms
+        print(f"[isolate] {label}: {ms}ms", file=sys.stderr)
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+
+    timeit("g1_raw", lambda t, i: t[i].sum())
+    timeit("g1_computed", lambda t, i: t[(i * jnp.uint32(7) + 3) & mask].sum())
+    timeit("g2_raw", lambda t, i: t[i].sum() + t[(i + 1) & mask].sum())
+    timeit(
+        "g4_computed",
+        lambda t, i: sum(
+            t[(i * jnp.uint32(2 * k + 1) + k) & mask].sum() for k in range(4)
+        ),
+    )
+    timeit(
+        "g12_computed",
+        lambda t, i: sum(
+            t[(i * jnp.uint32(2 * k + 1) + k) & mask].sum() for k in range(12)
+        ),
+    )
+    # one gather + bool compare + bool reduce
+    timeit("g1_cmp_bool", lambda t, i: (t[i].astype(jnp.int32) > now).sum())
+    # same semantics, no i1 anywhere: arithmetic sign mask
+    timeit(
+        "g1_cmp_arith",
+        lambda t, i: ((now - t[i].astype(jnp.int32)) >> 31).sum(),
+    )
+    # compare two gathered values (the match test shape)
+    timeit("g2_eq_bool", lambda t, i: (t[i] == t[(i + 1) & mask]).sum())
+    # where-select over a gathered compare
+    timeit(
+        "g2_where",
+        lambda t, i: jnp.where(
+            t[i].astype(jnp.int32) > now, i.astype(jnp.int32), -1
+        ).sum(),
+    )
+    # compare against a NON-gathered operand (pure elementwise)
+    timeit("cmp_elementwise", lambda t, i: (i.astype(jnp.int32) > now).sum())
+    timeit(
+        "where_elementwise",
+        lambda t, i: jnp.where(
+            i.astype(jnp.int32) > now, i.astype(jnp.int32), -1
+        ).sum(),
+    )
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Trust-check for the gather cost model: varied inputs per repeat.
+
+tools/microbench_gather.py repeats identical calls; if any layer caches
+identical executions the numbers would be fiction. This stages R distinct
+index arrays and loops over them (the real bench's pattern), timing:
+  * flat u32 gather, varied idx
+  * the unrolled-K flat probe select chain (the proposed redesign)
+  * the current (b, K) shaped probe choose (the suspected pathology)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    b, n = args.batch, args.slots
+    if device.platform != "tpu" and b > (1 << 14):
+        b, n = 1 << 13, 1 << 18
+
+    rng = np.random.RandomState(0)
+    R = args.repeats
+    idxs = [
+        jax.device_put(rng.randint(0, n, size=b).astype(np.uint32), device)
+        for _ in range(R)
+    ]
+    tab1 = jax.device_put(
+        rng.randint(0, 1 << 31, size=n).astype(np.uint32), device
+    )
+    tab8 = jax.device_put(
+        rng.randint(0, 1 << 31, size=(n, 8)).astype(np.uint32), device
+    )
+    now = jnp.int32(1 << 30)
+
+    def timeit(fn, inputs):
+        out = fn(inputs[-1])
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [fn(x) for x in inputs]
+        jax.block_until_ready(outs)
+        return round((time.perf_counter() - t0) / len(inputs) * 1e3, 3)
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+
+    gather_flat = jax.jit(lambda t, i: t[i].sum())
+    results["gather_flat_varied_ms"] = timeit(lambda i: gather_flat(tab1, i), idxs)
+
+    @jax.jit
+    def probe_flat(tab1, fp_lo):
+        fp_hi = fp_lo ^ jnp.uint32(0x9E3779B9)
+        step = fp_hi | jnp.uint32(1)
+        mask = jnp.uint32(n - 1)
+        match_any = jnp.zeros(fp_lo.shape, jnp.bool_)
+        avail_any = jnp.zeros(fp_lo.shape, jnp.bool_)
+        match_slot = jnp.zeros(fp_lo.shape, jnp.int32)
+        avail_slot = jnp.zeros(fp_lo.shape, jnp.int32)
+        cand0 = None
+        for k in reversed(range(4)):
+            cand = ((fp_lo + jnp.uint32(k) * step) & mask).astype(jnp.int32)
+            if k == 0:
+                cand0 = cand
+            st_lo = tab1[cand]
+            st_hi = tab1[(cand + 1) & (n - 1)]
+            st_exp = tab1[(cand + 2) & (n - 1)].astype(jnp.int32)
+            live = st_exp > now
+            match = live & (st_lo == fp_lo) & (st_hi == fp_hi)
+            avail = ~live
+            match_slot = jnp.where(match, cand, match_slot)
+            avail_slot = jnp.where(avail, cand, avail_slot)
+            match_any = match_any | match
+            avail_any = avail_any | avail
+        chosen = jnp.where(
+            match_any, match_slot, jnp.where(avail_any, avail_slot, cand0)
+        )
+        return chosen.sum()
+
+    results["probe_flat_unrolled_ms"] = timeit(lambda i: probe_flat(tab1, i), idxs)
+
+    @jax.jit
+    def probe_shaped(tab8, fp_lo):
+        fp_hi = fp_lo ^ jnp.uint32(0x9E3779B9)
+        step = fp_hi | jnp.uint32(1)
+        mask = jnp.uint32(n - 1)
+        j = jnp.arange(4, dtype=jnp.uint32)
+        cand = ((fp_lo[:, None] + j[None, :] * step[:, None]) & mask).astype(
+            jnp.int32
+        )
+        rows = tab8[cand]
+        live = rows[:, :, 4].astype(jnp.int32) > now
+        match = (
+            live
+            & (rows[:, :, 0] == fp_lo[:, None])
+            & (rows[:, :, 1] == fp_hi[:, None])
+        )
+        avail = ~live
+        match_any = match.any(axis=1)
+        avail_any = avail.any(axis=1)
+        pick = jnp.where(
+            match_any,
+            jnp.argmax(match, axis=1),
+            jnp.where(avail_any, jnp.argmax(avail, axis=1), 0),
+        )
+        chosen = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]
+        return chosen.sum()
+
+    results["probe_shaped_ms"] = timeit(lambda i: probe_shaped(tab8, i), idxs)
+
+    print(json.dumps(results))
+    print(f"[varied] {results}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
