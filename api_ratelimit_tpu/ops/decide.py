@@ -36,6 +36,43 @@ CODE_OK = 1
 CODE_OVER_LIMIT = 2
 
 
+def floor_div_exact_i32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Exact floor(a / b) without integer division, for int32 operands with
+    0 <= a < 2^31 and 1 <= b < 2^31.
+
+    XLA and Mosaic both expand a VECTOR integer divide into a ~32-pass
+    shift-subtract loop; on v5e that measured ~100ms per division site at
+    batch 2^20 (tools/bisect_step2.py vs tools/engine_ab.py: the slab step
+    is ~0.15ms without its divisions and ~300ms with them). The float32
+    seed quotient can be off by up to ~2^8 near a = 2^31 (float32 carries
+    24 bits); the refinement divides the SMALL residual, which float32
+    represents exactly, landing within +-1, and the integer fixup finishes.
+    All three steps are load-bearing — do not drop the refinement on the
+    strength of the seed alone. The seed is clamped below 2^31 because an
+    out-of-range float32->int32 convert is implementation-defined.
+    Mosaic-safe: int32/float32 ops only (kernels reuse this body verbatim).
+    Exactness is pinned against numpy // in tests/test_slab.py.
+    """
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    bf = b.astype(jnp.float32)
+    qf = jnp.floor(a.astype(jnp.float32) / bf)
+    q = jnp.minimum(qf, jnp.float32(2147483520.0)).astype(jnp.int32)
+    r = a - q * b
+    q = q + jnp.floor(r.astype(jnp.float32) / bf).astype(jnp.int32)
+    r = a - q * b
+    return q + (r >= b).astype(jnp.int32) - (r < 0).astype(jnp.int32)
+
+
+def floor_div_exact_u32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """floor(a / b) for uint32 a < 2^31 and uint32 b >= 1 of ANY magnitude.
+    b > a (including b >= 2^31, which would wrap negative as int32) short-
+    circuits to quotient 0 before the int32 core sees it."""
+    big_b = b > a  # uint32 compare; quotient is 0
+    q = floor_div_exact_i32(a, jnp.maximum(b.astype(jnp.int32), 1))
+    return jnp.where(big_b, jnp.uint32(0), q.astype(jnp.uint32))
+
+
 class DecideResult(NamedTuple):
     code: jnp.ndarray  # int32: 1=OK, 2=OVER_LIMIT
     limit_remaining: jnp.ndarray  # uint32
@@ -87,18 +124,19 @@ def decide(
     # Pacing (OK branch only, when past the near threshold). Padding rows may
     # carry divider 0; clamp so device integer division is always defined.
     divider = jnp.maximum(divider, 1)
-    window_end = (now // divider) * divider + divider
+    window_start = floor_div_exact_i32(now, divider) * divider
+    window_end = window_start + divider
     millis_remaining = ((window_end - now) * 1000).astype(u32)
     calls_remaining = jnp.maximum(over_threshold - after, jnp.uint32(1))
     throttle = jnp.where(
         jnp.logical_and(near_exceeded, jnp.logical_not(is_over)),
-        millis_remaining // calls_remaining,
+        floor_div_exact_u32(millis_remaining, calls_remaining),
         jnp.uint32(0),
     )
 
     code = jnp.where(is_over, jnp.int32(CODE_OVER_LIMIT), jnp.int32(CODE_OK))
     remaining = jnp.where(is_over, jnp.uint32(0), over_threshold - after)
-    duration = divider - now % divider
+    duration = window_end - now
 
     # Padding/unchecked items (hits == 0) are forced to a plain OK with no
     # stats contribution; the host assembles their statuses separately.

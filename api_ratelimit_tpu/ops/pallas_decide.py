@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .decide import CODE_OK, CODE_OVER_LIMIT, DecideResult
+from .decide import CODE_OK, CODE_OVER_LIMIT, DecideResult, floor_div_exact_i32
 
 LANES = 128
 BLOCK_ROWS = 64  # 64 x 128 = 8192 items per grid step
@@ -86,12 +86,14 @@ def _decide_kernel(
         jnp.zeros_like(hits),
     )
 
-    window_end = (now // divider) * divider + divider
+    # floor_div_exact_i32: vector idiv expands to a ~32-pass loop in Mosaic
+    # exactly as in XLA (~100ms per site at batch 2^20 — the r3 perf gap)
+    window_end = floor_div_exact_i32(now, divider) * divider + divider
     millis_remaining = (window_end - now) * 1000
     calls_remaining = jnp.maximum(over_threshold - after, jnp.int32(1))
     throttle = jnp.where(
         near_exceeded & ~is_over & valid,
-        millis_remaining // calls_remaining,
+        floor_div_exact_i32(millis_remaining, calls_remaining),
         jnp.int32(0),
     )
 
@@ -102,7 +104,7 @@ def _decide_kernel(
     remaining_ref[...] = jnp.where(
         valid & ~is_over, over_threshold - after, zero
     )
-    duration_ref[...] = jnp.where(valid, divider - now % divider, zero)
+    duration_ref[...] = jnp.where(valid, window_end - now, zero)
     throttle_ref[...] = throttle
     near_delta_ref[...] = jnp.where(
         valid, jnp.where(is_over, near_delta_over, near_delta_ok), zero

@@ -53,7 +53,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .decide import DecideResult, decide
+from .decide import DecideResult, decide, floor_div_exact_i32
 
 ROW_WIDTH = 8
 COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE = range(5)
@@ -291,7 +291,9 @@ def _slab_update_sorted(
         st_fp_hi = st_rows[:, COL_FP_HI]
 
         safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
-        cur_window = (now // safe_div) * safe_div
+        # floor_div_exact_i32: a vector integer divide would expand into a
+        # ~32-pass shift-subtract loop (~100ms at 2^20 on v5e — the r3 gap)
+        cur_window = floor_div_exact_i32(now, safe_div) * safe_div
         slot_live = st_expire > now
         fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
         same_window = st_window == cur_window

@@ -1,0 +1,147 @@
+"""A/B the real engine step programs on the attached device: XLA vs Pallas.
+
+tools/bisect_step2.py (bench methodology: chained donated state, varied
+staged inputs, literal scalars) showed the all-XLA slab program completes in
+~0.1-0.2ms at batch 2^20 — while BENCH_r03's pallas=True headline ran at
+261ms/step. This times the REAL shipped step functions end to end (decide +
+packbits + health + readback), both engines, so the bench's default engine
+choice is driven by a recorded head-to-head.
+
+Usage: python tools/engine_ab.py [--batch 1048576] [--slots 8388608]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--repeats", type=int, default=8)
+    ap.add_argument("--skip-pallas", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        SlabBatch,
+        _slab_step_sorted,
+        _slab_update_sorted,
+        _unsort,
+        make_slab,
+    )
+
+    device = jax.devices()[0]
+    if device.platform != "tpu" and args.batch > (1 << 14):
+        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+    b, n = args.batch, args.slots
+    R = args.repeats
+    now_lit = int(time.time())
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    @functools.partial(
+        jax.jit, donate_argnames=("state",), static_argnames=("use_pallas",)
+    )
+    def bench_step(state, ids, use_pallas):
+        state, _b, _a, d, order, health = _slab_step_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now_lit),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=use_pallas,
+            count_health=True,
+            lean_decide=use_pallas,
+        )
+        over = _unsort(d.code, order) == 2
+        return state, jnp.packbits(over), health
+
+    @functools.partial(
+        jax.jit, donate_argnames=("state",), static_argnames=("use_pallas",)
+    )
+    def after_step(state, ids, use_pallas):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now_lit),
+            n_probes=4,
+            count_health=True,
+            use_pallas=use_pallas,
+        )
+        after = jnp.minimum(_unsort(s_after, order), jnp.uint32(255))
+        return state, after.astype(jnp.uint8), health
+
+    rng = np.random.RandomState(0)
+    ids_all = (
+        rng.zipf(1.1, size=b * (R + 1)).astype(np.uint64) % args.keys
+    ).astype(np.uint32).reshape(R + 1, b)
+    staged = [jax.device_put(ids_all[i], device) for i in range(R + 1)]
+    for s in staged:
+        s.block_until_ready()
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+
+    def run(step, label, flag):
+        state = jax.device_put(make_slab(n), device)
+        state, out, health = step(state, staged[-1], flag)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        outs = []
+        for i in range(R):
+            state, out, health = step(state, staged[i], flag)
+            outs.append(out)
+        jax.block_until_ready(state)
+        t_device = time.perf_counter() - t0
+        fetched = [np.asarray(o) for o in outs]
+        t_e2e = time.perf_counter() - t0
+        entry = {
+            "ms_per_step_device": round(t_device / R * 1e3, 3),
+            "ms_per_step_e2e": round(t_e2e / R * 1e3, 3),
+            "rate": round(R * b / t_e2e),
+        }
+        results[label] = entry
+        print(f"[ab:{label}] {entry}", file=sys.stderr)
+        return fetched
+
+    bits_x = run(bench_step, "decided_xla", False)
+    run(after_step, "after_xla", False)
+    if device.platform == "tpu" and not args.skip_pallas:
+        try:
+            bits_p = run(bench_step, "decided_pallas", True)
+            results["decided_bits_equal"] = all(
+                np.array_equal(a, c) for a, c in zip(bits_x, bits_p)
+            )
+        except Exception as e:
+            results["pallas_error"] = str(e)[-300:]
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

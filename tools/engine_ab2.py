@@ -1,0 +1,176 @@
+"""Close the bisect gap: real slab functions, incremental output variants.
+
+bisect_step2 (inline ops, scalar output) = 0.11ms/step; engine_ab (real
+functions, array outputs) = ~318ms/step — after the division fix. The delta
+hides in what the bisect skipped: the real update's row stack, health
+reductions, decide(), _unsort, the u8 cast, packbits, or ARRAY OUTPUTS
+themselves. Each variant here uses the REAL shipped functions, chained
+donated state, varied staged inputs, adding one suspect at a time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--slots", type=int, default=1 << 23)
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--repeats", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.decide import decide
+    from api_ratelimit_tpu.ops.slab import (
+        SlabBatch,
+        _slab_step_sorted,
+        _slab_update_sorted,
+        _unsort,
+        make_slab,
+    )
+
+    device = jax.devices()[0]
+    if device.platform != "tpu" and args.batch > (1 << 14):
+        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+    b, n = args.batch, args.slots
+    R = args.repeats
+    now_lit = 1_700_000_000
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    rng = np.random.RandomState(0)
+    ids_all = (
+        rng.zipf(1.1, size=b * (R + 1)).astype(np.uint64) % args.keys
+    ).astype(np.uint32).reshape(R + 1, b)
+    staged = [jax.device_put(ids_all[i], device) for i in range(R + 1)]
+    for s in staged:
+        s.block_until_ready()
+
+    results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
+
+    def timed(label, step):
+        state = jax.device_put(make_slab(n), device)
+        out = step(state, staged[-1])
+        state = out[0]
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = []
+        for i in range(R):
+            out = step(state, staged[i])
+            state = out[0]
+            outs.append(out[1:])
+        jax.block_until_ready(state)
+        t_dev = time.perf_counter() - t0
+        fetched = jax.block_until_ready(outs)
+        t_e2e = time.perf_counter() - t0
+        results[label] = {
+            "ms_device": round(t_dev / R * 1e3, 3),
+            "ms_e2e": round(t_e2e / R * 1e3, 3),
+        }
+        print(f"[ab2:{label}] {results[label]}", file=sys.stderr)
+
+    # v1: REAL update (health off), scalar out
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v1(state, ids):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state, expand(ids), jnp.int32(now_lit), 4, count_health=False
+        )
+        return state, s_after.sum()
+
+    timed("update_scalar", v1)
+
+    # v2: + health reductions
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v2(state, ids):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state, expand(ids), jnp.int32(now_lit), 4, count_health=True
+        )
+        return state, s_after.sum() + health.sum()
+
+    timed("update_health_scalar", v2)
+
+    # v3: + unsort + u8 cast, still scalar out
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v3(state, ids):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state, expand(ids), jnp.int32(now_lit), 4, count_health=True
+        )
+        after = jnp.minimum(_unsort(s_after, order), jnp.uint32(255))
+        return state, after.astype(jnp.uint8).sum() + health.sum()
+
+    timed("after_scalar", v3)
+
+    # v4: after-mode with the REAL array output (u8[b])
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v4(state, ids):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state, expand(ids), jnp.int32(now_lit), 4, count_health=True
+        )
+        after = jnp.minimum(_unsort(s_after, order), jnp.uint32(255))
+        return state, after.astype(jnp.uint8), health
+
+    timed("after_array", v4)
+
+    # v5: + decide() on sorted results, scalar out
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v5(state, ids):
+        state, _b, _a, d, order, health = _slab_step_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now_lit),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=False,
+            count_health=True,
+        )
+        return state, d.code.sum() + health.sum()
+
+    timed("decided_scalar", v5)
+
+    # v6: + unsort(code) + ==2 + packbits (the real bench_step output)
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v6(state, ids):
+        state, _b, _a, d, order, health = _slab_step_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now_lit),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=False,
+            count_health=True,
+        )
+        over = _unsort(d.code, order) == 2
+        return state, jnp.packbits(over), health
+
+    timed("decided_packbits", v6)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
