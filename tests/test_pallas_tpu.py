@@ -60,3 +60,27 @@ def test_lean_decide_on_chip():
 
 def test_in_batch_slot_collision_on_chip():
     run_in_batch_slot_collision_parity(interpret=False)
+
+
+def test_floor_div_exact_on_chip():
+    """The exact floor division under every device path (window starts,
+    throttle pacing — ops/decide.py) depends on the CHIP's f32 divide
+    staying within the +-1 band the integer fixup corrects. CPU tests pin
+    the formula; this pins the hardware semantics (both XLA and Pallas
+    paths share the helper, so on-chip parity tests alone cannot catch a
+    TPU-specific f32 deviation)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.decide import floor_div_exact_i32
+
+    rng = np.random.RandomState(3)
+    a = rng.randint(0, 2**31, size=1 << 16).astype(np.int32)
+    b = rng.randint(1, 2**31, size=1 << 16).astype(np.int32)
+    b[::2] = rng.choice([1, 60, 3600, 86400], size=(1 << 15)).astype(np.int32)
+    # adversarial: quotients near exact multiples, max dividend
+    a[:4] = [2**31 - 1, 2**31 - 1, 86400 * 19676 - 1, 86400 * 19676]
+    b[:4] = [1, 3, 86400, 86400]
+    got = np.asarray(jax.jit(floor_div_exact_i32)(jnp.asarray(a), jnp.asarray(b)))
+    want = (a.astype(np.int64) // b.astype(np.int64)).astype(np.int32)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,60 @@
+"""Shared fixtures for the engine A/B tools (engine_ab.py, engine_ab2.py).
+
+The two tools' numbers are cited against each other, so their workloads
+must be IDENTICAL by construction: same fingerprint expansion, same Zipf id
+staging, same CPU downscale fallback, same pinned `now` literal (a wall
+clock `now` would make reruns non-reproducible and the pair non-comparable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NOW_LIT = 1_700_000_000
+
+
+def downscale(args, platform: str) -> None:
+    """Shrink shapes in place for CPU smoke runs."""
+    if platform != "tpu" and args.batch > (1 << 14):
+        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+
+
+def make_expand():
+    """Returns the on-device id -> SlabBatch expansion (two independent
+    murmur-finalizer bijections; unit-second windows, limit 100)."""
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import SlabBatch
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 100),
+            divider=jnp.full_like(ids, 1).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    return expand
+
+
+def stage_zipf_ids(device, batch: int, n_keys: int, count: int, seed: int = 0):
+    """`count` distinct Zipf(1.1) id arrays staged in device HBM."""
+    import jax
+
+    rng = np.random.RandomState(seed)
+    ids_all = (
+        rng.zipf(1.1, size=batch * count).astype(np.uint64) % n_keys
+    ).astype(np.uint32).reshape(count, batch)
+    staged = [jax.device_put(ids_all[i], device) for i in range(count)]
+    for s in staged:
+        s.block_until_ready()
+    return staged

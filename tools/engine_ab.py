@@ -20,6 +20,12 @@ import time
 
 import numpy as np
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _ab_common import NOW_LIT, downscale, make_expand, stage_zipf_ids
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -42,28 +48,12 @@ def main() -> None:
     )
 
     device = jax.devices()[0]
-    if device.platform != "tpu" and args.batch > (1 << 14):
-        args.batch, args.slots, args.keys = 1 << 13, 1 << 18, 100_000
+    downscale(args, device.platform)
     b, n = args.batch, args.slots
     R = args.repeats
-    now_lit = int(time.time())
+    now_lit = NOW_LIT
 
-    def fmix(x):
-        x = x ^ (x >> 16)
-        x = x * jnp.uint32(0x85EBCA6B)
-        x = x ^ (x >> 13)
-        x = x * jnp.uint32(0xC2B2AE35)
-        return x ^ (x >> 16)
-
-    def expand(ids):
-        return SlabBatch(
-            fp_lo=fmix(ids),
-            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
-            hits=jnp.ones_like(ids),
-            limit=jnp.full_like(ids, 100),
-            divider=jnp.full_like(ids, 1).astype(jnp.int32),
-            jitter=jnp.zeros_like(ids).astype(jnp.int32),
-        )
+    expand = make_expand()
 
     @functools.partial(
         jax.jit, donate_argnames=("state",), static_argnames=("use_pallas",)
@@ -97,13 +87,7 @@ def main() -> None:
         after = jnp.minimum(_unsort(s_after, order), jnp.uint32(255))
         return state, after.astype(jnp.uint8), health
 
-    rng = np.random.RandomState(0)
-    ids_all = (
-        rng.zipf(1.1, size=b * (R + 1)).astype(np.uint64) % args.keys
-    ).astype(np.uint32).reshape(R + 1, b)
-    staged = [jax.device_put(ids_all[i], device) for i in range(R + 1)]
-    for s in staged:
-        s.block_until_ready()
+    staged = stage_zipf_ids(device, b, args.keys, R + 1)
 
     results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
 
