@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 # Codes match envoy RateLimitResponse.Code (models/response.py).
@@ -36,30 +37,47 @@ CODE_OK = 1
 CODE_OVER_LIMIT = 2
 
 
+def _recip_f32(bf: jnp.ndarray) -> jnp.ndarray:
+    """Division-free approximate reciprocal of positive normal float32:
+    magic-constant exponent flip seeds ~10% relative error; three Newton
+    iterations (r <- r*(2 - b*r), squaring the error each time) land below
+    float32 epsilon. mul/sub/bitcast only — no division anywhere."""
+    xi = jax.lax.bitcast_convert_type(bf, jnp.int32)
+    r = jax.lax.bitcast_convert_type(jnp.int32(0x7EF311C3) - xi, jnp.float32)
+    two = jnp.float32(2.0)
+    r = r * (two - bf * r)
+    r = r * (two - bf * r)
+    return r * (two - bf * r)
+
+
 def floor_div_exact_i32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Exact floor(a / b) without integer division, for int32 operands with
-    0 <= a < 2^31 and 1 <= b < 2^31.
+    """Exact floor(a / b) without any hardware division, for int32 operands
+    with 0 <= a < 2^31 and 1 <= b < 2^31.
 
     XLA and Mosaic both expand a VECTOR integer divide into a ~32-pass
     shift-subtract loop; on v5e that measured ~100ms per division site at
     batch 2^20 (tools/bisect_step2.py vs tools/engine_ab.py: the slab step
-    is ~0.15ms without its divisions and ~300ms with them). The float32
-    seed quotient can be off by up to ~2^8 near a = 2^31 (float32 carries
-    24 bits); the refinement divides the SMALL residual, which float32
-    represents exactly, landing within +-1, and the integer fixup finishes.
-    All three steps are load-bearing — do not drop the refinement on the
-    strength of the seed alone. The seed is clamped below 2^31 because an
-    out-of-range float32->int32 convert is implementation-defined.
+    is ~0.15ms without its divisions and ~300ms with them) — and swapping
+    idiv for f32 division moved nothing, so the division op class itself is
+    avoided entirely: quotients come from a Newton reciprocal (_recip_f32,
+    mul/sub/bitcast only). The seed quotient can be off by several hundred
+    near a = 2^31 (float32 carries 24 bits); the refinement multiplies the
+    SMALL residual (exactly representable) by the same reciprocal, landing
+    within +-1, and the integer fixup finishes. All three steps are
+    load-bearing — do not drop the refinement on the strength of the seed
+    alone. The seed is clamped below 2^31 because an out-of-range
+    float32->int32 convert is implementation-defined.
     Mosaic-safe: int32/float32 ops only (kernels reuse this body verbatim).
-    Exactness is pinned against numpy // in tests/test_slab.py.
+    Exactness is pinned against numpy // in tests/test_slab.py and on real
+    hardware in tests/test_pallas_tpu.py.
     """
     a = a.astype(jnp.int32)
     b = b.astype(jnp.int32)
-    bf = b.astype(jnp.float32)
-    qf = jnp.floor(a.astype(jnp.float32) / bf)
+    rb = _recip_f32(b.astype(jnp.float32))
+    qf = jnp.floor(a.astype(jnp.float32) * rb)
     q = jnp.minimum(qf, jnp.float32(2147483520.0)).astype(jnp.int32)
     r = a - q * b
-    q = q + jnp.floor(r.astype(jnp.float32) / bf).astype(jnp.int32)
+    q = q + jnp.floor(r.astype(jnp.float32) * rb).astype(jnp.int32)
     r = a - q * b
     return q + (r >= b).astype(jnp.int32) - (r < 0).astype(jnp.int32)
 

@@ -263,16 +263,29 @@ def bench_engine_zipf(
         healths = [health]
         t0 = time.perf_counter()
         outs = []
+        extra = []
         k = 0
         while k < n_batches or (
             time.perf_counter() - t0 < min_timed_s and left() > 60
         ):
             state, out, health = step(state, staged[k % n_batches], flag)
-            outs.append(out)  # every step's output is drained (honest e2e)
-            if k < n_batches:
-                healths.append(health)
+            # health covers EVERY timed step (same scope as live_slots and
+            # the decision count); parity replays only the first pass
+            healths.append(health)
+            (outs if k < n_batches else extra).append(out)
             k += 1
+            if k % n_batches == 0:
+                # once per staged pass: block the chain so the wall clock
+                # tracks DEVICE progress (async dispatch would otherwise
+                # enqueue unbounded work), and drain extra-pass outputs so
+                # live buffers stay bounded
+                jax.block_until_ready(state)
+                for o in extra:
+                    np.asarray(o)
+                extra.clear()
         jax.block_until_ready(state)  # every launch chains through state
+        for o in extra:
+            np.asarray(o)
         t_device = time.perf_counter() - t0
         fetched = [np.asarray(o) for o in outs]
         t_e2e = time.perf_counter() - t0

@@ -397,6 +397,24 @@ class TestFloorDivExact:
         got = np.asarray(floor_div_exact_u32(jnp.asarray(a), jnp.asarray(b)))
         np.testing.assert_array_equal(got, np.zeros(4, np.uint32))
 
+    def test_i32_reciprocal_edges(self):
+        # the Newton reciprocal must stay inside the fixup band across
+        # exponent boundaries: powers of two, power-of-two +-1, and maximal
+        # quotients against each
+        from api_ratelimit_tpu.ops.decide import floor_div_exact_i32
+
+        bs = []
+        for k in range(0, 31):
+            bs += [1 << k, (1 << k) + 1, max(1, (1 << k) - 1)]
+        b = np.array(sorted(set(bs)), dtype=np.int32)
+        for a_val in (0, 1, 2**30, 2**31 - 1):
+            a = np.full_like(b, a_val)
+            got = np.asarray(
+                floor_div_exact_i32(jnp.asarray(a), jnp.asarray(b))
+            )
+            want = (a.astype(np.int64) // b.astype(np.int64)).astype(np.int32)
+            np.testing.assert_array_equal(got, want, err_msg=f"a={a_val}")
+
     def test_u32_randomized(self):
         from api_ratelimit_tpu.ops.decide import floor_div_exact_u32
 

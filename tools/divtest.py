@@ -21,19 +21,11 @@ import numpy as np
 
 
 def recip_f32(bf):
-    """Division-free approximate reciprocal of positive float32 b, accurate
-    to ~f32 precision: magic-constant exponent flip seeds ~10% error, three
-    Newton iterations (r <- r*(2 - b*r)) square it down below 2^-24."""
-    import jax
-    import jax.numpy as jnp
+    """The SHIPPED reciprocal (ops/decide.py) — imported, not copied, so
+    this probe always times and accuracy-checks what the engine runs."""
+    from api_ratelimit_tpu.ops.decide import _recip_f32
 
-    xi = jax.lax.bitcast_convert_type(bf, jnp.int32)
-    r = jax.lax.bitcast_convert_type(jnp.int32(0x7EF311C3) - xi, jnp.float32)
-    two = jnp.float32(2.0)
-    r = r * (two - bf * r)
-    r = r * (two - bf * r)
-    r = r * (two - bf * r)
-    return r
+    return _recip_f32(bf)
 
 
 def main() -> None:
