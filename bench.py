@@ -182,7 +182,11 @@ def bench_engine_zipf(
     # dispatch noise swamped the signal (the r1->r2 "regression" was mostly
     # this). 32 batches puts the timed region at ~100ms.
     n_batches = 16 if on_tpu else 32
-    use_pallas = on_tpu
+    # BENCH_PALLAS=0 makes the XLA path the headline engine; the OTHER
+    # engine is still measured by the alternate-engine block below (it runs
+    # whichever engine was not primary). Default keeps the Pallas kernel as
+    # the headline on TPU.
+    use_pallas = on_tpu and os.environ.get("BENCH_PALLAS", "1") != "0"
     now = int(time.time())
 
     def fmix(x):  # murmur3 finalizer: a bijection on uint32
@@ -258,9 +262,9 @@ def bench_engine_zipf(
         the output readback drain. Returns a result dict + fetched outputs
         of the FIRST staged pass (warm first) — the stream parity replays."""
         state = jax.device_put(make_slab(n_slots), device)
-        state, out, health = step(state, staged[-1], flag)
+        state, out, _warm_health = step(state, staged[-1], flag)
         warm = np.asarray(out)
-        healths = [health]
+        healths = []  # timed steps only — same scope as the decision count
         t0 = time.perf_counter()
         outs = []
         extra = []
@@ -275,19 +279,17 @@ def bench_engine_zipf(
             (outs if k < n_batches else extra).append(out)
             k += 1
             if k % n_batches == 0:
-                # once per staged pass: block the chain so the wall clock
-                # tracks DEVICE progress (async dispatch would otherwise
-                # enqueue unbounded work), and drain extra-pass outputs so
-                # live buffers stay bounded
+                # once per staged pass: block the CHAIN (no readback) so
+                # the wall clock tracks device progress — async dispatch
+                # would otherwise enqueue unbounded work
                 jax.block_until_ready(state)
-                for o in extra:
-                    np.asarray(o)
-                extra.clear()
         jax.block_until_ready(state)  # every launch chains through state
+        t_device = time.perf_counter() - t0
+        # readback window: first-pass outputs (parity stream) + extra-pass
+        # outputs, so transfer cost never masquerades as device time
+        fetched = [np.asarray(o) for o in outs]
         for o in extra:
             np.asarray(o)
-        t_device = time.perf_counter() - t0
-        fetched = [np.asarray(o) for o in outs]
         t_e2e = time.perf_counter() - t0
         decisions = k * batch
         steals, drops = (
@@ -353,16 +355,21 @@ def bench_engine_zipf(
     print(f"[engine] parity={result['parity']}", file=sys.stderr)
     publish(result)
 
-    # On the chip, also time the XLA-update twin (the kernel's win or loss
-    # vs the lax.sort+scan path must be a recorded number, VERDICT r3 weak
-    # #6) and the after-mode production path — each gated on budget.
-    if use_pallas and left() > 90:
+    # On the chip, also time the OTHER engine (kernel-vs-XLA must be a
+    # recorded number, VERDICT r3 weak #6) and the after-mode production
+    # path — each gated on budget. Runs whichever engine was not the
+    # headline, so BENCH_PALLAS=0 still records the kernel.
+    if on_tpu and pallas_error is None and left() > 90:
+        alt_flag = not use_pallas
+        alt_key = "rate_pallas_update" if alt_flag else "rate_xla_update"
         try:
-            xla, _ = run_path(bench_step, "xla-twin", False)
-            result["rate_xla_update"] = xla["rate"]
-            result["rate_xla_update_device_pipeline"] = xla["rate_device_pipeline"]
+            alt, _ = run_path(
+                bench_step, "pallas-twin" if alt_flag else "xla-twin", alt_flag
+            )
+            result[alt_key] = alt["rate"]
+            result[alt_key + "_device_pipeline"] = alt["rate_device_pipeline"]
         except Exception as e:
-            result["rate_xla_update"] = f"error: {str(e)[-200:]}"
+            result[alt_key] = f"error: {str(e)[-200:]}"
         publish(result)
     if left() > 90:
         try:
@@ -520,8 +527,13 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
     from api_ratelimit_tpu.stats.store import Store
     from api_ratelimit_tpu.utils.timeutil import RealTimeSource
 
-    n_threads = 8
-    per_thread = 400 if on_tpu else 100
+    # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
+    # parallel workers (test/redis/bench_test.go); oversubscribing a small
+    # box measures queueing, not the service (8 threads on the 1-core bench
+    # host tripled p99 vs 4). Floor of 4 keeps real cross-request
+    # coalescing in the batcher on any host.
+    n_threads = max(4, os.cpu_count() or 1)
+    per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
     store = Store(NullSink())
     local_cache = (
         LocalCache(max_entries=4096, time_source=RealTimeSource())
