@@ -78,6 +78,59 @@ def main() -> None:
         }
         print(f"[ab2:{label}] {results[label]}", file=sys.stderr)
 
+    # v0: the bisect's fastest inline program through THIS harness —
+    # same probe/sort/permute/update/scatter, no floor_div, no decide,
+    # no health, scalar out; rules out harness differences in one number
+    from api_ratelimit_tpu.ops.slab import _choose_slots, _sort_key
+
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v0(state, ids):
+        import jax.numpy as jnp2
+
+        batch = expand(ids)
+        now = jnp.int32(now_lit)
+        chosen, stolen, picked_rows = _choose_slots(state, batch, now, 4)
+        bsz = chosen.shape[0]
+        key = _sort_key(chosen, batch.fp_hi, state.n_slots)
+        (_, order) = jax.lax.sort(
+            (key, jnp.arange(bsz, dtype=jnp.int32)), num_keys=1, is_stable=True
+        )
+        s_slot = chosen[order]
+        s_fp_lo = batch.fp_lo[order]
+        s_fp_hi = batch.fp_hi[order]
+        s_hits = batch.hits[order]
+        st_rows = picked_rows[order]
+        same_prev = (
+            (s_slot[1:] == s_slot[:-1])
+            & (s_fp_lo[1:] == s_fp_lo[:-1])
+            & (s_fp_hi[1:] == s_fp_hi[:-1])
+        )
+        seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
+        incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+        excl = incl - s_hits
+        seg_base = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+        prior = excl - seg_base
+        base = jnp.where(
+            (s_hits > 0)
+            & (st_rows[:, 4].astype(jnp.int32) > now)
+            & (st_rows[:, 0] == s_fp_lo)
+            & (st_rows[:, 1] == s_fp_hi),
+            st_rows[:, 2],
+            jnp.uint32(0),
+        )
+        s_after = base + prior + s_hits
+        is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
+        write_idx = jnp.where(is_last, s_slot, jnp.int32(state.n_slots))
+        new_rows = jnp.stack([s_fp_lo, s_fp_hi, s_after] + [s_fp_lo] * 5, axis=1)
+        table = state.table.at[write_idx].set(
+            new_rows, mode="drop", unique_indices=True
+        )
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        return SlabState(table=table), s_after.sum()
+
+    timed("v0_inline_nodivide", v0)
+
     # v1: REAL update (health off), scalar out
     @functools.partial(jax.jit, donate_argnames=("state",))
     def v1(state, ids):
