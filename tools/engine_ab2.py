@@ -57,8 +57,10 @@ def main() -> None:
 
     results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
 
-    def timed(label, step):
+    def timed(label, step, raw_table=False):
         state = jax.device_put(make_slab(n), device)
+        if raw_table:
+            state = state.table
         out = step(state, staged[-1])
         state = out[0]
         jax.block_until_ready(out)
@@ -130,6 +132,54 @@ def main() -> None:
         return SlabState(table=table), s_after.sum()
 
     timed("v0_inline_nodivide", v0)
+
+    # v00: byte-for-byte the bisect_step2 final program — RAW table arg
+    # (not SlabState), donate_argnums, scalar out. If v00 is fast and v0
+    # slow, the difference is the harness/pytree, not the program.
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def v00(table, ids):
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        st = SlabState(table=table)
+        batch = expand(ids)
+        now = jnp.int32(now_lit)
+        chosen, stolen, picked_rows = _choose_slots(st, batch, now, 4)
+        bsz = chosen.shape[0]
+        key = _sort_key(chosen, batch.fp_hi, n)
+        (_, order) = jax.lax.sort(
+            (key, jnp.arange(bsz, dtype=jnp.int32)), num_keys=1, is_stable=True
+        )
+        s_slot = chosen[order]
+        s_fp_lo = batch.fp_lo[order]
+        s_fp_hi = batch.fp_hi[order]
+        s_hits = batch.hits[order]
+        st_rows = picked_rows[order]
+        seg_start = jnp.concatenate(
+            [jnp.array([True]),
+             ~((s_slot[1:] == s_slot[:-1])
+               & (s_fp_lo[1:] == s_fp_lo[:-1])
+               & (s_fp_hi[1:] == s_fp_hi[:-1]))]
+        )
+        incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+        excl = incl - s_hits
+        seg_base = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+        prior = excl - seg_base
+        base = jnp.where(
+            (s_hits > 0)
+            & (st_rows[:, 4].astype(jnp.int32) > now)
+            & (st_rows[:, 0] == s_fp_lo)
+            & (st_rows[:, 1] == s_fp_hi),
+            st_rows[:, 2],
+            jnp.uint32(0),
+        )
+        s_after = base + prior + s_hits
+        is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
+        write_idx = jnp.where(is_last, s_slot, jnp.int32(n))
+        new_rows = jnp.stack([s_fp_lo, s_fp_hi, s_after] + [s_fp_lo] * 5, axis=1)
+        table = table.at[write_idx].set(new_rows, mode="drop", unique_indices=True)
+        return table, s_after.sum()
+
+    timed("v00_rawtable_bisect", v00, raw_table=True)
 
     # v1: REAL update (health off), scalar out
     @functools.partial(jax.jit, donate_argnames=("state",))
