@@ -41,6 +41,13 @@ import numpy as np
 TARGET = 10_000_000.0
 
 
+def engine_use_pallas(on_tpu: bool) -> bool:
+    """One engine choice for every tier: BENCH_PALLAS=0 selects the XLA
+    update path on TPU (the bench engine tier still records the other
+    engine as its comparison row)."""
+    return on_tpu and os.environ.get("BENCH_PALLAS", "1") != "0"
+
+
 def resolve_platform() -> tuple[str, dict]:
     """Pick the JAX platform BEFORE importing jax in this process.
 
@@ -182,11 +189,7 @@ def bench_engine_zipf(
     # dispatch noise swamped the signal (the r1->r2 "regression" was mostly
     # this). 32 batches puts the timed region at ~100ms.
     n_batches = 16 if on_tpu else 32
-    # BENCH_PALLAS=0 makes the XLA path the headline engine; the OTHER
-    # engine is still measured by the alternate-engine block below (it runs
-    # whichever engine was not primary). Default keeps the Pallas kernel as
-    # the headline on TPU.
-    use_pallas = on_tpu and os.environ.get("BENCH_PALLAS", "1") != "0"
+    use_pallas = engine_use_pallas(on_tpu)
     now = int(time.time())
 
     def fmix(x):  # murmur3 finalizer: a bijection on uint32
@@ -614,7 +617,7 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
     engine = ShardedSlabEngine(
         mesh=mesh,
         n_slots_global=n_devices * ((1 << 20) if on_tpu else (1 << 15)),
-        use_pallas=on_tpu,
+        use_pallas=engine_use_pallas(on_tpu),
     )
 
     def pack(ids: np.ndarray) -> np.ndarray:
@@ -780,7 +783,7 @@ def bench_sidecar(
             n_slots=1 << 18,
             batch_window_seconds=0.001,
             max_batch=65536,
-            use_pallas=on_tpu,
+            use_pallas=engine_use_pallas(on_tpu),
         )
         server = SlabSidecarServer(path, engine)
         env = dict(os.environ)
