@@ -2,8 +2,11 @@
 
 One atomic ok flag backs both surfaces: HTTP /healthcheck answers 200 "OK" /
 500 (health.go:40-47), the standard grpc.health.v1.Health service answers
-SERVING / NOT_SERVING, and fail() flips both — called from the SIGTERM path
-so load balancers drain before shutdown (health.go:28-35).
+SERVING / NOT_SERVING over BOTH its RPCs — unary Check and streaming Watch
+(the reference registers the stock grpc-health server, health.go:21-27,
+which serves both) — and fail() flips everything at once: it is called from
+the SIGTERM path so load balancers drain before shutdown (health.go:28-35),
+and Watch subscribers get the NOT_SERVING push immediately.
 """
 
 from __future__ import annotations
@@ -18,27 +21,91 @@ HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
 
 
 class HealthChecker:
+    # Each sync-gRPC Watch stream holds one worker thread from the server's
+    # shared pool for its whole life; uncapped, a fleet of watch-mode health
+    # probes could pin every worker and starve the ratelimit RPCs the
+    # health service exists to protect. Excess watchers get
+    # RESOURCE_EXHAUSTED and should fall back to polling Check.
+    MAX_WATCHERS = 8
+
     def __init__(self, name: str = "ratelimit"):
         self.name = name
-        self._ok = threading.Event()
-        self._ok.set()
+        self._ok = True
+        # guards _ok; notified on every transition so Watch streams can push
+        # the new status to their subscribers without polling
+        self._cond = threading.Condition()
+        self._version = 0  # bumped per transition; lets Watch detect changes
+        self._watchers = 0
 
     def ok(self) -> bool:
-        return self._ok.is_set()
+        with self._cond:
+            return self._ok
 
     def fail(self) -> None:
-        """Flip to unhealthy (health.go:49-52). One-way, used for LB drain."""
-        self._ok.clear()
+        """Flip to unhealthy (health.go:49-52). One-way, used for LB drain;
+        wakes every Watch subscriber so the NOT_SERVING status is pushed,
+        not discovered at the next poll."""
+        with self._cond:
+            self._ok = False
+            self._version += 1
+            self._cond.notify_all()
 
     # -- gRPC surface --
 
-    def Check(self, request, context):  # noqa: N802 (proto casing)
-        status = (
+    def _status(self, service: str) -> int:
+        """Serving status for one service name. The stock health server
+        tracks a per-service map; this server registers the overall ("")
+        and its own name, like the reference's SetServingStatus calls
+        (health.go:24, 33)."""
+        if service not in ("", self.name):
+            return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+        return (
             health_pb2.HealthCheckResponse.SERVING
-            if self.ok()
+            if self._ok
             else health_pb2.HealthCheckResponse.NOT_SERVING
         )
+
+    def Check(self, request, context):  # noqa: N802 (proto casing)
+        with self._cond:
+            status = self._status(request.service)
+        if status == health_pb2.HealthCheckResponse.SERVICE_UNKNOWN:
+            # the stock health server answers unary Check for an unknown
+            # service with NOT_FOUND (Watch instead streams SERVICE_UNKNOWN)
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
         return health_pb2.HealthCheckResponse(status=status)
+
+    def Watch(self, request, context):  # noqa: N802 (proto casing)
+        """Streaming watch: send the current status immediately, then one
+        message per transition until the client disconnects — the standard
+        grpc.health.v1 semantics the reference gets from the stock server."""
+        service = request.service
+        with self._cond:
+            if self._watchers >= self.MAX_WATCHERS:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"too many health watchers (max {self.MAX_WATCHERS}); "
+                    "poll Check instead",
+                )
+            self._watchers += 1
+            last = self._status(service)
+            version = self._version
+        try:
+            yield health_pb2.HealthCheckResponse(status=last)
+            while context.is_active():
+                with self._cond:
+                    # wake on transitions; time out periodically to notice a
+                    # silently-departed client and release the stream
+                    self._cond.wait_for(
+                        lambda: self._version != version, timeout=1.0
+                    )
+                    version = self._version
+                    status = self._status(service)
+                if status != last and context.is_active():
+                    last = status
+                    yield health_pb2.HealthCheckResponse(status=status)
+        finally:
+            with self._cond:
+                self._watchers -= 1
 
     def add_to_grpc_server(self, server: grpc.Server) -> None:
         handlers = {
@@ -46,7 +113,12 @@ class HealthChecker:
                 self.Check,
                 request_deserializer=health_pb2.HealthCheckRequest.FromString,
                 response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
-            )
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                self.Watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
         }
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(HEALTH_SERVICE_NAME, handlers),)

@@ -229,6 +229,45 @@ def test_healthcheck_and_grpc_health(running_server):
         assert resp.status == health_pb2.HealthCheckResponse.NOT_SERVING
 
 
+def test_grpc_health_watch_streams_transition(running_server):
+    """The streaming Watch RPC (reference: the stock grpc-health server
+    registered at health.go:21-27 serves Check AND Watch): the first message
+    is the current status, and the SIGTERM-drain fail() pushes NOT_SERVING
+    to the open stream without the client re-polling."""
+    import threading
+
+    runner, _ = running_server
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        watch = ch.unary_stream(
+            "/grpc.health.v1.Health/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        stream = watch(health_pb2.HealthCheckRequest(service="ratelimit"))
+        first = next(stream)
+        assert first.status == health_pb2.HealthCheckResponse.SERVING
+
+        # flip AFTER the stream is established; the update must be pushed
+        threading.Timer(0.1, runner.server.health.fail).start()
+        second = next(stream)
+        assert second.status == health_pb2.HealthCheckResponse.NOT_SERVING
+        stream.cancel()
+
+        # unknown service: Watch streams SERVICE_UNKNOWN (Check -> NOT_FOUND)
+        stream2 = watch(health_pb2.HealthCheckRequest(service="nope"))
+        assert next(stream2).status == health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+        stream2.cancel()
+
+        check = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            check(health_pb2.HealthCheckRequest(service="nope"))
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
 def test_debug_endpoints(running_server):
     runner, _ = running_server
     port = runner.server.debug_port
