@@ -323,13 +323,27 @@ class ShardedSlabEngine:
         """Production mesh path: host-side owner routing + per-shard
         compacted compute (see module comment above). packed: uint32[7, b]
         -> uint32[b] post-increment counters in arrival order."""
+        return self.collect_after_compact(self.launch_after_compact(packed, cap))
+
+    def launch_after_compact(
+        self, packed: np.ndarray, cap: int = 0xFFFFFFFF, min_bucket: int = 128
+    ):
+        """Async half of step_after_compact: owner-route on the host,
+        dispatch the sharded launch, return a token WITHOUT blocking on the
+        result. The device work chains through the donated state, so the
+        backend's double-buffered dispatcher can launch batch k+1 (host
+        routing + H2D included) while batch k's readback drains — the same
+        split the single-device engine runs (backends/tpu.py).
+
+        min_bucket floors the power-of-two bucket ladder: callers that know
+        the shapes they will see (the bench pins one bucket across a block
+        stream) can force a single compile instead of one per ladder rung."""
         n_dev = int(self.mesh.devices.size)
         b = packed.shape[1]
         hits = packed[ROW_HITS]
         valid_idx = np.flatnonzero(hits > 0)
-        out = np.zeros(b, dtype=np.uint32)
         if valid_idx.size == 0:
-            return out
+            return (None, None, None, None, b)
 
         # MUST mirror _owner_mask's device-side formula ((fp_lo ^ fp_hi) mod
         # n_dev) exactly — a mismatch silently routes keys to shards that
@@ -341,7 +355,7 @@ class ShardedSlabEngine:
         counts = np.bincount(owner, minlength=n_dev)
         # power-of-two bucket >= the fullest shard (>=128 for lane alignment)
         bucket = 128
-        while bucket < counts.max():
+        while bucket < max(int(min_bucket), counts.max()):
             bucket <<= 1
 
         route = np.argsort(owner, kind="stable")
@@ -371,6 +385,15 @@ class ShardedSlabEngine:
         with self._state_lock:
             self._state, after_blocks, health = step(self._state, blocks_dev)
             self._note_health(health)
+        return (after_blocks, routed_idx, routed_owner, within, b)
+
+    def collect_after_compact(self, token) -> np.ndarray:
+        """Blocking half: drain the sharded result and unscatter it back to
+        arrival order using the routing permutation built at launch."""
+        after_blocks, routed_idx, routed_owner, within, b = token
+        out = np.zeros(b, dtype=np.uint32)
+        if after_blocks is None:  # launch saw no valid lanes
+            return out
         after_np = np.asarray(after_blocks)
         out[routed_idx] = after_np[routed_owner, within].astype(np.uint32)
         return out
