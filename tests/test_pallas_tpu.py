@@ -64,11 +64,13 @@ def test_in_batch_slot_collision_on_chip():
 
 def test_floor_div_exact_on_chip():
     """The exact floor division under every device path (window starts,
-    throttle pacing — ops/decide.py) depends on the CHIP's f32 divide
-    staying within the +-1 band the integer fixup corrects. CPU tests pin
-    the formula; this pins the hardware semantics (both XLA and Pallas
-    paths share the helper, so on-chip parity tests alone cannot catch a
-    TPU-specific f32 deviation)."""
+    throttle pacing — ops/decide.py) contains no divide at all: it is a
+    Newton-reciprocal built from mul/sub/bitcast, and its exactness
+    depends on the chip's f32 multiply/rounding staying within the +-1
+    band the integer fixup corrects. CPU tests pin the formula; this pins
+    the hardware semantics (both XLA and Pallas paths share the helper,
+    so on-chip parity tests alone cannot catch a TPU-specific f32
+    multiply deviation)."""
     import numpy as np
     import jax.numpy as jnp
 
