@@ -206,6 +206,9 @@ class Runner:
             runtime_path=settings.runtime_path,
             runtime_subdirectory=settings.runtime_subdirectory,
             ignore_dotfiles=settings.runtime_ignoredotfiles,
+            poll_interval_seconds=settings.runtime_poll_interval,
+            watcher=settings.runtime_watcher,
+            safety_rescan_seconds=settings.runtime_safety_rescan,
         )
         self.service = RateLimitService(
             runtime=self.runtime,
