@@ -75,8 +75,15 @@ def main() -> None:
 
     timeit("recip_div", rdiv)
 
-    # correctness cross-check of the quotient band: recip vs true, worst
-    # deviation over a batch (must stay within the +-1 fixup band)
+    # correctness cross-check of the RAW quotient band: recip-multiply vs
+    # true, worst deviation over a batch. This is the FIRST-ESTIMATE band,
+    # dominated by the int->f32 rounding of a (exact only below 2^24): CPU
+    # measures ~8 at a~2^27, and that is fine — the SHIPPED
+    # floor_div_exact_i32 refines the estimate with an integer residual
+    # pass plus a +-1 fixup and is pinned exact by tests/test_decide. On
+    # chip, compare against the CPU figure: same order => same seed/refine
+    # budget suffices; orders larger => the chip's f32 multiply/rounding
+    # differs and the exact path needs re-validation there.
     x = np.asarray(xs[0])
     a = x.astype(np.int64)
     d = (x & 1023).astype(np.int64) + 1
