@@ -223,6 +223,17 @@ def main() -> None:
 
     timed("after_array", v4)
 
+    # v4b: array output WITHOUT the u8 min/cast — splits "returning an
+    # array" from "the narrowing cast" if v4 is slow
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v4b(state, ids):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state, expand(ids), jnp.int32(now_lit), 4, count_health=True
+        )
+        return state, _unsort(s_after, order), health
+
+    timed("after_array_u32", v4b)
+
     # v5: + decide() on sorted results, scalar out
     @functools.partial(jax.jit, donate_argnames=("state",))
     def v5(state, ids):
@@ -255,6 +266,27 @@ def main() -> None:
         return state, jnp.packbits(over), health
 
     timed("decided_packbits", v6)
+
+    # v7: same output bits via the multiply-add packer (ops/decide.py
+    # packbits_mxu) — the candidate swap if v6 shows packbits' shift/or
+    # lowering is another pathological vector op class (like division was)
+    from api_ratelimit_tpu.ops.decide import packbits_mxu
+
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def v7(state, ids):
+        state, _b, _a, d, order, health = _slab_step_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now_lit),
+            jnp.float32(0.8),
+            n_probes=4,
+            use_pallas=False,
+            count_health=True,
+        )
+        over = _unsort(d.code, order) == 2
+        return state, packbits_mxu(over), health
+
+    timed("decided_dotpack", v7)
 
     print(json.dumps(results))
 
