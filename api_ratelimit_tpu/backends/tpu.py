@@ -90,7 +90,7 @@ class SlabDeviceEngine:
         n_slots: int = 1 << 22,
         batch_window_seconds: float = 0.0,
         max_batch: int = 65536,
-        buckets: Sequence[int] = (1024, 8192, 65536),
+        buckets: Sequence[int] = (128, 1024, 8192, 65536),
         device=None,
         use_pallas: bool | None = None,
         mesh=None,
@@ -394,7 +394,7 @@ class TpuRateLimitCache:
         n_slots: int = 1 << 22,
         batch_window_seconds: float = 0.0,
         max_batch: int = 65536,
-        buckets: Sequence[int] = (1024, 8192, 65536),
+        buckets: Sequence[int] = (128, 1024, 8192, 65536),
         device=None,
         use_pallas: bool | None = None,
         mesh=None,

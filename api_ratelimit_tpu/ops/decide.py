@@ -91,23 +91,25 @@ def floor_div_exact_u32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(big_b, jnp.uint32(0), q.astype(jnp.uint32))
 
 
-def packbits_mxu(mask: jnp.ndarray) -> jnp.ndarray:
+def packbits_muladd(mask: jnp.ndarray) -> jnp.ndarray:
     """jnp.packbits twin built from reshape + weighted sum (multiply-add
     only — no shift/or bit ops), big-endian bit order like numpy's default.
 
     Why it exists: the same op-class caution as floor_div_exact above. The
     engine ships OVER_LIMIT masks back at 1 bit/decision via packbits; if
     on-chip attribution (tools/engine_ab2.py decided_packbits vs
-    decided_dotpack) shows the shift/or lowering is another pathological
-    vector op class on this stack, this is the drop-in replacement —
-    elementwise multiply by [128..1] and an 8-lane row sum, which the VPU
-    handles natively. Requires mask.size % 8 == 0 (every engine batch is a
-    power of two >= 128). Parity vs numpy packbits pinned in
-    tests/test_slab.py.
+    decided_muladd_pack) shows the shift/or lowering is another
+    pathological vector op class on this stack, this is the drop-in
+    replacement — elementwise multiply by [128..1] and an 8-lane row sum,
+    plain VPU multiply-add (no MXU involved, hence the name). Any nonzero
+    element counts as a set bit, matching packbits' semantics for
+    non-boolean input. Requires mask.size % 8 == 0 (every engine batch is
+    a power of two >= 128). Parity vs numpy packbits pinned in
+    tests/test_slab.py and on hardware in tests/test_pallas_tpu.py.
     """
     w = jnp.array([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.uint32)
-    x = mask.reshape(mask.shape[0] // 8, 8).astype(jnp.uint32)
-    return (x * w).sum(axis=1).astype(jnp.uint8)
+    bits = (mask != 0).reshape(mask.shape[0] // 8, 8).astype(jnp.uint32)
+    return (bits * w).sum(axis=1).astype(jnp.uint8)
 
 
 class DecideResult(NamedTuple):

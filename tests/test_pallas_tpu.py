@@ -88,19 +88,19 @@ def test_floor_div_exact_on_chip():
     np.testing.assert_array_equal(got, want)
 
 
-def test_packbits_mxu_on_chip():
+def test_packbits_muladd_on_chip():
     """Hardware parity pin for the multiply-add packbits twin
-    (ops/decide.py packbits_mxu — the candidate swap if attribution shows
+    (ops/decide.py packbits_muladd — the candidate swap if attribution shows
     packbits' shift/or lowering is pathological, like division was). The
     formula is pinned on CPU in tests/test_slab.py; this pins the chip's
     u32 multiply-add reduce lowering."""
     import numpy as np
     import jax.numpy as jnp
 
-    from api_ratelimit_tpu.ops.decide import packbits_mxu
+    from api_ratelimit_tpu.ops.decide import packbits_muladd
 
     rng = np.random.RandomState(17)
     for size in (128, 1 << 16, 1 << 20):
         mask = rng.rand(size) < 0.41
-        got = np.asarray(jax.jit(packbits_mxu)(jnp.asarray(mask)))
+        got = np.asarray(jax.jit(packbits_muladd)(jnp.asarray(mask)))
         np.testing.assert_array_equal(got, np.packbits(mask))

@@ -426,7 +426,7 @@ class TestFloorDivExact:
         np.testing.assert_array_equal(got, want)
 
 
-class TestPackbitsMxu:
+class TestPackbitsMuladd:
     """The multiply-add packbits twin (the candidate packbits swap if
     on-chip attribution shows the shift/or lowering is pathological) must
     bit-match numpy's big-endian packbits on every mask shape the engine
@@ -434,15 +434,15 @@ class TestPackbitsMxu:
     floor_div precedent."""
 
     def test_matches_numpy(self):
-        from api_ratelimit_tpu.ops.decide import packbits_mxu
+        from api_ratelimit_tpu.ops.decide import packbits_muladd
 
         rng = np.random.RandomState(13)
         for size in (128, 1 << 12, 1 << 16):
             mask = rng.rand(size) < 0.37
-            got = np.asarray(packbits_mxu(jnp.asarray(mask)))
+            got = np.asarray(packbits_muladd(jnp.asarray(mask)))
             np.testing.assert_array_equal(got, np.packbits(mask))
         # all-zeros / all-ones edges
         for mask in (np.zeros(256, bool), np.ones(256, bool)):
             np.testing.assert_array_equal(
-                np.asarray(packbits_mxu(jnp.asarray(mask))), np.packbits(mask)
+                np.asarray(packbits_muladd(jnp.asarray(mask))), np.packbits(mask)
             )

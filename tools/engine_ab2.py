@@ -268,9 +268,9 @@ def main() -> None:
     timed("decided_packbits", v6)
 
     # v7: same output bits via the multiply-add packer (ops/decide.py
-    # packbits_mxu) — the candidate swap if v6 shows packbits' shift/or
+    # packbits_muladd) — the candidate swap if v6 shows packbits' shift/or
     # lowering is another pathological vector op class (like division was)
-    from api_ratelimit_tpu.ops.decide import packbits_mxu
+    from api_ratelimit_tpu.ops.decide import packbits_muladd
 
     @functools.partial(jax.jit, donate_argnames=("state",))
     def v7(state, ids):
@@ -284,9 +284,9 @@ def main() -> None:
             count_health=True,
         )
         over = _unsort(d.code, order) == 2
-        return state, packbits_mxu(over), health
+        return state, packbits_muladd(over), health
 
-    timed("decided_dotpack", v7)
+    timed("decided_muladd_pack", v7)
 
     print(json.dumps(results))
 
