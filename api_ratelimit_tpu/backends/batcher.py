@@ -28,7 +28,7 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 _CLOSE = object()
 
@@ -42,12 +42,23 @@ class MicroBatcher:
         execute_launch: Callable[[list], Any] | None = None,
         execute_collect: Callable[[Any], list] | None = None,
         max_inflight: int = 2,
+        block_mode: bool = False,
     ):
+        """block_mode: each submit() argument is ONE pre-packed uint32[6, n]
+        column block (the sidecar wire format) instead of a sequence of
+        per-item objects, and the executors receive a list of such blocks.
+        Same coalescing/window/double-buffer machinery — the unit taken per
+        future is the whole block, counts are in ITEMS (block columns), and
+        results may be one numpy array (sliced per future like a list).
+        This keeps the sidecar's aggregation path free of per-item Python
+        objects end to end."""
         self._execute = execute
         self._window = float(window_seconds)
         self._max_batch = int(max_batch)
+        self._block_mode = bool(block_mode)
         self._lock = threading.Lock()
         self._items: list = []
+        self._pending = 0  # item count across self._items (== len in item mode)
         # (future, start, count, enqueued_at)
         self._futures: list[tuple[Future, int, int, float]] = []
         self._inflight = 0
@@ -76,25 +87,33 @@ class MicroBatcher:
 
     # -- client side --
 
-    def submit(self, items: Sequence) -> list:
+    def submit(self, items) -> list:
         """Run `items` through the batch executor; returns their results in
-        order. Blocks until results are available."""
-        if not items:
+        order. Blocks until results are available. In block mode, `items`
+        is one uint32[6, n] block and the return is its uint32[n] result."""
+        count = items.shape[1] if self._block_mode else len(items)
+        if count == 0:
             return []
         if self._window <= 0:
             # direct mode: caller thread executes (single-flight via lock)
             with self._direct_lock:
                 if self._closed:
                     raise RuntimeError("batcher is closed")
+                if self._block_mode:
+                    return self._execute([items])
                 return self._execute(list(items))
 
         future: Future = Future()
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            start = len(self._items)
-            self._items.extend(items)
-            self._futures.append((future, start, len(items), time.monotonic()))
+            start = self._pending
+            if self._block_mode:
+                self._items.append(items)
+            else:
+                self._items.extend(items)
+            self._pending += count
+            self._futures.append((future, start, count, time.monotonic()))
             self._wakeup.notify()
         return future.result()
 
@@ -139,9 +158,9 @@ class MicroBatcher:
                 # submit() notifies on every enqueue, so wait on a deadline
                 # loop or the first straggler would end the window early
                 warm = self._futures and self._futures[0][3] <= self._last_end
-                if len(self._items) < self._max_batch and not warm:
+                if self._pending < self._max_batch and not warm:
                     deadline = time.monotonic() + self._window
-                    while len(self._items) < self._max_batch and not self._closed:
+                    while self._pending < self._max_batch and not self._closed:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
@@ -149,7 +168,8 @@ class MicroBatcher:
                 # Take whole requests only — a request's items never split
                 # across launches (its future completes from one result set).
                 # A single oversized request is taken alone; the executor
-                # loops over buckets internally.
+                # loops over buckets internally. Block mode: one submitted
+                # block per future, so taking k futures takes k blocks.
                 futures = []
                 taken = 0
                 for future, _start, count, _ts in self._futures:
@@ -157,8 +177,10 @@ class MicroBatcher:
                         break
                     futures.append((future, taken, count))
                     taken += count
-                items = self._items[:taken]
-                self._items = self._items[taken:]
+                n_units = len(futures) if self._block_mode else taken
+                items = self._items[:n_units]
+                self._items = self._items[n_units:]
+                self._pending -= taken
                 self._futures = [
                     (f, start - taken, count, ts)
                     for f, start, count, ts in self._futures[len(futures) :]

@@ -94,6 +94,7 @@ class SlabDeviceEngine:
         device=None,
         use_pallas: bool | None = None,
         mesh=None,
+        block_mode: bool = False,
     ):
         self._time_source = time_source
         self._near_limit_ratio = float(near_limit_ratio)
@@ -151,14 +152,27 @@ class SlabDeviceEngine:
         # overlaps the collector's blocking readback of batch k (ADVICE r3:
         # the p99 fix is pipelining in the dispatch path, not lock
         # narrowing; VERDICT r4 weak #2 extended the split to the sharded
-        # engine's compacted path).
-        self._batcher = MicroBatcher(
-            self._execute_batch,
-            window_seconds=batch_window_seconds,
-            max_batch=max_batch,
-            execute_launch=self._execute_launch,
-            execute_collect=self._execute_collect,
-        )
+        # engine's compacted path). block_mode (the sidecar server) swaps
+        # the item-list executors for the wire-block ones; the batcher
+        # machinery is shared.
+        self._block_batcher = bool(block_mode)
+        if self._block_batcher:
+            self._batcher = MicroBatcher(
+                self._execute_blocks,
+                window_seconds=batch_window_seconds,
+                max_batch=max_batch,
+                execute_launch=self._execute_blocks_launch,
+                execute_collect=self._execute_blocks_collect,
+                block_mode=True,
+            )
+        else:
+            self._batcher = MicroBatcher(
+                self._execute_batch,
+                window_seconds=batch_window_seconds,
+                max_batch=max_batch,
+                execute_launch=self._execute_launch,
+                execute_collect=self._execute_collect,
+            )
 
     def _drain_health_locked(self) -> None:
         pending, self._pending_health = self._pending_health, []
@@ -194,6 +208,8 @@ class SlabDeviceEngine:
     def submit(self, items: list[_Item]) -> list[int]:
         """Batched fixed-window increment; returns each item's
         post-increment counter."""
+        if self._block_batcher:
+            raise RuntimeError("engine is in block_mode; use submit_block")
         return self._batcher.submit(items)
 
     def flush(self) -> None:
@@ -260,10 +276,15 @@ class SlabDeviceEngine:
 
     def _launch_async(self, items: list[_Item]):
         """Async launch: pack, dispatch, return a token without waiting for
-        execution. Mesh mode owner-routes on the host and dispatches the
-        compacted per-shard launch (each chip probes only the ~n/n_dev keys
-        it owns — nothing replicated or psum'd on the result path)."""
-        packed, n, cap = self._pack_with_cap(items)
+        execution."""
+        return self._dispatch_packed(*self._pack_with_cap(items))
+
+    def _dispatch_packed(self, packed: np.ndarray, n: int, cap: int):
+        """Dispatch one packed uint32[7, bucket] launch; returns the token
+        the collect phase drains. Mesh mode owner-routes on the host and
+        dispatches the compacted per-shard launch (each chip probes only
+        the ~n/n_dev keys it owns — nothing replicated or psum'd on the
+        result path)."""
         self.launch_sizes.append(n)
         if self._engine is not None:
             token = self._engine.launch_after_compact(packed, cap)
@@ -316,10 +337,94 @@ class SlabDeviceEngine:
         return after_dev, n
 
     def _collect(self, token) -> list[int]:
+        return self._collect_array(token).tolist()
+
+    def _collect_array(self, token) -> np.ndarray:
         payload, n = token
         if self._engine is not None:
-            return self._engine.collect_after_compact(payload)[:n].tolist()
-        return np.asarray(payload)[:n].tolist()
+            return self._engine.collect_after_compact(payload)[:n]
+        return np.asarray(payload)[:n]
+
+    # -- block-native path (sidecar wire blocks; no per-item objects) --
+
+    @property
+    def block_mode(self) -> bool:
+        """Public capability flag: the sidecar server routes wire payloads
+        through submit_block when this is True (a private-attr getattr
+        would silently fall back to the slow per-item path if the field
+        were ever renamed)."""
+        return self._block_batcher
+
+    def submit_block(self, block: np.ndarray) -> np.ndarray:
+        """Batched fixed-window increment over one uint32[6, n] column
+        block (the sidecar wire layout: fp_lo, fp_hi, hits, limit, divider,
+        jitter) — returns uint32[n] post-increment counters. At aggregated
+        sidecar load the per-item object path costs ~260ns/item in pure
+        Python (a ~4M items/s host ceiling regardless of the device); this
+        path goes wire block -> padded device block with numpy row copies
+        only. Requires block_mode=True."""
+        if not self._block_batcher:
+            raise RuntimeError("engine not in block_mode")
+        return self._batcher.submit(block)
+
+    def _iter_block_chunks(self, blocks: list[np.ndarray]):
+        """Yield (packed[7, bucket], n, cap) per max_bucket chunk of the
+        submitted blocks. The common case (total fits one launch) copies
+        each block's columns straight into the padded device block — one
+        copy per byte; only an oversized aggregate pays a concatenate
+        first. The cap bound uses max(limit)+max(hits) over the chunk — at
+        least as wide as the per-item max the item path computes, so the
+        saturating readback stays exact."""
+        total = sum(b.shape[1] for b in blocks)
+        if total <= self._max_bucket:
+            size = self._bucket_for(total)
+            packed = np.zeros((7, size), dtype=np.uint32)
+            off = 0
+            for b in blocks:
+                packed[:6, off : off + b.shape[1]] = b
+                off += b.shape[1]
+            chunks = [(packed, total)]
+        else:
+            cat = np.concatenate(blocks, axis=1)
+            chunks = []
+            for off in range(0, total, self._max_bucket):
+                chunk = cat[:, off : off + self._max_bucket]
+                n = chunk.shape[1]
+                packed = np.zeros((7, self._bucket_for(n)), dtype=np.uint32)
+                packed[:6, :n] = chunk
+                chunks.append((packed, n))
+        now = np.uint32(self._time_source.unix_now())
+        ratio = np.float32(self._near_limit_ratio).view(np.uint32)
+        for packed, n in chunks:
+            maxv = int(packed[2, :n].max()) + int(packed[3, :n].max())
+            cap = 0xFF if maxv < 255 else 0xFFFF if maxv < 65535 else 0xFFFFFFFF
+            packed[6, 0] = now
+            packed[6, 1] = ratio
+            yield packed, n, cap
+
+    def _execute_blocks(self, blocks: list[np.ndarray]) -> np.ndarray:
+        return self._execute_blocks_collect(self._execute_blocks_launch(blocks))
+
+    def _execute_blocks_launch(self, blocks: list[np.ndarray]):
+        try:
+            return [
+                self._dispatch_packed(packed, n, cap)
+                for packed, n, cap in self._iter_block_chunks(blocks)
+            ]
+        except Exception as e:
+            raise CacheError(f"tpu backend failure: {e}") from e
+
+    def _execute_blocks_collect(self, tokens) -> np.ndarray:
+        try:
+            outs = [
+                self._collect_array(t).astype(np.uint32, copy=False)
+                for t in tokens
+            ]
+            return outs[0] if len(outs) == 1 else np.concatenate(outs)
+        except CacheError:
+            raise
+        except Exception as e:
+            raise CacheError(f"tpu backend failure: {e}") from e
 
     def _pack(self, items: list[_Item]) -> np.ndarray:
         """uint32[7, bucket] input block (one H2D transfer per launch)."""

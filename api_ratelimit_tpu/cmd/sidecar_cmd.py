@@ -51,6 +51,10 @@ def main() -> None:
         max_batch=settings.tpu_batch_limit,
         use_pallas=None if settings.tpu_use_pallas else False,
         mesh=mesh,
+        # frontends ship packed uint32[6, n] wire blocks; the block-native
+        # batcher keeps the aggregation path free of per-item Python
+        # objects (~260ns/item, a ~4M items/s host ceiling otherwise)
+        block_mode=True,
     )
     server = SlabSidecarServer(
         settings.sidecar_socket,

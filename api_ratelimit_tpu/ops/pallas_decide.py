@@ -8,10 +8,12 @@ scalar host oracle on every branch.
 
 Layout: the batch is viewed as (rows, 128) int32/uint32/float32 tiles —
 the natural VPU shape (8x128 lanes). The kernel runs on a 1-D grid over
-row-blocks so any power-of-two batch >= 128 (one lane row — the backend's
-smallest launch bucket, backends/tpu.py) streams through VMEM; the block
-size adapts via gcd, so sub-1024 batches simply run a single smaller
-block. now/near_ratio arrive as SMEM scalars.
+row-blocks. Any power-of-two batch >= 128 (one lane row — the backend's
+smallest launch bucket, backends/tpu.py) works: row counts <= the 64-row
+block run as one smaller block, larger power-of-two counts divide evenly.
+Non-power-of-two row counts that don't divide by the block raise — the
+backend's buckets are always powers of two, so the constraint never fires
+in production. now/near_ratio arrive as SMEM scalars.
 
 Reference semantics mirrored (same as ops/decide.py):
 src/limiter/base_limiter.go:83-86, :88, :107-109, :129-145, :154-165 and

@@ -979,6 +979,7 @@ def bench_sidecar(
             batch_window_seconds=0.001,
             max_batch=65536,
             use_pallas=engine_use_pallas(on_tpu),
+            block_mode=True,  # wire blocks go straight to the device path
         )
         server = SlabSidecarServer(path, engine)
         env = dict(os.environ)

@@ -48,6 +48,7 @@ def _make_engine(ts):
         buckets=(128, 1024),
         max_batch=1024,
         use_pallas=False,
+        block_mode=True,  # the production sidecar server runs block-native
     )
 
 
@@ -352,6 +353,7 @@ class TestRunnerIntegration:
             buckets=(128, 1024),
             max_batch=1024,
             use_pallas=False,
+            block_mode=True,
         )
         sock = str(tmp_path / "slab.sock")
         server = SlabSidecarServer(sock, engine)
