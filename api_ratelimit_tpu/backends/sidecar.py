@@ -15,10 +15,11 @@ through the one slab, exactly like N replicas against one Redis.
 
 The server runs the engine in block mode: the wire payload's uint32[6, n]
 block goes to the device input with numpy row copies only — no per-item
-Python objects anywhere on the aggregation path (the item path costs
-~260ns/item, an ~0.4M items/s server ceiling at batch 8k; block-native
-measures ~8x that on the same host, and the gap widens on a real chip
-where device time stops masking host time).
+Python objects anywhere on the aggregation path (the item path's decode +
+repack cost ~2.3us/item of pure Python — an ~0.4M items/s server ceiling
+at batch 8k with device time included; block-native measures ~8x that on
+the same host, and the gap widens on a real chip where device time stops
+masking host time).
 
 This is the "JAX/TPU sidecar" of the north star (BASELINE.json).
 

