@@ -359,10 +359,10 @@ class SlabDeviceEngine:
         """Batched fixed-window increment over one uint32[6, n] column
         block (the sidecar wire layout: fp_lo, fp_hi, hits, limit, divider,
         jitter) — returns uint32[n] post-increment counters. At aggregated
-        sidecar load the per-item object path costs ~260ns/item in pure
-        Python (a ~4M items/s host ceiling regardless of the device); this
-        path goes wire block -> padded device block with numpy row copies
-        only. Requires block_mode=True."""
+        sidecar load the per-item path's decode + repack cost ~2.3us/item
+        of pure Python (an ~0.4M items/s server ceiling at batch 8k,
+        measured in PERF.md); this path goes wire block -> padded device
+        block with numpy row copies only. Requires block_mode=True."""
         if not self._block_batcher:
             raise RuntimeError("engine not in block_mode")
         return self._batcher.submit(block)

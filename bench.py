@@ -23,6 +23,27 @@ Two tiers:
 
 Prints ONE JSON line: the headline engine metric plus per-config results.
 vs_baseline is against the 10M decisions/sec north-star target.
+
+Artifact field guide (round 5 additions):
+  probe.total_cap_s / probe_s     probe wall-time cap and actual spend —
+                                  the probe can no longer starve tiers
+  engine.pass_s_first/pass_s_min/warm_replay_ratio
+                                  per-pass device times; ratio < 0.5 flags
+                                  tunnel replay dedup, and the headline is
+                                  then derived from the first cold pass
+                                  (rate_looped_suspect keeps the tainted
+                                  loop rate for diagnosis)
+  engine.parity.lossy_events/explained
+                                  structural drift bound: every false_ok
+                                  must be covered by drops + steals*limit
+  service.device_split            chain-timed device_ms vs readback_ms at
+                                  the batcher's observed median batch, and
+                                  p99_co_located_est_ms (= p99 minus the
+                                  result drain that rides the dev tunnel)
+  engine.sharded.{rate,rate_pipelined,rate_replicated,rate_single_device}
+                                  cold-block sharded rows; host_cpus says
+                                  whether the mesh could physically
+                                  parallelize (1 core = shape check only)
 """
 
 from __future__ import annotations
@@ -113,6 +134,7 @@ def resolve_platform() -> tuple[str, dict]:
             diag["attempts"].append(rec)
             if probe.returncode == 0 and platform:
                 diag["platform"] = platform
+                diag["probe_s"] = round(time.perf_counter() - t_probe, 1)
                 return platform, diag
         except subprocess.TimeoutExpired as e:
             rec["error"] = f"timeout after {deadline:.0f}s"

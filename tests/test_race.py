@@ -268,3 +268,57 @@ class TestSlabPropertyDifferential:
                 assert got.limit_remaining == want.limit_remaining
         finally:
             engine.close()
+
+
+class TestBlockPathPropertyDifferential:
+    """The sidecar server's block-native path must be op-for-op identical
+    to the per-item engine path under random op streams — duplicates in a
+    batch, window rollovers, and counter continuation included."""
+
+    @settings(max_examples=15, deadline=None)
+    @given(
+        ops=st.lists(
+            st.tuples(
+                st.integers(min_value=0, max_value=5),  # key id
+                st.integers(min_value=1, max_value=3),  # hits
+                st.integers(min_value=0, max_value=90),  # seconds to advance
+                st.integers(min_value=1, max_value=3),  # duplicates in batch
+            ),
+            min_size=1,
+            max_size=30,
+        ),
+        limit=st.integers(min_value=1, max_value=6),
+        divider=st.sampled_from([1, 60, 3600]),
+    )
+    def test_block_matches_item_engine(self, ops, limit, divider):
+        import numpy as np
+
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+
+        ts_a, ts_b = FakeTimeSource(700_000), FakeTimeSource(700_000)
+        item_eng = SlabDeviceEngine(
+            time_source=ts_a, n_slots=256, use_pallas=False
+        )
+        blk_eng = SlabDeviceEngine(
+            time_source=ts_b, n_slots=256, use_pallas=False, block_mode=True
+        )
+        try:
+            for key_id, hits, advance, repeat in ops:
+                ts_a.advance(advance)
+                ts_b.advance(advance)
+                fp = (0x9E3779B97F4A7C15 * (key_id + 1)) & ((1 << 64) - 1)
+                items = [
+                    _Item(fp=fp, hits=hits, limit=limit, divider=divider, jitter=0)
+                ] * repeat
+                block = np.zeros((6, repeat), dtype=np.uint32)
+                block[0] = fp & 0xFFFFFFFF
+                block[1] = fp >> 32
+                block[2] = hits
+                block[3] = limit
+                block[4] = divider
+                want = item_eng.submit(items)
+                got = blk_eng.submit_block(block)
+                assert want == got.tolist(), (key_id, hits, advance, repeat)
+        finally:
+            item_eng.close()
+            blk_eng.close()
