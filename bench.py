@@ -301,9 +301,19 @@ def bench_engine_zipf(
         return state, after.astype(jnp.uint8), health
 
     host_ids = zipf_ids(n_keys, batch, n_batches + 1)
-    staged = [jax.device_put(host_ids[i], device) for i in range(n_batches + 1)]
-    for s in staged:
-        s.block_until_ready()
+    # staged device buffers live in a box so the tier can FREE them before
+    # the service/sidecar tiers run (~128MB of HBM on TPU) and the deferred
+    # extras closure can re-stage from the host ids when it finally runs
+    staged_box: dict = {"arrays": []}
+
+    def ensure_staged() -> list:
+        if not staged_box["arrays"]:
+            staged_box["arrays"] = [
+                jax.device_put(host_ids[i], device) for i in range(n_batches + 1)
+            ]
+            for s in staged_box["arrays"]:
+                s.block_until_ready()
+        return staged_box["arrays"]
 
     # keep the timed region meaningful whatever the per-step cost turns out
     # to be: after the first pass over the staged stream (which parity
@@ -317,6 +327,7 @@ def bench_engine_zipf(
         device pipeline (block on the donated state chain) separately from
         the output readback drain. Returns a result dict + fetched outputs
         of the FIRST staged pass (warm first) — the stream parity replays."""
+        staged = ensure_staged()
         state = jax.device_put(make_slab(n_slots), device)
         state, out, _warm_health = step(state, staged[-1], flag)
         warm = np.asarray(out)
@@ -455,29 +466,45 @@ def bench_engine_zipf(
     print(f"[engine] parity={result['parity']}", file=sys.stderr)
     publish(result)
 
-    # On the chip, also time the OTHER engine (kernel-vs-XLA must be a
-    # recorded number, VERDICT r3 weak #6) and the after-mode production
-    # path — each gated on budget. Runs whichever engine was not the
-    # headline, so BENCH_PALLAS=0 still records the kernel.
-    if on_tpu and pallas_error is None and left() > 90:
-        alt_flag = not use_pallas
-        alt_key = "rate_pallas_update" if alt_flag else "rate_xla_update"
+    # The comparison rows — the OTHER engine's twin (kernel-vs-XLA must be
+    # a recorded number, VERDICT r3 weak #6) and the after-mode production
+    # path — are DEFERRED: on a cold compilation cache each costs a remote
+    # compile (~60-90s through the tunnel), and running them here starved
+    # the never-yet-measured-on-TPU service tiers. main() runs the
+    # returned closure after the full tier sweep, budget permitting.
+    # free the staged device buffers before the service/sidecar tiers run;
+    # extras re-stages from the host ids if/when it gets budget
+    staged_box["arrays"] = []
+
+    def extras() -> None:
         try:
-            alt, _ = run_path(
-                bench_step, "pallas-twin" if alt_flag else "xla-twin", alt_flag
-            )
-            result[alt_key] = alt["rate"]
-            result[alt_key + "_device_pipeline"] = alt["rate_device_pipeline"]
-        except Exception as e:
-            result[alt_key] = f"error: {str(e)[-200:]}"
-        publish(result)
-    if left() > 90:
-        try:
-            after, _ = run_path(after_step, "after-mode", use_pallas)
-            result["after_mode"] = after
-        except Exception as e:
-            result["after_mode"] = {"error": str(e)[-200:]}
-    return result
+            if on_tpu and pallas_error is None and left() > 90:
+                alt_flag = not use_pallas
+                alt_key = "rate_pallas_update" if alt_flag else "rate_xla_update"
+                try:
+                    alt, _ = run_path(
+                        bench_step,
+                        "pallas-twin" if alt_flag else "xla-twin",
+                        alt_flag,
+                    )
+                    result[alt_key] = alt["rate"]
+                    result[alt_key + "_device_pipeline"] = alt[
+                        "rate_device_pipeline"
+                    ]
+                except Exception as e:
+                    result[alt_key] = f"error: {str(e)[-200:]}"
+                publish(result)
+            if left() > 90:
+                try:
+                    after, _ = run_path(after_step, "after-mode", use_pallas)
+                    result["after_mode"] = after
+                except Exception as e:
+                    result["after_mode"] = {"error": str(e)[-200:]}
+                publish(result)
+        finally:
+            staged_box["arrays"] = []
+
+    return result, extras
 
 
 # ---------------- service-level benches (configs[0..3]) ----------------
@@ -1206,8 +1233,9 @@ def main() -> None:
             result["vs_baseline"] = round(partial["rate"] / TARGET, 4)
         emit()
 
+    engine_extras = None
     try:
-        engine = bench_engine_zipf(device, on_tpu, left, publish_engine)
+        engine, engine_extras = bench_engine_zipf(device, on_tpu, left, publish_engine)
         configs["zipf_10M_engine"] = engine
         result["value"] = engine["rate"]
         result["vs_baseline"] = round(engine["rate"] / TARGET, 4)
@@ -1251,6 +1279,16 @@ def main() -> None:
         except Exception as e:
             sidecar_results["error"] = str(e)[-300:]
     emit()
+
+    # engine comparison rows (kernel twin, after-mode), deferred from the
+    # engine tier so their cold-cache compiles never starve the tier sweep
+    # (budget gates live inside the closure; it publishes its own lines)
+    if engine_extras is not None:
+        try:
+            engine_extras()
+        except Exception as e:
+            engine["extras_error"] = str(e)[-200:]
+            emit()
 
     # sharded scaling LAST — on real multi-device hardware it is a real
     # number; the 1-core virtual-CPU-mesh fallback only validates shapes
