@@ -268,6 +268,46 @@ def test_grpc_health_watch_streams_transition(running_server):
         assert err.value.code() == grpc.StatusCode.NOT_FOUND
 
 
+def test_grpc_health_watch_cap(running_server):
+    """Each sync Watch stream pins a gRPC worker thread; beyond MAX_WATCHERS
+    the server answers RESOURCE_EXHAUSTED instead of letting health probes
+    starve the ratelimit RPC pool."""
+    from api_ratelimit_tpu.server.health import HealthChecker
+
+    runner, _ = running_server
+    with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+        watch = ch.unary_stream(
+            "/grpc.health.v1.Health/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        streams = []
+        try:
+            for _ in range(HealthChecker.MAX_WATCHERS):
+                s = watch(health_pb2.HealthCheckRequest())
+                assert next(s).status == health_pb2.HealthCheckResponse.SERVING
+                streams.append(s)
+            overflow = watch(health_pb2.HealthCheckRequest())
+            with pytest.raises(grpc.RpcError) as err:
+                next(overflow)
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            for s in streams:
+                s.cancel()
+        # slots free up once watchers disconnect
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                s = watch(health_pb2.HealthCheckRequest())
+                assert next(s).status == health_pb2.HealthCheckResponse.SERVING
+                s.cancel()
+                break
+            except grpc.RpcError:
+                time.sleep(0.1)
+        else:
+            pytest.fail("watcher slot never freed after cancels")
+
+
 def test_debug_endpoints(running_server):
     runner, _ = running_server
     port = runner.server.debug_port

@@ -235,6 +235,36 @@ class TestRuntimeLoader:
         with pytest.raises(ValueError):
             DirectoryRuntimeLoader(str(tmp_path), watcher="fswatch")
 
+    def test_inotify_rebuild_failure_falls_back_to_poll(self, tmp_path):
+        """Mid-flight inotify failure (e.g. watch-limit exhaustion during a
+        deploy burst) must degrade to polling, not kill hot reload."""
+        if sys.platform != "linux":
+            pytest.skip("inotify is Linux-only")
+        self._mkconfig(tmp_path, "a.yaml", "one")
+        loader = DirectoryRuntimeLoader(
+            str(tmp_path),
+            watcher="inotify",
+            poll_interval_seconds=0.05,
+            safety_rescan_seconds=3600.0,
+        )
+        try:
+            loader.start_watching()
+            assert loader.watching_with == "inotify"
+
+            def boom():
+                raise OSError("inotify watch limit reached")
+
+            loader._inotify.rebuild = boom
+            self._mkconfig(tmp_path, "b.yaml", "two")  # event -> failed rebuild
+            assert self._wait_for(lambda: loader.watching_with == "poll")
+            # the poll loop keeps detecting changes
+            self._mkconfig(tmp_path, "c.yaml", "three")
+            assert self._wait_for(
+                lambda: loader.snapshot().get("config.c") == "three"
+            )
+        finally:
+            loader.stop()
+
 
 class TestConfigCheckCmd:
     def test_valid_config(self, tmp_path, capsys):
