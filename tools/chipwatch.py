@@ -1,0 +1,254 @@
+"""Unattended on-chip measurement chain for flaky chip windows.
+
+The round-5 tunnel pattern: down for 18+ hours, then a window opens that
+is long enough for small-batch probes (divtest completed: add 0.026ms /
+float_div 0.029ms / recip_div 0.027ms at 2^20 — division exonerated) but
+dies during the first 256MB slab staging of engine_ab2. This driver
+makes every future window count without a human in the loop:
+
+  probe -> linkprobe -> divtest -> engine_ab2(small slab) ->
+  engine_ab2(full) -> Pallas TPU tests -> bench.py
+
+Per-stage subprocess timeouts; after any stage failure the device is
+re-probed (a wedged tunnel fails the probe and we go back to waiting)
+and completed stages are never re-run. All output streams into the log
+with flushed per-stage headers so a dead window still yields evidence.
+
+Usage:  nohup python -m tools.chipwatch > /tmp/chipwatch.log 2>&1 &
+        (add --resume to continue a prior chain after a watcher crash;
+        the default start re-measures everything)
+State:  /tmp/chipwatch_state.json (stage completion), logs under /tmp,
+        bench artifact copied to BENCH_r05_chip_try.json on success.
+A stage only counts as done when its output proves it ran on the chip
+(platform marker / tests actually passed) — rc==0 on the CPU fallback
+is a failed window, not evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_PATH = "/tmp/chipwatch_state.json"
+# The probe must resolve the platform EXACTLY like the stages do
+# (respect_jax_platforms_env, then ask jax) and compare the last line
+# whole — substring-matching all of stdout would pass on a plugin banner
+# mentioning "tpu", and skipping the env re-assert would let the probe
+# see the chip while every stage pins itself to cpu.
+PROBE_CMD = [
+    sys.executable,
+    "-c",
+    "from api_ratelimit_tpu.utils.jaxsetup import respect_jax_platforms_env;"
+    "respect_jax_platforms_env();"
+    "import jax; print(jax.devices()[0].platform)",
+]
+
+# (name, argv, timeout_s, success_marker). Order is cheapest-first so a
+# short window still produces the highest-information-per-second
+# evidence. success_marker must appear in the output THIS run appended —
+# rc==0 alone is not success: if the window dies between our probe and
+# the stage's jax init, the tools downscale onto the CPU fallback and
+# exit 0, and the pallas test module skips itself cleanly.
+TPU_MARK = '"platform": "tpu"'
+STAGES = [
+    ("linkprobe", [sys.executable, "-m", "tools.linkprobe"], 900, TPU_MARK),
+    ("divtest", [sys.executable, "-m", "tools.divtest"], 900, TPU_MARK),
+    (
+        "ab2_small",
+        [sys.executable, "-m", "tools.engine_ab2", "--slots", str(1 << 21)],
+        1800,
+        TPU_MARK,
+    ),
+    ("ab2_full", [sys.executable, "-m", "tools.engine_ab2"], 2400, TPU_MARK),
+    (
+        "pallas_tests",
+        [sys.executable, "-m", "pytest", "tests/test_pallas_tpu.py", "-q"],
+        1800,
+        " passed",
+    ),
+    ("bench", [sys.executable, "bench.py"], 900, TPU_MARK),
+]
+
+
+def log(msg: str) -> None:
+    print(f"[chipwatch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"done": []}
+
+
+def save_state(state: dict) -> None:
+    with open(STATE_PATH, "w") as f:
+        json.dump(state, f)
+
+
+def probe(timeout_s: float = 150.0) -> bool:
+    try:
+        out = subprocess.run(
+            PROBE_CMD,
+            cwd=REPO,
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        lines = [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+        ok = out.returncode == 0 and bool(lines) and lines[-1] == "tpu"
+        if not ok:
+            log(f"probe rc={out.returncode} out={out.stdout.strip()!r}")
+        return ok
+    except subprocess.TimeoutExpired:
+        log(f"probe timeout after {timeout_s:.0f}s")
+        return False
+
+
+def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> bool:
+    log(f"stage {name}: start (timeout {timeout_s:.0f}s)")
+    logpath = f"/tmp/chip_{name}.log"
+    env = dict(os.environ)
+    if name == "pallas_tests":
+        env["TPU_TESTS"] = "1"
+    if name == "bench":
+        # Forced mode: no silent CPU fallback — a dead window makes the
+        # stage fail (and not count, per the probe-gated failure rule)
+        # instead of recording a CPU artifact as chip evidence.
+        env["BENCH_PLATFORM"] = "tpu"
+    offset = os.path.getsize(logpath) if os.path.exists(logpath) else 0
+    with open(logpath, "ab") as lf:
+        lf.write(f"\n===== {time.ctime()} =====\n".encode())
+        lf.flush()
+        try:
+            # New session so a timeout can kill grandchildren too (bench
+            # sidecar workers, pytest children) — an orphan holding the
+            # TPU runtime would wedge every later probe in this driver.
+            proc = subprocess.Popen(
+                argv,
+                cwd=REPO,
+                stdout=lf,
+                stderr=subprocess.STDOUT,
+                env=env,
+                start_new_session=True,
+            )
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            log(f"stage {name}: TIMEOUT after {timeout_s:.0f}s (log {logpath})")
+            return "timeout"
+    with open(logpath, "rb") as f:
+        f.seek(offset)
+        appended = f.read().decode(errors="replace")
+    ok = rc == 0 and marker in appended
+    log(f"stage {name}: rc={rc} marker_found={marker in appended} (log {logpath})")
+    if ok:
+        return "ok"
+    # rc==0 without the marker means the stage silently ran on the CPU
+    # fallback — a window problem, not a stage bug.
+    return "fail" if rc != 0 else "fallback"
+
+
+MAX_STAGE_FAILURES = 3
+
+
+def harvest(state: dict) -> None:
+    """Copy evidence into the repo: the bench JSON line — only if THIS
+    chain's bench stage succeeded (/tmp/chip_bench.log is append-only
+    across chains; republishing its last line unconditionally would
+    present a stale pre-relaunch artifact as this chain's evidence) —
+    and the chain's own log, always."""
+    if "bench" in state["done"]:
+        try:
+            with open("/tmp/chip_bench.log", "rb") as f:
+                lines = [
+                    ln
+                    for ln in f.read().decode(errors="replace").splitlines()
+                    if ln.startswith('{"metric"')
+                ]
+            if lines:
+                with open(os.path.join(REPO, "BENCH_r05_chip_try.json"), "w") as f:
+                    f.write(lines[-1] + "\n")
+        except OSError:
+            pass
+    try:
+        subprocess.run(["cp", "/tmp/chipwatch.log", os.path.join(REPO, "CHIP_RUN_r5.log")])
+    except OSError:
+        pass
+
+
+def main() -> None:
+    # Fresh by default: the state file is for resuming THIS chain after a
+    # watcher crash (--resume), not for surviving intentional relaunches —
+    # a relaunch after a code fix or for a new round must re-measure, not
+    # silently skip stages a stale file marked done.
+    if "--resume" in sys.argv[1:]:
+        state = load_state()
+        log(f"resuming: done={state['done']}")
+    else:
+        state = {"done": []}
+        save_state(state)
+    failures: dict = {}
+    attempt = 0
+    while True:
+        # Repeatedly-failing stages are DEMOTED to the end of the pass,
+        # not dropped: a slow-but-alive tunnel can time a heavy stage
+        # out with the tiny probe still passing, and permanent exclusion
+        # would then skip the chain's primary measurement in a later
+        # healthy window. The chain only finishes early if EVERY
+        # remaining stage has hit the failure cap.
+        remaining = sorted(
+            (s for s in STAGES if s[0] not in state["done"]),
+            key=lambda s: (
+                failures.get(s[0], 0) >= MAX_STAGE_FAILURES,
+                STAGES.index(s),
+            ),
+        )
+        if not remaining or all(
+            failures.get(s[0], 0) >= MAX_STAGE_FAILURES for s in remaining
+        ):
+            log(f"chain finished: done={state['done']} failures={failures}")
+            harvest(state)
+            return
+        attempt += 1
+        if not probe():
+            time.sleep(90)
+            continue
+        log(f"window open (attempt {attempt}); {len(remaining)} stages remain")
+        for name, argv, timeout_s, marker in remaining:
+            outcome = run_stage(name, argv, timeout_s, marker)
+            if outcome == "ok":
+                state["done"].append(name)
+                save_state(state)
+                continue
+            # Re-probe to distinguish "tunnel died" (wait for a new
+            # window; nothing counted) from a live device. Only a
+            # nonzero exit with the device alive counts as a
+            # deterministic stage failure — timeouts and silent CPU
+            # fallbacks are window symptoms even when the probe passes.
+            alive = probe()
+            counted = alive and outcome == "fail"
+            if counted:
+                failures[name] = failures.get(name, 0) + 1
+            log(
+                f"stage {name} {outcome} (counted={counted}, "
+                f"count={failures.get(name, 0)}); device alive={alive}"
+            )
+            if not alive:
+                break
+        harvest(state)
+        time.sleep(30)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,9 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=8)
     args = ap.parse_args()
 
+    from api_ratelimit_tpu.utils.jaxsetup import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
     import jax
     import jax.numpy as jnp
 
@@ -53,12 +56,21 @@ def main() -> None:
 
     expand = make_expand()
 
+    print(f"[ab2] staging {R + 1} x {b * 4 >> 20}MB id arrays", file=sys.stderr, flush=True)
     staged = stage_zipf_ids(device, b, args.keys, R + 1)
+    print("[ab2] staging done", file=sys.stderr, flush=True)
 
     results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
 
     def timed(label, step, raw_table=False):
+        print(
+            f"[ab2:{label}] staging {n * 32 >> 20}MB slab",
+            file=sys.stderr,
+            flush=True,
+        )
         state = jax.device_put(make_slab(n), device)
+        jax.block_until_ready(state)
+        print(f"[ab2:{label}] slab staged; warmup compile", file=sys.stderr, flush=True)
         if raw_table:
             state = state.table
         out = step(state, staged[-1])
@@ -72,7 +84,10 @@ def main() -> None:
             outs.append(out[1:])
         jax.block_until_ready(state)
         t_dev = time.perf_counter() - t0
-        fetched = jax.block_until_ready(outs)
+        # device_get, not block_until_ready: the e2e leg must pay the
+        # actual D2H readback (the ~280ms/step prime suspect over the
+        # ~14MB/s tunnel) or array-out variants would read as free.
+        fetched = jax.device_get(outs)
         t_e2e = time.perf_counter() - t0
         results[label] = {
             "ms_device": round(t_dev / R * 1e3, 3),

@@ -1,0 +1,93 @@
+"""Characterize the host<->device link before trusting any measurement.
+
+The 2026-07-31 chip window died mid-way through engine_ab2's staging: the
+process sat 21 minutes at 1s of CPU, blocked in a device_put, with no way
+to tell whether the tunnel had died or a large transfer was crawling.
+This probe ramps transfer sizes 1MB -> 256MB with a flushed line per
+size, so the log always shows the largest size that completed and the
+realized bandwidth in each direction. Run it FIRST in any chip window.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from api_ratelimit_tpu.utils.jaxsetup import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+    import jax
+
+    t0 = time.perf_counter()
+    d = jax.devices()[0]
+    print(
+        f"[linkprobe] device={d} platform={d.platform} "
+        f"init={time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+        flush=True,
+    )
+    sizes = [1, 4, 16, 64, 256]
+    if d.platform != "tpu":
+        sizes = [1, 4]
+    # Connection warmup (as bench.py's measure_link does): the first
+    # transfer pays one-time tunnel/client setup that would otherwise be
+    # billed to the 1MB row and misread as a slow link.
+    w = jax.device_put(np.zeros(1024, dtype=np.int32), d)
+    np.asarray(w)
+    del w
+    results = {"platform": d.platform}
+    for mb in sizes:
+        a = np.zeros((mb << 20) // 4, dtype=np.int32)
+        t0 = time.perf_counter()
+        x = jax.device_put(a, d)
+        x.block_until_ready()
+        h2d = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(x)
+        d2h = time.perf_counter() - t0
+        results[f"{mb}MB"] = {
+            "h2d_MBps": round(mb / h2d, 1),
+            "d2h_MBps": round(mb / d2h, 1),
+        }
+        print(
+            f"[linkprobe] {mb}MB h2d {mb / h2d:.1f} MB/s ({h2d:.2f}s) "
+            f"d2h {mb / d2h:.1f} MB/s ({d2h:.2f}s)",
+            file=sys.stderr,
+            flush=True,
+        )
+        del x
+    # One tiny dispatch round-trip: the per-launch floor every
+    # chained-step measurement sits on top of.
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + 1)
+    y = jax.device_put(np.zeros(8, dtype=np.int32), d)
+    f(y).block_until_ready()
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        y = f(y)
+    y.block_until_ready()
+    chained = (time.perf_counter() - t0) / n * 1e3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f(y).block_until_ready()
+    blocking = (time.perf_counter() - t0) / n * 1e3
+    results["launch_ms_chained"] = round(chained, 3)
+    results["launch_ms_blocking"] = round(blocking, 3)
+    print(
+        f"[linkprobe] launch chained {chained:.3f}ms blocking {blocking:.3f}ms",
+        file=sys.stderr,
+        flush=True,
+    )
+    import json
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
