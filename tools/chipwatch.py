@@ -111,7 +111,44 @@ def probe(timeout_s: float = 150.0) -> bool:
         return False
 
 
-def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> bool:
+def _descendants(root: int) -> list:
+    """All live PIDs whose parent chain reaches `root` (/proc walk).
+
+    killpg alone is not enough here: intermediate wrapper processes can
+    re-group children, so a timed-out stage's grandchildren (bench
+    sidecar workers, pytest children) may sit in a different process
+    group while still holding the TPU runtime open."""
+    ppid: dict = {}
+    for ent in os.listdir("/proc"):
+        if not ent.isdigit():
+            continue
+        try:
+            with open(f"/proc/{ent}/stat") as f:
+                ppid[int(ent)] = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+    out, frontier = [], {root}
+    while frontier:
+        nxt = {p for p, pp in ppid.items() if pp in frontier and p not in out}
+        out.extend(nxt)
+        frontier = nxt
+    return out
+
+
+def _kill_tree(pid: int) -> None:
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    for p in _descendants(pid):
+        try:
+            os.kill(p, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> str:
+    """Returns "ok" | "fail" | "timeout" | "fallback" (rc==0, no marker)."""
     log(f"stage {name}: start (timeout {timeout_s:.0f}s)")
     logpath = f"/tmp/chip_{name}.log"
     env = dict(os.environ)
@@ -120,8 +157,12 @@ def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> bool:
     if name == "bench":
         # Forced mode: no silent CPU fallback — a dead window makes the
         # stage fail (and not count, per the probe-gated failure rule)
-        # instead of recording a CPU artifact as chip evidence.
+        # instead of recording a CPU artifact as chip evidence. The
+        # budget is raised above the driver's default so this one chip
+        # run can complete every tier (the stage timeout still bounds
+        # it); slow-compile time is the usual cost, not measurement.
         env["BENCH_PLATFORM"] = "tpu"
+        env.setdefault("BENCH_BUDGET_S", "780")
     offset = os.path.getsize(logpath) if os.path.exists(logpath) else 0
     with open(logpath, "ab") as lf:
         lf.write(f"\n===== {time.ctime()} =====\n".encode())
@@ -140,10 +181,7 @@ def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> bool:
             )
             rc = proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
+            _kill_tree(proc.pid)
             proc.wait()
             log(f"stage {name}: TIMEOUT after {timeout_s:.0f}s (log {logpath})")
             return "timeout"
