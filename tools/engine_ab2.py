@@ -303,6 +303,30 @@ def main() -> None:
 
     timed("decided_muladd_pack", v7)
 
+    # v8: v6's program with use_pallas=True — the engine BENCH_r03's 4.0M
+    # headline actually ran. Every other variant is the XLA twin; if the
+    # residual lives in the Mosaic kernel (e.g. the SMEM-carry grid
+    # serializing at 2^20/block_rows steps), only this row shows it.
+    # TPU only: interpret-mode Pallas on CPU is minutes per step and the
+    # CPU smoke run's job is validating the harness, not timing Mosaic.
+    if device.platform == "tpu":
+
+        @functools.partial(jax.jit, donate_argnames=("state",))
+        def v8(state, ids):
+            state, _b, _a, d, order, health = _slab_step_sorted(
+                state,
+                expand(ids),
+                jnp.int32(now_lit),
+                jnp.float32(0.8),
+                n_probes=4,
+                use_pallas=True,
+                count_health=True,
+            )
+            over = _unsort(d.code, order) == 2
+            return state, jnp.packbits(over), health
+
+        timed("decided_pallas", v8)
+
     print(json.dumps(results))
 
 
