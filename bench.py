@@ -1146,6 +1146,29 @@ def _sharded_in_subprocess(n_mesh: int) -> dict:
         return {"error": "sharded subprocess timed out"}
 
 
+def _start_watchdog(
+    deadline_s: float, result: dict, emit, _exit=os._exit
+) -> threading.Thread:
+    """Daemon thread that force-lands the artifact if the process is still
+    alive deadline_s from now: marks the result, emits the last cumulative
+    JSON line, and exits 0. A hung device RPC blocks the main thread with
+    the GIL released, so this thread still runs — the only defense that
+    works when the hang is inside the C extension."""
+
+    def fire() -> None:
+        time.sleep(deadline_s)
+        result["watchdog"] = f"hard deadline {deadline_s:.0f}s hit; forced emit"
+        try:
+            emit()
+        except Exception:
+            pass
+        _exit(0)
+
+    t = threading.Thread(target=fire, daemon=True, name="bench-watchdog")
+    t.start()
+    return t
+
+
 def main() -> None:
     """Tier order and emission discipline (VERDICT r3 #1 — round 3's
     complete-artifact failure): engine first (the headline), then the
@@ -1217,9 +1240,22 @@ def main() -> None:
         "configs": configs,
     }
 
+    emit_lock = threading.Lock()
+
     def emit() -> None:
         result["elapsed_s"] = round(time.monotonic() - t_start, 1)
-        print(json.dumps(result), flush=True)
+        with emit_lock:
+            print(json.dumps(result), flush=True)
+
+    # First line BEFORE any device touch: if the tunnel wedges inside
+    # measure_link (it died mid-device_put on 2026-07-31 minutes after a
+    # successful probe), the artifact still parses.
+    emit()
+    # Hard-deadline watchdog: between-tier budget checks can't see a hang
+    # inside a C-level RPC (GIL released), but this thread can — it
+    # emits the cumulative state and exits 0 so the driver records
+    # everything measured instead of an rc=124 with no line (BENCH_r03).
+    _start_watchdog(budget + 120.0, result, emit)
 
     try:
         result["link"] = measure_link(device)

@@ -175,3 +175,70 @@ class TestArtifactDiscipline:
         assert configs["zipf_10M_engine"].get("sharded") == {
             "skipped": "budget"
         }
+
+
+class TestWatchdog:
+    def test_fires_emit_and_exit_after_deadline(self):
+        """A hung device RPC blocks the main thread in C with the GIL
+        released; nothing in main() can run, so the watchdog thread is
+        the only thing standing between the driver and an rc=124 artifact
+        with no JSON line (BENCH_r03). Pin: it marks the result, emits,
+        then calls the (injected) exit."""
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO)
+        import threading
+
+        fired = threading.Event()
+        emitted = []
+        exits = []
+
+        result = {"value": 41}
+
+        def emit():
+            emitted.append(dict(result))
+
+        def fake_exit(code):
+            exits.append(code)
+            fired.set()
+
+        bench._start_watchdog(0.05, result, emit, _exit=fake_exit)
+        assert fired.wait(5.0), "watchdog never fired"
+        assert exits == [0]
+        assert emitted and emitted[0]["value"] == 41
+        assert "watchdog" in emitted[0]
+
+    def test_exits_even_if_emit_raises(self):
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO)
+        import threading
+
+        fired = threading.Event()
+        exits = []
+
+        def bad_emit():
+            raise RuntimeError("stdout gone")
+
+        def fake_exit(code):
+            exits.append(code)
+            fired.set()
+
+        bench._start_watchdog(0.05, {}, bad_emit, _exit=fake_exit)
+        assert fired.wait(5.0)
+        assert exits == [0]
+
+    def test_daemon_thread_does_not_block_clean_exit(self):
+        """The real bench finishes well under the deadline; the watchdog
+        must be a daemon so the process can exit without joining it."""
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO)
+        t = bench._start_watchdog(3600.0, {}, lambda: None, _exit=lambda c: None)
+        assert t.daemon
