@@ -1229,12 +1229,23 @@ def main() -> None:
         return
 
     configs: dict = {}
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        rev = ""
     result = {
         "metric": "rate_limit_decisions_per_sec_zipf10M",
         "value": 0,
         "unit": "decisions/sec",
         "vs_baseline": 0.0,
         "platform": device.platform,
+        "git_rev": rev,
         "probe": probe_diag,
         "budget_s": budget,
         "configs": configs,
