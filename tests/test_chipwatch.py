@@ -67,8 +67,17 @@ def test_timeout_kills_process_group():
         "open('/tmp/chip_test_grandchild.pid', 'w').write(str(p.pid));"
         "time.sleep(60)"
     )
-    out = _run("timeout", [sys.executable, "-c", script], 3, "MARK")
+    # The timeout must comfortably cover interpreter startup on a loaded
+    # 1-core box (>3s observed) or the kill can fire before the grandchild
+    # pid file exists and the test flakes under concurrent load.
+    out = _run("timeout", [sys.executable, "-c", script], 10, "MARK")
     assert out == "timeout"
+    if not os.path.exists("/tmp/chip_test_grandchild.pid"):
+        # Extreme load can delay interpreter startup past the stage
+        # timeout; the grandchild never existed, so there is nothing to
+        # assert about tree-killing — skip rather than fail on a box
+        # artifact.
+        pytest.skip("stage timed out before the grandchild spawned")
     with open("/tmp/chip_test_grandchild.pid") as f:
         gpid = int(f.read())
     # The grandchild can land in a DIFFERENT process group (wrapper
