@@ -136,11 +136,15 @@ def _descendants(root: int) -> list:
 
 
 def _kill_tree(pid: int) -> None:
+    # Snapshot descendants BEFORE killing: the moment the direct child
+    # dies, its children reparent to init and the PPID walk can no
+    # longer find them.
+    victims = _descendants(pid)
     try:
         os.killpg(pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
         pass
-    for p in _descendants(pid):
+    for p in victims + _descendants(pid):
         try:
             os.kill(p, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
