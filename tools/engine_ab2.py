@@ -58,6 +58,12 @@ def main() -> None:
 
     print(f"[ab2] staging {R + 1} x {b * 4 >> 20}MB id arrays", file=sys.stderr, flush=True)
     staged = stage_zipf_ids(device, b, args.keys, R + 1)
+    # Placement check: CPU step time at batch 8192 extrapolates to
+    # ~346ms at 2^20 — almost exactly the on-chip ~318ms residual. If a
+    # buffer or computation silently lands on the host (axon relay
+    # quirk), every "device" measurement here is actually CPU speed;
+    # make placement explicit in the log.
+    print(f"[ab2] staged[0].devices = {staged[0].devices()}", file=sys.stderr, flush=True)
     print("[ab2] staging done", file=sys.stderr, flush=True)
 
     results: dict = {"platform": device.platform, "batch": b, "n_slots": n}
@@ -89,11 +95,13 @@ def main() -> None:
         # ~14MB/s tunnel) or array-out variants would read as free.
         fetched = jax.device_get(outs)
         t_e2e = time.perf_counter() - t0
+        leaf = jax.tree_util.tree_leaves(state)[0]
         results[label] = {
             "ms_device": round(t_dev / R * 1e3, 3),
             "ms_e2e": round(t_e2e / R * 1e3, 3),
+            "state_devices": str(leaf.devices()),
         }
-        print(f"[ab2:{label}] {results[label]}", file=sys.stderr)
+        print(f"[ab2:{label}] {results[label]}", file=sys.stderr, flush=True)
 
     # v0: the bisect's fastest inline program through THIS harness —
     # same probe/sort/permute/update/scatter, no floor_div, no decide,
