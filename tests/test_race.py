@@ -10,12 +10,18 @@ op streams holding the slab engine to the memory oracle.
 
 from __future__ import annotations
 
+import os
 import threading
 
 import pytest
 
 hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st  # noqa: E402
+
+# Default example counts keep the suite fast; an extended campaign sets
+# SLAB_FUZZ_EXAMPLES (e.g. 2000) to mine the same differential properties
+# much deeper on idle hardware.
+FUZZ_EXAMPLES = int(os.environ.get("SLAB_FUZZ_EXAMPLES", "0") or 0)
 
 from api_ratelimit_tpu.backends.memory import MemoryRateLimitCache
 from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
@@ -216,7 +222,7 @@ class TestSlabPropertyDifferential:
     the memory oracle on every decision code (the §4.4 differential oracle,
     fuzzed rather than hand-cased)."""
 
-    @settings(max_examples=20, deadline=None)
+    @settings(max_examples=FUZZ_EXAMPLES or 20, deadline=None)
     @given(
         ops=st.lists(
             st.tuples(
@@ -275,7 +281,7 @@ class TestBlockPathPropertyDifferential:
     to the per-item engine path under random op streams — duplicates in a
     batch, window rollovers, and counter continuation included."""
 
-    @settings(max_examples=15, deadline=None)
+    @settings(max_examples=FUZZ_EXAMPLES or 15, deadline=None)
     @given(
         ops=st.lists(
             st.tuples(
