@@ -64,6 +64,23 @@ STAGES = [
         1800,
         TPU_MARK,
     ),
+    # Batch ladder point: residual linear in batch => transfer-bound
+    # (tunnel bandwidth); constant => per-launch overhead. One extra
+    # geometry answers it with the same tool.
+    (
+        "ab2_batch64k",
+        [
+            sys.executable,
+            "-m",
+            "tools.engine_ab2",
+            "--batch",
+            str(1 << 16),
+            "--slots",
+            str(1 << 21),
+        ],
+        1800,
+        TPU_MARK,
+    ),
     ("ab2_full", [sys.executable, "-m", "tools.engine_ab2"], 2400, TPU_MARK),
     (
         "pallas_tests",
