@@ -58,6 +58,12 @@ tests_tpu:
 bench:
 	$(PY) bench.py
 
+# Unattended chip-window chain: waits for the (flaky) device tunnel and
+# runs linkprobe -> divtest -> attribution ladder -> TPU kernel tests ->
+# bench the moment a window opens (tools/chipwatch.py docstring).
+chipwatch:
+	setsid nohup $(PY) -m tools.chipwatch > /tmp/chipwatch.log 2>&1 < /dev/null &
+
 # Local dev server with the example config on the TPU backend.
 serve:
 	RUNTIME_ROOT=examples/ratelimit RUNTIME_SUBDIRECTORY= \
