@@ -621,3 +621,52 @@ class TestSlabHealthStats:
         tripled = dict(base, drops=270)
         assert _loss_ppm(tripled) == 280
         assert _loss_ppm({"steals": 0, "drops": 0, "decisions": 0}) == 0
+
+
+class TestReadbackWidths:
+    """The per-launch readback cap picks the narrowest EXACT width
+    (cap > limit + hits for every item, backends/tpu.py:_pack_with_cap).
+    The differential fuzz only uses tiny limits, so the u16 and u32
+    readback paths — and a mixed-width launch forcing promotion — are
+    pinned here with exact counts across the u8 saturation boundary."""
+
+    def test_u16_readback_exact_across_255(self):
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+
+        ts = FakeTimeSource(1000)
+        eng = SlabDeviceEngine(time_source=ts, n_slots=1 << 10, use_pallas=False)
+        try:
+            item = _Item(fp=12345, hits=100, limit=300, divider=3600, jitter=0)
+            afters = [eng.submit([item])[0] for _ in range(5)]
+            # u8 would saturate at 255; the cap math must pick u16 and
+            # return exact counts through and past the limit
+            assert afters == [100, 200, 300, 400, 500]
+        finally:
+            eng.close()
+
+    def test_u32_readback_exact_across_65535(self):
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+
+        ts = FakeTimeSource(2000)
+        eng = SlabDeviceEngine(time_source=ts, n_slots=1 << 10, use_pallas=False)
+        try:
+            item = _Item(fp=777, hits=40000, limit=70000, divider=3600, jitter=0)
+            afters = [eng.submit([item])[0] for _ in range(3)]
+            assert afters == [40000, 80000, 120000]
+        finally:
+            eng.close()
+
+    def test_mixed_width_launch_promotes_whole_launch(self):
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+
+        ts = FakeTimeSource(3000)
+        eng = SlabDeviceEngine(time_source=ts, n_slots=1 << 10, use_pallas=False)
+        try:
+            small = _Item(fp=1, hits=1, limit=5, divider=3600, jitter=0)
+            big = _Item(fp=2, hits=500, limit=70000, divider=3600, jitter=0)
+            for expect_small, expect_big in ((1, 500), (2, 1000), (3, 1500)):
+                got = eng.submit([small, big])
+                assert got == [expect_small, expect_big]
+        finally:
+            eng.close()
+
