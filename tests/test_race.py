@@ -227,13 +227,21 @@ class TestSlabPropertyDifferential:
         ops=st.lists(
             st.tuples(
                 st.integers(min_value=0, max_value=7),  # key id
-                st.integers(min_value=1, max_value=3),  # hits
+                # mostly small hits; occasionally large enough to push
+                # counters across the u8/u16 readback-width boundaries
+                st.one_of(
+                    st.integers(min_value=1, max_value=3),
+                    st.sampled_from([100, 40000]),
+                ),
                 st.integers(min_value=0, max_value=90),  # seconds to advance
             ),
             min_size=1,
             max_size=60,
         ),
-        limit_rpu=st.integers(min_value=1, max_value=6),
+        limit_rpu=st.one_of(
+            st.integers(min_value=1, max_value=6),
+            st.sampled_from([250, 300, 70000]),
+        ),
         unit=st.sampled_from([Unit.SECOND, Unit.MINUTE, Unit.HOUR]),
     )
     def test_engine_matches_oracle(self, ops, limit_rpu, unit):
@@ -286,14 +294,20 @@ class TestBlockPathPropertyDifferential:
         ops=st.lists(
             st.tuples(
                 st.integers(min_value=0, max_value=5),  # key id
-                st.integers(min_value=1, max_value=3),  # hits
+                st.one_of(  # small hits + width-boundary crossers
+                    st.integers(min_value=1, max_value=3),
+                    st.sampled_from([100, 40000]),
+                ),
                 st.integers(min_value=0, max_value=90),  # seconds to advance
                 st.integers(min_value=1, max_value=3),  # duplicates in batch
             ),
             min_size=1,
             max_size=30,
         ),
-        limit=st.integers(min_value=1, max_value=6),
+        limit=st.one_of(
+            st.integers(min_value=1, max_value=6),
+            st.sampled_from([250, 300, 70000]),
+        ),
         divider=st.sampled_from([1, 60, 3600]),
     )
     def test_block_matches_item_engine(self, ops, limit, divider):
