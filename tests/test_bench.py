@@ -172,6 +172,8 @@ class TestArtifactDiscipline:
         ):
             assert tier in configs, f"{tier} missing from artifact"
             assert configs[tier] == {"skipped": "budget"}, configs[tier]
+        # provenance: the artifact must say which commit produced it
+        assert last.get("git_rev"), "artifact missing git_rev"
         assert configs["zipf_10M_engine"].get("sharded") == {
             "skipped": "budget"
         }
