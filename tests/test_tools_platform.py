@@ -1,0 +1,47 @@
+"""The measurement tools must honor JAX_PLATFORMS.
+
+A site package force-sets jax_platforms=axon at import, overriding the
+operator's env var; a tool that skips respect_jax_platforms_env() then
+hangs trying to claim the (frequently down) device tunnel even when the
+operator pinned JAX_PLATFORMS=cpu. That cost real debugging time on
+2026-07-31 — pin it for every standalone measurement tool.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tool(mod, extra=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device, like an operator shell
+    return subprocess.run(
+        [sys.executable, "-m", mod, *extra],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "mod,extra",
+    [
+        ("tools.linkprobe", ()),
+        ("tools.divtest", ("--batch", "4096", "--repeats", "2")),
+    ],
+)
+def test_tool_runs_on_cpu_when_pinned(mod, extra):
+    proc = _run_tool(mod, extra)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout[-300:]
+    assert json.loads(lines[-1])["platform"] == "cpu"
