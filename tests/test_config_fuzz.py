@@ -1,0 +1,101 @@
+"""Generative robustness for the YAML config loader.
+
+Operators hand this loader arbitrary files through the hot-reload
+runtime directory; the reference pins ten specific malformed fixtures
+(test/config/config_test.go:240-345) but anything else must ALSO
+surface as a counted ConfigError that keeps the last good config
+(ratelimit.go:81-92) — never an unhandled AttributeError/TypeError/
+KeyError that would kill the reload thread. Hypothesis builds arbitrary
+YAML-serializable trees plus mutated nearly-valid configs and asserts
+the loader's only failure mode is ConfigError.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+import yaml  # noqa: E402
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from api_ratelimit_tpu.config.loader import ConfigFile, load_config  # noqa: E402
+from api_ratelimit_tpu.models.config import ConfigError  # noqa: E402
+from api_ratelimit_tpu.stats.sinks import NullSink  # noqa: E402
+from api_ratelimit_tpu.stats.store import Store  # noqa: E402
+
+
+def _scope():
+    return Store(NullSink()).scope("t")
+
+
+# Arbitrary YAML-serializable values: scalars, lists, string-keyed maps.
+_scalar = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(10**9), max_value=10**9),
+    st.text(max_size=12),
+)
+_yaml_tree = st.recursive(
+    _scalar,
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(st.text(max_size=10), children, max_size=4),
+    ),
+    max_leaves=20,
+)
+
+
+class TestLoaderNeverCrashes:
+    @settings(max_examples=150, deadline=None)
+    @given(tree=_yaml_tree)
+    def test_arbitrary_yaml_tree(self, tree):
+        text = yaml.safe_dump(tree)
+        try:
+            load_config([ConfigFile(name="config.fuzz", contents=text)], _scope())
+        except ConfigError:
+            pass  # the one allowed failure mode
+
+    @settings(max_examples=150, deadline=None)
+    @given(
+        domain=st.one_of(st.text(max_size=8), st.integers(), st.none()),
+        key=st.one_of(st.text(max_size=8), st.integers(), st.none()),
+        value=st.one_of(st.text(max_size=8), st.none()),
+        unit=st.one_of(
+            st.sampled_from(["second", "minute", "hour", "day"]),
+            st.text(max_size=8),
+            st.integers(),
+            st.none(),
+        ),
+        rpu=st.one_of(
+            st.integers(min_value=-5, max_value=10**10), st.text(max_size=5), st.none()
+        ),
+        extra_key=st.one_of(st.none(), st.sampled_from(["unknow_field", "rate_limits"])),
+    )
+    def test_mutated_nearly_valid_config(self, domain, key, value, unit, rpu, extra_key):
+        desc: dict = {"key": key}
+        if value is not None:
+            desc["value"] = value
+        if unit is not None or rpu is not None:
+            desc["rate_limit"] = {}
+            if unit is not None:
+                desc["rate_limit"]["unit"] = unit
+            if rpu is not None:
+                desc["rate_limit"]["requests_per_unit"] = rpu
+        if extra_key:
+            desc[extra_key] = 1
+        tree = {"domain": domain, "descriptors": [desc]}
+        text = yaml.safe_dump(tree)
+        try:
+            cfg = load_config([ConfigFile(name="config.fuzz", contents=text)], _scope())
+        except ConfigError:
+            return
+        # If it loaded, dump must work and the domain must be a string
+        assert isinstance(cfg.dump(), str)
+
+    @settings(max_examples=60, deadline=None)
+    @given(raw=st.text(max_size=60))
+    def test_raw_garbage_text(self, raw):
+        try:
+            load_config([ConfigFile(name="config.fuzz", contents=raw)], _scope())
+        except ConfigError:
+            pass
