@@ -198,7 +198,29 @@ class RateLimitConfig:
                 unit = unit_from_string(str(unit_name)) if unit_name is not None else None
                 if unit is None:
                     raise _error(file, f"invalid rate limit unit '{unit_name}'")
-                requests_per_unit = int(rate_limit.get("requests_per_unit") or 0)
+                # Strict like the reference's uint32 unmarshal
+                # (config_impl.go:25 requests_per_unit uint32): a
+                # non-integer, negative, or >u32 value is a config error —
+                # NOT a ValueError that would escape the reload handler's
+                # except ConfigError (found by tests/test_config_fuzz.py),
+                # and not a silent overflow of the device row the limit is
+                # packed into (uint32, ops/slab.py).
+                rpu_raw = rate_limit.get("requests_per_unit")
+                if rpu_raw is None:
+                    requests_per_unit = 0
+                elif (
+                    isinstance(rpu_raw, bool)
+                    or not isinstance(rpu_raw, int)
+                    or rpu_raw < 0
+                    or rpu_raw > 0xFFFFFFFF
+                ):
+                    raise _error(
+                        file,
+                        "error loading config file: requests_per_unit must be "
+                        f"an integer in [0, 2^32), got {rpu_raw!r}",
+                    )
+                else:
+                    requests_per_unit = rpu_raw
                 limit = self._new_rate_limit(
                     requests_per_unit,
                     unit,

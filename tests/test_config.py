@@ -238,6 +238,28 @@ descriptors:
         ),
         ("0.25: d\ndescriptors:\n", "key is not of type string"),
         ("domain: d\ndescriptors:\n  - a\n  - b\n", "list of type other than map"),
+        # requests_per_unit strictness (uint32 unmarshal parity,
+        # config_impl.go:25; found as a raw ValueError by the loader fuzz)
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requests_per_unit: ':'\n",
+            "requests_per_unit must be an integer",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requests_per_unit: -5\n",
+            "requests_per_unit must be an integer",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requests_per_unit: 4294967296\n",
+            "requests_per_unit must be an integer",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requests_per_unit: true\n",
+            "requests_per_unit must be an integer",
+        ),
+        (
+            "domain: d\ndescriptors:\n  - key: k\n    rate_limit:\n      unit: day\n      requests_per_unit: '5'\n",
+            "requests_per_unit must be an integer",
+        ),
     ],
 )
 def test_config_errors(contents, match):
