@@ -152,8 +152,15 @@ def add_json_handler(server: HttpServer, service: RateLimitService) -> None:
                 _handle_json(h)
 
     def _handle_json(h: _Handler) -> None:
-        length = int(h.headers.get("Content-Length", 0))
-        body = h.rfile.read(length) if length else b""
+        # A malformed Content-Length must be a 400, not a ValueError that
+        # drops the connection; a negative one must not turn into an
+        # unbounded rfile.read.
+        try:
+            length = int(h.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            h._write(400, b"Bad Request: invalid Content-Length\n")
+            return
+        body = h.rfile.read(length) if length > 0 else b""
         if not body:
             h._write(400, b"Bad Request: empty body\n")
             return

@@ -202,6 +202,27 @@ def test_http_json_status_mapping(running_server):
     assert post("{nonsense")[0] == 400
 
 
+def test_http_json_malformed_content_length(running_server):
+    """A garbage or negative Content-Length must map to 400, not a
+    ValueError that drops the connection (or an unbounded read)."""
+    import http.client
+
+    runner, _ = running_server
+    port = runner.server.http_port
+    for bad in ("abc", "-5"):
+        conn = http.client.HTTPConnection("localhost", port, timeout=5)
+        try:
+            conn.putrequest("POST", "/json")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", bad)
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 400, (bad, resp.status)
+            resp.read()
+        finally:
+            conn.close()
+
+
 def test_healthcheck_and_grpc_health(running_server):
     runner, _ = running_server
     status, text = http_get(runner.server.http_port, "/healthcheck")
