@@ -79,26 +79,44 @@ class _Reader:
         data, self._buf = self._buf[:n], self._buf[n:]
         return data
 
+    # Redis's own proto-max-bulk-len default; a corrupt length past this
+    # must be a protocol error, not a multi-GB buffering attempt.
+    _MAX_BULK = 512 << 20
+
+    @staticmethod
+    def _parse_len(rest: bytes) -> int:
+        """Corrupt wire bytes must surface as RedisError (counted at the
+        service boundary like any backend failure), never as a raw
+        ValueError escaping the pool."""
+        try:
+            return int(rest)
+        except ValueError:
+            raise RedisError(f"bad RESP length: {rest!r}") from None
+
     def read_reply(self):
         line = self._read_line()
         kind, rest = line[:1], line[1:]
         if kind == b"+":
-            return rest.decode()
+            return rest.decode(errors="replace")
         if kind == b"-":
-            return RedisReplyError(rest.decode())
+            return RedisReplyError(rest.decode(errors="replace"))
         if kind == b":":
-            return int(rest)
+            return self._parse_len(rest)
         if kind == b"$":
-            n = int(rest)
+            n = self._parse_len(rest)
             if n == -1:
                 return None
+            if n < 0 or n > self._MAX_BULK:
+                raise RedisError(f"bad RESP bulk length: {n}")
             data = self._read_exact(n)
             self._read_exact(2)  # trailing \r\n
             return data
         if kind == b"*":
-            n = int(rest)
+            n = self._parse_len(rest)
             if n == -1:
                 return None
+            if n < 0 or n > 1 << 20:
+                raise RedisError(f"bad RESP array length: {n}")
             return [self.read_reply() for _ in range(n)]
         raise RedisError(f"bad RESP reply type: {line!r}")
 

@@ -336,3 +336,61 @@ class TestRedisVsMemoryOracle:
                 want.code,
                 want.limit_remaining,
             ), f"divergence at step {step} key {key}"
+
+
+class TestRespParserRobustness:
+    """Corrupt server replies must surface as RedisError (the counted
+    backend-failure path), never raw ValueError/UnicodeDecodeError/
+    unbounded allocation — the parser is in-repo (no radix to lean on)."""
+
+    @staticmethod
+    def _reader_for(payload: bytes):
+        import socket as socket_mod
+
+        from api_ratelimit_tpu.backends.redis_driver import _Reader
+
+        a, b = socket_mod.socketpair()
+        a.sendall(payload)
+        a.close()  # EOF after payload: parser must not hang
+        b.settimeout(5)
+        return _Reader(b)
+
+    def test_corrupt_bulk_length(self):
+        from api_ratelimit_tpu.backends.redis_driver import RedisError
+
+        r = self._reader_for(b"$abc\r\n")
+        with pytest.raises(RedisError, match="bad RESP length"):
+            r.read_reply()
+
+    def test_corrupt_integer(self):
+        from api_ratelimit_tpu.backends.redis_driver import RedisError
+
+        r = self._reader_for(b":12x\r\n")
+        with pytest.raises(RedisError, match="bad RESP length"):
+            r.read_reply()
+
+    def test_huge_bulk_length_rejected(self):
+        from api_ratelimit_tpu.backends.redis_driver import RedisError
+
+        r = self._reader_for(b"$99999999999\r\n")
+        with pytest.raises(RedisError, match="bad RESP bulk length"):
+            r.read_reply()
+
+    def test_negative_array_length_rejected(self):
+        from api_ratelimit_tpu.backends.redis_driver import RedisError
+
+        r = self._reader_for(b"*-7\r\n")
+        with pytest.raises(RedisError, match="bad RESP array length"):
+            r.read_reply()
+
+    def test_invalid_utf8_status_survives(self):
+        r = self._reader_for(b"+\xff\xfe\r\n")
+        assert isinstance(r.read_reply(), str)
+
+    def test_valid_replies_still_parse(self):
+        r = self._reader_for(b"+OK\r\n:42\r\n$3\r\nfoo\r\n*2\r\n:1\r\n:2\r\n$-1\r\n")
+        assert r.read_reply() == "OK"
+        assert r.read_reply() == 42
+        assert r.read_reply() == b"foo"
+        assert r.read_reply() == [1, 2]
+        assert r.read_reply() is None
