@@ -112,12 +112,18 @@ class MemcacheClient:
         while i < len(lines):
             line = lines[i]
             if line.startswith(b"VALUE "):
+                # Corrupt/truncated VALUE lines (missing key, binary key,
+                # missing data line) are treated like the non-numeric
+                # foreign-value case below: absent => counted as 0, the
+                # backend's documented tolerance (memcached errors are
+                # logged and tolerated, cache_impl.go:96-99) — never an
+                # IndexError/UnicodeDecodeError out of the client.
                 parts = line.split()
-                key = parts[1].decode()
                 try:
+                    key = parts[1].decode()
                     values[key] = int(lines[i + 1])
-                except ValueError:
-                    pass  # non-numeric foreign value: treat as absent (=> 0)
+                except (IndexError, UnicodeDecodeError, ValueError):
+                    pass
                 i += 2
             else:
                 i += 1
@@ -131,8 +137,12 @@ class MemcacheClient:
         if line == b"NOT_FOUND":
             raise NotFoundError(key)
         if line.startswith(b"ERROR") or line.startswith(b"CLIENT_ERROR"):
-            raise MemcacheError(line.decode())
-        return int(line)
+            raise MemcacheError(line.decode(errors="replace"))
+        try:
+            return int(line)
+        except ValueError:
+            # any other reply shape is a protocol error, not a ValueError
+            raise MemcacheError(f"bad incr reply: {line!r}") from None
 
     def add(self, key: str, value: int, expiry_seconds: int) -> None:
         _check_key(key)

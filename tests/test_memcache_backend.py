@@ -139,3 +139,46 @@ class TestMemcacheCache:
         adds = [c for c in fake_memcache.commands_seen if c.startswith(b"add")]
         assert len(adds) == 1
         assert adds[0].split()[3] == b"60"  # exptime = MINUTE divider
+
+
+class TestWireRobustness:
+    """Corrupt server replies must surface as MemcacheError or be
+    tolerated per the backend's documented fail-open behavior — never as
+    IndexError/UnicodeDecodeError/ValueError out of the in-repo client
+    (the analog of the RESP-parser hardening on the redis side)."""
+
+    @staticmethod
+    def _client_with_reply(reply: bytes):
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def serve():
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(reply)
+            conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        host, port = srv.getsockname()
+        return MemcacheClient(f"{host}:{port}")
+
+    def test_get_multi_truncated_value_line(self):
+        c = self._client_with_reply(b"VALUE\r\nEND\r\n")
+        assert c.get_multi(["a"]) == {}
+
+    def test_get_multi_binary_key(self):
+        c = self._client_with_reply(b"VALUE \xff\xfe 0 1\r\n7\r\nEND\r\n")
+        assert c.get_multi(["a"]) == {}
+
+    def test_get_multi_value_without_data_line(self):
+        c = self._client_with_reply(b"VALUE a 0 1\r\nEND\r\n")
+        assert c.get_multi(["a"]) == {}
+
+    def test_incr_garbage_reply(self):
+        c = self._client_with_reply(b"WAT\r\n")
+        with pytest.raises(MemcacheError, match="bad incr reply"):
+            c.increment("a", 1)
