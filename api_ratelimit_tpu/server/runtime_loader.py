@@ -195,7 +195,12 @@ def scan_directory(
             rel = os.path.relpath(path, root)
             try:
                 st = os.stat(path)
-                with open(path, "r", encoding="utf-8") as f:
+                # errors="replace", not strict: a stray binary file must
+                # reach the YAML loader as (invalid) text so the reload
+                # counts config_load_error and keeps the last good config
+                # — a UnicodeDecodeError here would escape the reload
+                # handler and kill hot reload for good.
+                with open(path, "r", encoding="utf-8", errors="replace") as f:
                     entries[_key_for(rel)] = f.read()
                 sig.append((rel, st.st_mtime_ns, st.st_size))
             except OSError:

@@ -110,6 +110,27 @@ class TestRuntimeLoader:
         entries, _sig = scan_directory(str(tmp_path))
         assert entries == {"config.basic": "x"}
 
+    def test_binary_file_survives_scan_and_fails_load_cleanly(self, tmp_path):
+        """A stray binary file in the config dir must not raise
+        UnicodeDecodeError out of the scan (that would kill the reload
+        thread); it must reach the YAML loader as invalid text so the
+        reload counts config_load_error and keeps the last good config."""
+        from api_ratelimit_tpu.config.loader import ConfigFile, load_config
+        from api_ratelimit_tpu.models.config import ConfigError
+        from api_ratelimit_tpu.stats.sinks import NullSink
+        from api_ratelimit_tpu.stats.store import Store
+
+        config = tmp_path / "config"
+        config.mkdir(parents=True)
+        (config / "junk.yaml").write_bytes(b"\xff\xfe\x00bad: [\x9c")
+        entries, _sig = scan_directory(str(tmp_path))
+        assert "config.junk" in entries  # scanned, not skipped or crashed
+        with pytest.raises(ConfigError):
+            load_config(
+                [ConfigFile(name="config.junk", contents=entries["config.junk"])],
+                Store(NullSink()).scope("t"),
+            )
+
     def test_refresh_detects_changes(self, tmp_path):
         self._mkconfig(tmp_path, "a.yaml", "one")
         loader = DirectoryRuntimeLoader(str(tmp_path))
