@@ -314,10 +314,18 @@ class SlabSidecarServer:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown BEFORE close: a thread blocked in accept() does not
+        # reliably wake on close() alone (Linux), which leaves the kernel
+        # socket held and a restart on the same port failing EADDRINUSE.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        self._accept_thread.join(5.0)
         if self._scheme == "unix":
             try:
                 os.unlink(self._path)
