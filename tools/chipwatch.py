@@ -109,7 +109,10 @@ def save_state(state: dict) -> None:
         json.dump(state, f)
 
 
-def probe(timeout_s: float = 150.0) -> bool:
+def probe(timeout_s: float = 90.0) -> bool:
+    """90s covers the observed healthy-tunnel init (~30-60s) while keeping
+    worst-case window-detection latency ~2 minutes — window #1 lasted only
+    ~25 minutes, so detection latency is chain time stolen."""
     try:
         out = subprocess.run(
             PROBE_CMD,
@@ -281,7 +284,7 @@ def main() -> None:
             return
         attempt += 1
         if not probe():
-            time.sleep(90)
+            time.sleep(45)
             continue
         log(f"window open (attempt {attempt}); {len(remaining)} stages remain")
         for name, argv, timeout_s, marker in remaining:
