@@ -152,6 +152,18 @@ class RateLimitService:
             if span is not None:
                 span.set_error(e)
             raise
+        except Exception as e:
+            # The reference's recovery catches ANY panic, counts it as
+            # serviceError, and returns a typed error rather than letting
+            # it escape uncounted (ratelimit.go:260-290). Without this, an
+            # unexpected bug-class exception bypasses the error counters
+            # the dashboards alert on.
+            self._stats.service_error.add(1)
+            span = active_span()
+            if span is not None:
+                span.set_error(e)
+            logger.exception("unexpected error in should_rate_limit")
+            raise ServiceError(f"unexpected error: {e}") from e
 
     def _worker(
         self, request: RateLimitRequest

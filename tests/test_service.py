@@ -168,6 +168,19 @@ class TestServiceBasics:
         store.flush()
         assert sink.counters["ratelimit.call.should_rate_limit.redis_error"] == 1
 
+    def test_unexpected_exception_counted_and_typed(self):
+        """The reference's recovery catches ANY panic, counts serviceError,
+        and surfaces a typed error (ratelimit.go:260-290) — a bug-class
+        exception must not bypass the alerting counters."""
+        svc, _, cache, store, sink = make_service()
+        cache.raise_error = RuntimeError("bug class")
+        with pytest.raises(ServiceError, match="unexpected error"):
+            svc.should_rate_limit(req(("k", "v")))
+        store.flush()
+        assert (
+            sink.counters["ratelimit.call.should_rate_limit.service_error"] == 1
+        )
+
 
 class TestConfigReload:
     def test_reload_picks_up_new_domain(self):
