@@ -56,11 +56,13 @@ def floor_div_exact_i32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
     XLA and Mosaic both expand a VECTOR integer divide into a ~32-pass
     shift-subtract loop; on v5e that measured ~100ms per division site at
-    batch 2^20 (tools/bisect_step2.py vs tools/engine_ab.py: the slab step
-    is ~0.15ms without its divisions and ~300ms with them) — and swapping
-    idiv for f32 division moved nothing, so the division op class itself is
-    avoided entirely: quotients come from a Newton reciprocal (_recip_f32,
-    mul/sub/bitcast only). The seed quotient can be off by several hundred
+    batch 2^20 (tools/bisect_step2.py). Standalone f32 division itself is
+    NOT slow on-chip (tools/divtest 2026-07-31: add 0.026ms / f32-div
+    0.029ms / reciprocal 0.027ms at 2^20), so this helper exists to avoid
+    the INTEGER-divide lowering specifically; quotients come from a Newton
+    reciprocal (_recip_f32, mul/sub/bitcast only). The ~300ms real-step
+    residual that once implicated division has a separate, still-open
+    attribution (PERF.md round-5 chip window #1). The seed quotient can be off by several hundred
     near a = 2^31 (float32 carries 24 bits); the refinement multiplies the
     SMALL residual (exactly representable) by the same reciprocal, landing
     within +-1, and the integer fixup finishes. All three steps are
