@@ -979,6 +979,9 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
                 "balanced_ratio_flops": round(fB / f1, 4),
                 "balanced_ratio_bytes": round(bB / b1, 4),
                 "ideal": round(1.0 / n_devices, 4),
+                # why the actual bucket is what it is: the Zipf stream's
+                # hottest shard held this fraction of the batch
+                "hot_shard_frac": round(shard_max / batch, 4),
             }
     except Exception as e:  # cost analysis is diagnostic, never fatal
         result["per_device_cost"] = {"error": str(e)[-200:]}
