@@ -195,6 +195,18 @@ def sharded_slab_step_after(
 # Bucket sizes round up to powers of two so XLA compiles a handful of
 # shapes; a pathologically skewed batch just gets a bigger bucket (worst
 # case b: one shard does all the work, which is what the data demanded).
+#
+# Scaling evidence + the skew caveat (measured, bench `per_device_cost`
+# field and tests/test_sharded_slab.py::TestPerDeviceCostScaling): with
+# balanced routing the per-chip compiled cost is ~1/N of the
+# single-device program (0.1241 flops / 0.1303 bytes at N=8, ideal
+# 0.125). Under single-key skew the hot shard sets the bucket for ALL
+# shards (SPMD: one program shape), so per-chip compute does not shrink
+# — the bench's Zipf(1.1) stream puts ~54% of a batch on one shard.
+# That is the hot-shard property the reference inherits from Redis
+# Cluster (one key lives on one node). A mitigation (salting hot keys
+# across shards) would need psum'd partial counts and trades away the
+# single-owner counter model; it is deliberately not attempted.
 
 
 def _sharded_body_after_compact(
