@@ -944,18 +944,38 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
             ),
             donate_argnums=(0,),
         )
-        s_state = jax.device_put(make_slab(engine.n_slots_global), dev0)
-        c1 = single_jit.lower(s_state, jnp.asarray(blocks[-1])).compile().cost_analysis()
+        # AOT lowering needs only shapes — materializing a second
+        # n_slots_global slab here would burn ~256MB of HBM per 8 chips
+        # for a program that never executes.
+        from api_ratelimit_tpu.ops.slab import ROW_WIDTH, SlabState
+
+        s_state = SlabState(
+            table=jax.ShapeDtypeStruct(
+                (engine.n_slots_global, ROW_WIDTH), jnp.uint32
+            )
+        )
+        c1 = (
+            single_jit.lower(
+                s_state,
+                jax.ShapeDtypeStruct(blocks[-1].shape, jnp.uint32),
+            )
+            .compile()
+            .cost_analysis()
+        )
         c1 = c1[0] if isinstance(c1, list) else c1
         step_fn = sharded_slab_step_after_compact(
             mesh, 0xFFFF, n_probes=4, use_pallas=engine_use_pallas(on_tpu)
         )
+        sharded_state_shapes = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            engine._state,
+        )
 
         def compact_cost(bkt):
-            cb = jax.device_put(
-                np.zeros((n_dev, 7, bkt), dtype=np.uint32), engine._blocks_sharding
+            cb = jax.ShapeDtypeStruct(
+                (n_dev, 7, bkt), jnp.uint32, sharding=engine._blocks_sharding
             )
-            c = step_fn.lower(engine._state, cb).compile().cost_analysis()
+            c = step_fn.lower(sharded_state_shapes, cb).compile().cost_analysis()
             c = c[0] if isinstance(c, list) else c
             return float(c.get("flops", 0)), float(c.get("bytes accessed", 0))
 
@@ -1223,10 +1243,34 @@ def _start_watchdog(
     def fire() -> None:
         time.sleep(deadline_s)
         result["watchdog"] = f"hard deadline {deadline_s:.0f}s hit; forced emit"
-        try:
-            emit()
-        except Exception:
-            pass
+        # The main thread may still be mutating `result` (a tier running
+        # past the deadline inserts between budget checks), which can
+        # break json serialization mid-iteration — retry on a snapshot,
+        # and if all else fails land a minimal line rather than nothing.
+        for _ in range(3):
+            try:
+                emit()
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            try:
+                import copy
+
+                print(json.dumps(copy.deepcopy(result)), flush=True)
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {
+                            "metric": "rate_limit_decisions_per_sec_zipf10M",
+                            "value": 0,
+                            "unit": "decisions/sec",
+                            "vs_baseline": 0.0,
+                            "watchdog": f"emit failed: {e}",
+                        }
+                    ),
+                    flush=True,
+                )
         _exit(0)
 
     t = threading.Thread(target=fire, daemon=True, name="bench-watchdog")

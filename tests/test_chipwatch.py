@@ -22,7 +22,10 @@ from tools import chipwatch
 
 @pytest.fixture(autouse=True)
 def _tmp_stage_logs(tmp_path, monkeypatch):
-    # Redirect the per-stage logs away from the real /tmp evidence files.
+    # Redirect per-stage logs away from the real /tmp evidence files: any
+    # call through chipwatch.run_stage in a test gets a test_-prefixed
+    # stage name (cleaned up below), so even a future test calling
+    # run_stage or main() directly cannot clobber /tmp/chip_<stage>.log.
     monkeypatch.setattr(
         chipwatch, "STATE_PATH", str(tmp_path / "state.json"), raising=True
     )
@@ -30,8 +33,11 @@ def _tmp_stage_logs(tmp_path, monkeypatch):
     orig = chipwatch.run_stage
 
     def patched(name, argv, timeout_s, marker):
-        return orig(f"test_{name}", argv, timeout_s, marker)
+        if not name.startswith("test_"):
+            name = f"test_{name}"
+        return orig(name, argv, timeout_s, marker)
 
+    monkeypatch.setattr(chipwatch, "run_stage", patched)
     yield
     for f in os.listdir("/tmp"):
         if f.startswith("chip_test_"):

@@ -88,7 +88,10 @@ STAGES = [
         1800,
         " passed",
     ),
-    ("bench", [sys.executable, "bench.py"], 900, TPU_MARK),
+    # Timeout must exceed bench's own forced-emit horizon (BENCH_BUDGET_S
+    # 780 + 120s watchdog + jax-init slack) or we SIGKILL the tree before
+    # the watchdog can land the artifact line.
+    ("bench", [sys.executable, "bench.py"], 1200, TPU_MARK),
 ]
 
 
