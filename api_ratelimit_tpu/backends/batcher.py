@@ -33,6 +33,24 @@ from typing import Any, Callable
 _CLOSE = object()
 
 
+class BatcherStats:
+    """StatGenerator exporting the batcher's instantaneous backlog at every
+    stats flush / metrics scrape:
+
+        <scope>.queue_depth   items enqueued awaiting a dispatcher take
+        <scope>.inflight      batches launched but not yet collected
+    """
+
+    def __init__(self, batcher: "MicroBatcher", scope):
+        self._batcher = batcher
+        self._queue_depth = scope.gauge("queue_depth")
+        self._inflight = scope.gauge("inflight")
+
+    def generate_stats(self) -> None:
+        self._queue_depth.set(self._batcher.queue_depth)
+        self._inflight.set(self._batcher.inflight)
+
+
 class MicroBatcher:
     def __init__(
         self,
@@ -43,6 +61,7 @@ class MicroBatcher:
         execute_collect: Callable[[Any], list] | None = None,
         max_inflight: int = 2,
         block_mode: bool = False,
+        scope=None,
     ):
         """block_mode: each submit() argument is ONE pre-packed uint32[6, n]
         column block (the sidecar wire format) instead of a sequence of
@@ -51,7 +70,13 @@ class MicroBatcher:
         future is the whole block, counts are in ITEMS (block columns), and
         results may be one numpy array (sliced per future like a list).
         This keeps the sidecar's aggregation path free of per-item Python
-        objects end to end."""
+        objects end to end.
+
+        scope: optional stats Scope (stats/store.py). When set, the batcher
+        records its per-stage telemetry — queue_wait_ms (submit enqueue ->
+        batch take), batch_size (items per launch, pow-2 buckets) — and
+        registers a StatGenerator exporting queue_depth / inflight gauges
+        at every flush/scrape."""
         self._execute = execute
         self._window = float(window_seconds)
         self._max_batch = int(max_batch)
@@ -70,6 +95,15 @@ class MicroBatcher:
         self._thread: threading.Thread | None = None
         self._collector: threading.Thread | None = None
         self._collect_q: queue.Queue | None = None
+        self._h_wait = self._h_batch = None
+        if scope is not None:
+            from ..stats.store import DEFAULT_SIZE_BUCKETS
+
+            self._h_wait = scope.histogram("queue_wait_ms")
+            self._h_batch = scope.histogram(
+                "batch_size", boundaries=DEFAULT_SIZE_BUCKETS
+            )
+            scope.add_stat_generator(BatcherStats(self, scope))
         pipelined = execute_launch is not None and execute_collect is not None
         self._execute_launch = execute_launch
         self._execute_collect = execute_collect
@@ -85,6 +119,16 @@ class MicroBatcher:
             )
             self._thread.start()
 
+    @property
+    def queue_depth(self) -> int:
+        """Items awaiting a dispatcher take (racy read; stats only)."""
+        return self._pending
+
+    @property
+    def inflight(self) -> int:
+        """Batches launched but not yet finished (racy read; stats only)."""
+        return self._inflight
+
     # -- client side --
 
     def submit(self, items) -> list:
@@ -95,10 +139,17 @@ class MicroBatcher:
         if count == 0:
             return []
         if self._window <= 0:
-            # direct mode: caller thread executes (single-flight via lock)
+            # direct mode: caller thread executes (single-flight via lock).
+            # queue_wait here is the time spent blocked on the dispatch
+            # lock behind another caller — the direct-mode analog of queue
+            # time, and the signal that a window would start paying off.
+            t_enq = time.monotonic() if self._h_wait is not None else 0.0
             with self._direct_lock:
                 if self._closed:
                     raise RuntimeError("batcher is closed")
+                if self._h_wait is not None:
+                    self._h_wait.record((time.monotonic() - t_enq) * 1e3)
+                    self._h_batch.record(count)
                 if self._block_mode:
                     return self._execute([items])
                 return self._execute(list(items))
@@ -172,11 +223,16 @@ class MicroBatcher:
                 # block per future, so taking k futures takes k blocks.
                 futures = []
                 taken = 0
-                for future, _start, count, _ts in self._futures:
+                t_take = time.monotonic() if self._h_wait is not None else 0.0
+                for future, _start, count, ts in self._futures:
                     if futures and taken + count > self._max_batch:
                         break
+                    if self._h_wait is not None:
+                        self._h_wait.record((t_take - ts) * 1e3)
                     futures.append((future, taken, count))
                     taken += count
+                if self._h_batch is not None:
+                    self._h_batch.record(taken)
                 n_units = len(futures) if self._block_mode else taken
                 items = self._items[:n_units]
                 self._items = self._items[n_units:]

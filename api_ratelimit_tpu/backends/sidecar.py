@@ -62,6 +62,7 @@ import socket
 import ssl
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -349,13 +350,24 @@ class SidecarEngineClient:
         tls_cert: str = "",
         tls_key: str = "",
         tls_server_name: str = "",
+        scope=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
         tls_ca: CA bundle the server cert must chain to (defaults to the
         system store when empty). tls_cert/tls_key: client certificate for
         mutual TLS. tls_server_name: SNI/hostname override when the cert CN
         doesn't match the dialed host (the reference's equivalent knob:
-        tls dial options, driver_impl.go:60-78)."""
+        tls dial options, driver_impl.go:60-78).
+
+        scope: optional stats Scope; records <scope>.sidecar.rpc_ms — the
+        frontend-side SUBMIT round trip (socket + the sidecar's own
+        batcher/device stages), the frontend's analog of the in-process
+        launch+readback histograms."""
+        self._h_rpc = (
+            scope.scope("sidecar").histogram("rpc_ms")
+            if scope is not None
+            else None
+        )
         self._path = address
         self._scheme, self._target = parse_sidecar_address(address)
         self._timeout = timeout
@@ -430,6 +442,7 @@ class SidecarEngineClient:
     def submit(self, items) -> list[int]:
         if not items:
             return []
+        t0 = time.perf_counter() if self._h_rpc is not None else 0.0
         conn = self._acquire()
         try:
             conn.sendall(
@@ -444,6 +457,8 @@ class SidecarEngineClient:
             (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
             out = np.frombuffer(_recv_exact(conn, 4 * n), dtype=np.uint32)
             self._release(conn)
+            if self._h_rpc is not None:
+                self._h_rpc.record((time.perf_counter() - t0) * 1e3)
             return out.tolist()
         except CacheError:
             raise
@@ -462,7 +477,7 @@ class SidecarEngineClient:
             self._pool.clear()
 
 
-def new_sidecar_cache_from_settings(settings, base_limiter):
+def new_sidecar_cache_from_settings(settings, base_limiter, stats_scope=None):
     """BACKEND_TYPE=tpu-sidecar factory: a TpuRateLimitCache whose device
     driver is the remote sidecar (runner.py backend switch)."""
     from .tpu import TpuRateLimitCache
@@ -475,5 +490,6 @@ def new_sidecar_cache_from_settings(settings, base_limiter):
             tls_cert=settings.sidecar_tls_cert,
             tls_key=settings.sidecar_tls_key,
             tls_server_name=settings.sidecar_tls_server_name,
+            scope=stats_scope,
         ),
     )

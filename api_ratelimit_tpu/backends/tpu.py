@@ -31,6 +31,7 @@ import collections
 import dataclasses
 import logging
 import threading
+import time
 from typing import Sequence
 
 import jax
@@ -95,7 +96,14 @@ class SlabDeviceEngine:
         use_pallas: bool | None = None,
         mesh=None,
         block_mode: bool = False,
+        scope=None,
     ):
+        """scope: optional stats Scope rooted at the service prefix (e.g.
+        the runner's `ratelimit` scope). When set, the engine records the
+        per-stage device histograms — <scope>.device.{pack_ms,launch_ms,
+        readback_ms} — and hands <scope>.batcher to the micro-batcher for
+        queue-wait/batch-size/depth telemetry. None (the default) keeps
+        the hot path entirely free of stats work."""
         self._time_source = time_source
         self._near_limit_ratio = float(near_limit_ratio)
         if device is None:
@@ -155,6 +163,14 @@ class SlabDeviceEngine:
         # engine's compacted path). block_mode (the sidecar server) swaps
         # the item-list executors for the wire-block ones; the batcher
         # machinery is shared.
+        self._h_pack = self._h_launch = self._h_readback = None
+        batcher_scope = None
+        if scope is not None:
+            device_scope = scope.scope("device")
+            self._h_pack = device_scope.histogram("pack_ms")
+            self._h_launch = device_scope.histogram("launch_ms")
+            self._h_readback = device_scope.histogram("readback_ms")
+            batcher_scope = scope.scope("batcher")
         self._block_batcher = bool(block_mode)
         if self._block_batcher:
             self._batcher = MicroBatcher(
@@ -164,6 +180,7 @@ class SlabDeviceEngine:
                 execute_launch=self._execute_blocks_launch,
                 execute_collect=self._execute_blocks_collect,
                 block_mode=True,
+                scope=batcher_scope,
             )
         else:
             self._batcher = MicroBatcher(
@@ -172,6 +189,7 @@ class SlabDeviceEngine:
                 max_batch=max_batch,
                 execute_launch=self._execute_launch,
                 execute_collect=self._execute_collect,
+                scope=batcher_scope,
             )
 
     def _drain_health_locked(self) -> None:
@@ -277,14 +295,22 @@ class SlabDeviceEngine:
     def _launch_async(self, items: list[_Item]):
         """Async launch: pack, dispatch, return a token without waiting for
         execution."""
-        return self._dispatch_packed(*self._pack_with_cap(items))
+        if self._h_pack is None:
+            return self._dispatch_packed(*self._pack_with_cap(items))
+        t0 = time.perf_counter()
+        packed = self._pack_with_cap(items)
+        self._h_pack.record((time.perf_counter() - t0) * 1e3)
+        return self._dispatch_packed(*packed)
 
     def _dispatch_packed(self, packed: np.ndarray, n: int, cap: int):
         """Dispatch one packed uint32[7, bucket] launch; returns the token
         the collect phase drains. Mesh mode owner-routes on the host and
         dispatches the compacted per-shard launch (each chip probes only
         the ~n/n_dev keys it owns — nothing replicated or psum'd on the
-        result path)."""
+        result path). launch_ms times THIS host-side phase (async device
+        dispatch, never the device execution — readback_ms carries the
+        blocking wait)."""
+        t_launch = time.perf_counter() if self._h_launch is not None else 0.0
         self.launch_sizes.append(n)
         if self._engine is not None:
             token = self._engine.launch_after_compact(packed, cap)
@@ -292,6 +318,8 @@ class SlabDeviceEngine:
             # a failed launch must not inflate the loss_ppm denominator
             with self._state_lock:
                 self._decisions_total += n
+            if self._h_launch is not None:
+                self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
             return token, n
         dtype = (
             jnp.uint8
@@ -334,16 +362,26 @@ class SlabDeviceEngine:
             self._decisions_total += n
             if len(self._pending_health) > 4096:
                 self._drain_health_locked()
+        if self._h_launch is not None:
+            self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
         return after_dev, n
 
     def _collect(self, token) -> list[int]:
         return self._collect_array(token).tolist()
 
     def _collect_array(self, token) -> np.ndarray:
+        """Blocking readback of one launch token. readback_ms covers the
+        wait for device completion plus the D2H drain — the stage a slow
+        link inflates (the co-located p99 estimate subtracts it)."""
+        t0 = time.perf_counter() if self._h_readback is not None else 0.0
         payload, n = token
         if self._engine is not None:
-            return self._engine.collect_after_compact(payload)[:n]
-        return np.asarray(payload)[:n]
+            out = self._engine.collect_after_compact(payload)[:n]
+        else:
+            out = np.asarray(payload)[:n]
+        if self._h_readback is not None:
+            self._h_readback.record((time.perf_counter() - t0) * 1e3)
+        return out
 
     # -- block-native path (sidecar wire blocks; no per-item objects) --
 
@@ -407,9 +445,17 @@ class SlabDeviceEngine:
 
     def _execute_blocks_launch(self, blocks: list[np.ndarray]):
         try:
+            if self._h_pack is None:
+                return [
+                    self._dispatch_packed(packed, n, cap)
+                    for packed, n, cap in self._iter_block_chunks(blocks)
+                ]
+            t0 = time.perf_counter()
+            chunks = list(self._iter_block_chunks(blocks))
+            self._h_pack.record((time.perf_counter() - t0) * 1e3)
             return [
                 self._dispatch_packed(packed, n, cap)
-                for packed, n, cap in self._iter_block_chunks(blocks)
+                for packed, n, cap in chunks
             ]
         except Exception as e:
             raise CacheError(f"tpu backend failure: {e}") from e
@@ -504,10 +550,15 @@ class TpuRateLimitCache:
         use_pallas: bool | None = None,
         mesh=None,
         engine=None,
+        stats_scope=None,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
-        passes a socket client instead (backends/sidecar.py)."""
+        passes a socket client instead (backends/sidecar.py).
+
+        stats_scope: optional stats Scope (the runner's `ratelimit` root);
+        forwarded to the in-process engine for device/batcher histograms.
+        A caller-provided engine owns its own telemetry wiring."""
         self._base = base_limiter
         # Prewarm the native host codec so the first request never pays the
         # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
@@ -525,6 +576,7 @@ class TpuRateLimitCache:
                 device=device,
                 use_pallas=use_pallas,
                 mesh=mesh,
+                scope=stats_scope,
             )
         self._engine_core = engine
         # (domain, entries, divider) -> fingerprint. Rate-limit traffic is

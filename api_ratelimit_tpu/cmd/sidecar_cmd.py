@@ -9,6 +9,14 @@ through this process's slab (backends/sidecar.py).
 Honors the same TPU_* env knobs as the in-process backend: TPU_SLAB_SLOTS,
 TPU_BATCH_WINDOW (recommended: 100-500us — the cross-frontend coalescing
 window), TPU_BATCH_LIMIT, TPU_MESH_DEVICES, TPU_USE_PALLAS.
+
+Telemetry: the sidecar owns the device, so the device-stage histograms
+(batcher queue wait / batch size, pack/launch/readback) and the slab
+health gauges live HERE, not in the frontends. It runs its own stats
+store (statsd push per USE_STATSD) and its own debug listener with
+GET /metrics + /stats on DEBUG_PORT — give the sidecar a distinct
+DEBUG_PORT from any same-host frontend, or SO_REUSEPORT will split
+scrapes between the two processes.
 """
 
 from __future__ import annotations
@@ -18,9 +26,12 @@ import signal
 import threading
 
 from ..backends.sidecar import SlabSidecarServer
-from ..backends.tpu import SlabDeviceEngine
+from ..backends.tpu import SlabDeviceEngine, SlabHealthStats
 from ..runner import setup_logging
+from ..server.http_server import new_debug_server
 from ..settings import new_settings
+from ..stats.sinks import NullSink, StatsdSink
+from ..stats.store import Store
 from ..utils.timeutil import RealTimeSource
 
 logger = logging.getLogger("ratelimit.sidecar.main")
@@ -29,6 +40,14 @@ logger = logging.getLogger("ratelimit.sidecar.main")
 def main() -> None:
     settings = new_settings()
     setup_logging(settings)
+
+    sink = (
+        StatsdSink(settings.statsd_host, settings.statsd_port)
+        if settings.use_statsd
+        else NullSink()
+    )
+    store = Store(sink, latency_buckets=settings.latency_buckets())
+    scope = store.scope("ratelimit")
 
     from ..utils.jaxsetup import respect_jax_platforms_env
 
@@ -56,7 +75,17 @@ def main() -> None:
         # objects (decode + repack cost ~2.3us/item otherwise — an ~0.4M
         # items/s server ceiling at batch 8k, measured in PERF.md)
         block_mode=True,
+        scope=scope,
     )
+    store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
+    debug = new_debug_server(
+        "",
+        settings.debug_port,
+        store,
+        enable_metrics=settings.debug_metrics_enabled,
+    )
+    debug.serve_background()
+    store.start_flushing()
     server = SlabSidecarServer(
         settings.sidecar_socket,
         engine,
@@ -76,6 +105,8 @@ def main() -> None:
         signal.signal(sig, on_signal)
     stop.wait()
     server.close()
+    store.stop_flushing()
+    debug.shutdown()
 
 
 if __name__ == "__main__":

@@ -84,8 +84,12 @@ def setup_logging(settings: Settings) -> None:
 def create_limiter(
     settings: Settings, base: BaseRateLimiter, stats_store: Store
 ) -> RateLimitCache:
-    """BackendType switch (runner.go:43-64)."""
+    """BackendType switch (runner.go:43-64). The TPU backends get the
+    `ratelimit` scope so the per-stage pipeline histograms
+    (batcher.queue_wait_ms, device.{pack,launch,readback}_ms,
+    sidecar.rpc_ms) land in the same store /metrics scrapes."""
     backend = settings.backend_type
+    scope = stats_store.scope("ratelimit")
     if backend == "tpu":
         from .backends.tpu import TpuRateLimitCache
 
@@ -104,11 +108,12 @@ def create_limiter(
             max_batch=settings.tpu_batch_limit,
             use_pallas=None if settings.tpu_use_pallas else False,
             mesh=mesh,
+            stats_scope=scope,
         )
     if backend == "tpu-sidecar":
         from .backends.sidecar import new_sidecar_cache_from_settings
 
-        return new_sidecar_cache_from_settings(settings, base)
+        return new_sidecar_cache_from_settings(settings, base, stats_scope=scope)
     if backend == "memory":
         return MemoryRateLimitCache(base)
     if backend == "redis":
@@ -131,7 +136,9 @@ class Runner:
                 if self.settings.use_statsd
                 else NullSink()
             )
-        self.stats_store = Store(sink)
+        self.stats_store = Store(
+            sink, latency_buckets=self.settings.latency_buckets()
+        )
         self.scope = self.stats_store.scope("ratelimit")
         self.server: Server | None = None
         self.service: RateLimitService | None = None

@@ -12,6 +12,7 @@ with the reference's three conversion/dispatch error counters
 from __future__ import annotations
 
 import logging
+import time
 
 import grpc
 
@@ -24,17 +25,29 @@ logger = logging.getLogger("ratelimit.server.grpc")
 
 
 class RateLimitServicerV3(rls_grpc.RateLimitServiceV3Servicer):
-    def __init__(self, service: RateLimitService):
+    def __init__(self, service: RateLimitService, stats_scope=None):
         self._service = service
+        # transport.grpc_ms: handler wall time — proto conversion + the
+        # service call. The gap against the service's own latency_ms is
+        # the transport (receive-stage) overhead.
+        self._h_receive = (
+            stats_scope.scope("transport").histogram("grpc_ms")
+            if stats_scope is not None
+            else None
+        )
 
     def ShouldRateLimit(self, request, context):  # noqa: N802
         logger.debug("handling v3 should_rate_limit for domain %s", request.domain)
+        t0 = time.perf_counter() if self._h_receive is not None else 0.0
         try:
             internal = proto_adapter.request_from_v3(request)
             overall, statuses, headers = self._service.should_rate_limit(internal)
+            return proto_adapter.response_to_v3(overall, statuses, headers)
         except (CacheError, ServiceError) as e:
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
-        return proto_adapter.response_to_v3(overall, statuses, headers)
+        finally:
+            if self._h_receive is not None:
+                self._h_receive.record((time.perf_counter() - t0) * 1e3)
 
 
 class RateLimitServicerV2(rls_grpc.RateLimitServiceV2Servicer):

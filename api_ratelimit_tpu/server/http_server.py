@@ -141,15 +141,27 @@ class HttpServer:
             self._thread = None
 
 
-def add_json_handler(server: HttpServer, service: RateLimitService) -> None:
-    """POST /json — HTTP/JSON mirror of the v3 RPC (server_impl.go:62-104)."""
+def add_json_handler(
+    server: HttpServer, service: RateLimitService, stats_scope=None
+) -> None:
+    """POST /json — HTTP/JSON mirror of the v3 RPC (server_impl.go:62-104).
+    stats_scope (optional) records transport.json_ms: handler wall time —
+    body read + jsonpb conversion + the service call."""
+    h_receive = (
+        stats_scope.scope("transport").histogram("json_ms")
+        if stats_scope is not None
+        else None
+    )
 
     def handle(h: _Handler) -> None:
         # HTTP middleware span honoring inbound B3 headers
         # (src/tracing/lightstep.go:107-160); no-op when tracing is off.
+        t0 = time.perf_counter() if h_receive is not None else 0.0
         with tracing.start_http_server_span("/json", h.headers) as span:
             with tracing.activate(span):
                 _handle_json(h)
+        if h_receive is not None:
+            h_receive.record((time.perf_counter() - t0) * 1e3)
 
     def _handle_json(h: _Handler) -> None:
         # A malformed Content-Length must be a 400, not a ValueError that
@@ -197,9 +209,16 @@ def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
     server.add_get("/healthcheck", handle)
 
 
-def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
+def new_debug_server(
+    host: str, port: int, stats_store, enable_metrics: bool = True
+) -> HttpServer:
     """The debug-port suite (server_impl.go:217-250); /rlconfig is added by
-    the runner via Server.add_debug_endpoint (runner.go:108-113)."""
+    the runner via Server.add_debug_endpoint (runner.go:108-113).
+
+    enable_metrics mounts GET /metrics — Prometheus text exposition
+    rendered straight from the stats store (stats/prometheus.py), making
+    the statsd -> prom-statsd-exporter hop optional. DEBUG_METRICS_ENABLED
+    turns it off for deployments that must not expose a scrape surface."""
     server = HttpServer(host, port, "debug")
 
     def handle_stats(h: _Handler) -> None:
@@ -207,6 +226,15 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
             200,
             json.dumps(stats_store.debug_snapshot(), indent=2).encode(),
             content_type="application/json",
+        )
+
+    def handle_metrics(h: _Handler) -> None:
+        from ..stats import prometheus
+
+        h._write(
+            200,
+            prometheus.render(stats_store).encode(),
+            content_type=prometheus.CONTENT_TYPE,
         )
 
     def handle_pprof(h: _Handler) -> None:
@@ -358,6 +386,8 @@ def new_debug_server(host: str, port: int, stats_store) -> HttpServer:
         )
 
     server.add_get("/stats", handle_stats)
+    if enable_metrics:
+        server.add_get("/metrics", handle_metrics)
     server.add_get("/debug/pprof/", handle_pprof)
     server.add_get("/debug/pprof/profile", handle_profile)
     server.add_get("/debug/pprof/heap", handle_heap)

@@ -43,6 +43,7 @@ class Server:
         debug_port: int,
         stats_store,
         grpc_max_workers: int = 32,
+        enable_metrics: bool = True,
     ):
         self.health = HealthChecker()
         self.stats_store = stats_store
@@ -65,7 +66,9 @@ class Server:
         self.http = HttpServer(host, port, "main")
         add_healthcheck(self.http, self.health)
 
-        self.debug = new_debug_server(host, debug_port, stats_store)
+        self.debug = new_debug_server(
+            host, debug_port, stats_store, enable_metrics=enable_metrics
+        )
 
         self._stopped = threading.Event()
         self._signals_installed = False
@@ -95,12 +98,15 @@ class Server:
 
     def register_service(self, service: RateLimitService, stats_scope) -> None:
         """Register v3 + legacy v2 RLS and the /json route
-        (runner.go:115-121)."""
-        rls_grpc.add_v3_servicer(RateLimitServicerV3(service), self.grpc_server)
+        (runner.go:115-121). The transport receive histograms
+        (<scope>.transport.{grpc_ms,json_ms}) hang off the same scope."""
+        rls_grpc.add_v3_servicer(
+            RateLimitServicerV3(service, stats_scope), self.grpc_server
+        )
         rls_grpc.add_v2_servicer(
             RateLimitServicerV2(service, stats_scope), self.grpc_server
         )
-        add_json_handler(self.http, service)
+        add_json_handler(self.http, service, stats_scope)
 
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT/SIGHUP -> drain + stop (server_impl.go:255-269).
@@ -176,4 +182,5 @@ def new_server(settings, stats_store) -> Server:
         grpc_port=settings.grpc_port,
         debug_port=settings.debug_port,
         stats_store=stats_store,
+        enable_metrics=settings.debug_metrics_enabled,
     )

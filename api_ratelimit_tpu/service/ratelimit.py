@@ -20,6 +20,7 @@ import base64
 import json
 import logging
 import threading
+import time
 from typing import Callable, Protocol, Sequence
 
 from ..assertx import assert_
@@ -59,7 +60,9 @@ def should_rate_limit_stats_names() -> tuple[str, str]:
 
 class _ServiceStats:
     """config_load_success/error + call.should_rate_limit.{redis,service}_error
-    (ratelimit.go:32-56)."""
+    (ratelimit.go:32-56), plus the end-to-end request latency histogram —
+    the top of the per-stage pipeline (queue wait / launch / readback live
+    under the backend's scopes)."""
 
     def __init__(self, scope):
         self.config_load_success = scope.counter("config_load_success")
@@ -67,6 +70,7 @@ class _ServiceStats:
         call_scope = scope.scope("call.should_rate_limit")
         self.redis_error = call_scope.counter("redis_error")
         self.service_error = call_scope.counter("service_error")
+        self.latency = call_scope.histogram("latency_ms")
 
 
 class RateLimitService:
@@ -104,6 +108,12 @@ class RateLimitService:
             burst=100, period_seconds=1.0, next_sampler=RandomSampler(100)
         )
 
+        # Test hook: extra seconds slept inside every should_rate_limit —
+        # integration tests force a request into the histogram's top
+        # latency bucket to exercise exemplar capture + span force-sampling
+        # without depending on real tail behavior.
+        self.debug_inject_latency_s: float = 0.0
+
         runtime.add_update_callback(self.reload_config)
         self.reload_config()
 
@@ -137,7 +147,14 @@ class RateLimitService:
 
     def should_rate_limit(self, request: RateLimitRequest):
         """Returns (overall_code, statuses, response_headers). Raises
-        CacheError / ServiceError after counting them."""
+        CacheError / ServiceError after counting them.
+
+        Every call — success or error — lands in the latency_ms histogram.
+        A request that falls in the top (overflow) bucket attaches its
+        trace id as an exemplar and force-samples the active span, so the
+        p99 tail in /metrics links straight to a per-stage span breakdown
+        in /debug/traces."""
+        t_start = time.perf_counter()
         try:
             return self._worker(request)
         except CacheError as e:
@@ -164,6 +181,17 @@ class RateLimitService:
                 span.set_error(e)
             logger.exception("unexpected error in should_rate_limit")
             raise ServiceError(f"unexpected error: {e}") from e
+        finally:
+            if self.debug_inject_latency_s > 0:  # test hook (see __init__)
+                self._time_source.sleep(self.debug_inject_latency_s)
+            ms = (time.perf_counter() - t_start) * 1e3
+            exemplar = None
+            if self._stats.latency.is_slow(ms):
+                span = active_span()
+                if span is not None and span.tracer is not None:
+                    exemplar = f"{span.context.trace_id:032x}"
+                    span.force_sample()
+            self._stats.latency.record(ms, exemplar=exemplar)
 
     def _worker(
         self, request: RateLimitRequest

@@ -46,6 +46,12 @@ class Settings:
     use_statsd: bool = True
     statsd_host: str = "localhost"
     statsd_port: int = 8125
+    # Prometheus pull telemetry (this framework): GET /metrics on the
+    # debug port, and the latency-histogram bucket ladder in MILLISECONDS
+    # (comma-separated floats; empty = the built-in log-spaced default,
+    # stats/store.py DEFAULT_LATENCY_BUCKETS_MS)
+    debug_metrics_enabled: bool = True
+    metrics_latency_buckets_ms: str = ""
     # runtime config dir (settings.go:20-23)
     runtime_path: str = "/srv/runtime_data/current"
     runtime_subdirectory: str = ""
@@ -113,6 +119,23 @@ class Settings:
     sidecar_tls_ca: str = ""
     sidecar_tls_server_name: str = ""
 
+    def latency_buckets(self) -> tuple[float, ...] | None:
+        """Parsed METRICS_LATENCY_BUCKETS_MS, or None for the default.
+        Raises ValueError on junk — a typo'd bucket ladder must fail the
+        boot, not silently fall back and skew every percentile."""
+        raw = self.metrics_latency_buckets_ms.strip()
+        if not raw:
+            return None
+        buckets = tuple(
+            sorted(float(p) for p in raw.split(",") if p.strip())
+        )
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError(
+                f"METRICS_LATENCY_BUCKETS_MS must be positive floats, "
+                f"got {raw!r}"
+            )
+        return buckets
+
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("port", "PORT", int),
@@ -121,6 +144,8 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("use_statsd", "USE_STATSD", _parse_bool),
     ("statsd_host", "STATSD_HOST", str),
     ("statsd_port", "STATSD_PORT", int),
+    ("debug_metrics_enabled", "DEBUG_METRICS_ENABLED", _parse_bool),
+    ("metrics_latency_buckets_ms", "METRICS_LATENCY_BUCKETS_MS", str),
     ("runtime_path", "RUNTIME_ROOT", str),
     ("runtime_subdirectory", "RUNTIME_SUBDIRECTORY", str),
     ("runtime_ignoredotfiles", "RUNTIME_IGNOREDOTFILES", _parse_bool),

@@ -1,11 +1,26 @@
-from .store import Store, Scope, Counter, Gauge, Timer, StatGenerator, new_null_store
-from .sinks import Sink, NullSink, TestSink, StatsdSink
+from .store import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    DEFAULT_SIZE_BUCKETS,
+    Store,
+    Scope,
+    Counter,
+    Gauge,
+    Histogram,
+    Timer,
+    StatGenerator,
+    new_null_store,
+)
+from .sinks import Sink, NullSink, TestSink, StatsdSink, format_statsd_ms
+from .prometheus import render as render_prometheus
 
 __all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "DEFAULT_SIZE_BUCKETS",
     "Store",
     "Scope",
     "Counter",
     "Gauge",
+    "Histogram",
     "Timer",
     "StatGenerator",
     "new_null_store",
@@ -13,4 +28,6 @@ __all__ = [
     "NullSink",
     "TestSink",
     "StatsdSink",
+    "format_statsd_ms",
+    "render_prometheus",
 ]

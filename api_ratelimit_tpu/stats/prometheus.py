@@ -1,0 +1,89 @@
+"""Prometheus text-exposition renderer over a stats Store.
+
+Makes the prom-statsd-exporter hop from the reference deployment optional:
+GET /metrics on the debug port (server/http_server.py) renders the live
+store directly in text exposition format 0.0.4 — counters, gauges, timers
+(as summaries with p50/p99 quantiles), and the hot-path histograms with
+classic `_bucket{le=...}` / `_sum` / `_count` series.
+
+Name mangling follows the exporter's convention: the dotted statsd paths
+become underscore-separated Prometheus names (`ratelimit.slab.occupancy`
+-> `ratelimit_slab_occupancy`), so dashboards keyed on the exporter
+mapping translate mechanically.
+
+Histogram `le` labels are in MILLISECONDS, matching the `_ms`-suffixed
+metric names — the store records ms everywhere and rescaling at the edge
+would desynchronize /metrics from /stats and the BENCH artifacts.
+"""
+
+from __future__ import annotations
+
+import re
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(dotted: str) -> str:
+    """statsd dotted path -> Prometheus metric name."""
+    name = _NAME_SANITIZE.sub("_", dotted.replace(".", "_"))
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample value: integers stay integral, floats stay
+    fixed-point (exposition format allows scientific notation but plain
+    decimals parse everywhere)."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int) or float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def render(store) -> str:
+    """The full /metrics payload for a Store (stats/store.py). One
+    metrics_snapshot() call — the same snapshot path bench.py reads — so
+    scrape and artifact can never disagree."""
+    snap = store.metrics_snapshot()
+    lines: list[str] = []
+
+    for name, value in sorted(snap["counters"].items()):
+        p = prom_name(name)
+        lines.append(f"# TYPE {p} counter")
+        lines.append(f"{p} {_fmt(value)}")
+
+    for name, value in sorted(snap["gauges"].items()):
+        p = prom_name(name)
+        lines.append(f"# TYPE {p} gauge")
+        lines.append(f"{p} {_fmt(value)}")
+
+    for name, summary in sorted(snap["timers"].items()):
+        p = prom_name(name)
+        lines.append(f"# TYPE {p} summary")
+        lines.append(f'{p}{{quantile="0.5"}} {_fmt(summary["p50_ms"])}')
+        lines.append(f'{p}{{quantile="0.99"}} {_fmt(summary["p99_ms"])}')
+        lines.append(f"{p}_sum {_fmt(summary['sum_ms'])}")
+        lines.append(f"{p}_count {_fmt(summary['count'])}")
+        if summary.get("dropped"):
+            d = f"{p}_dropped_samples"
+            lines.append(f"# TYPE {d} counter")
+            lines.append(f"{d} {_fmt(summary['dropped'])}")
+
+    for name, hist in sorted(snap["histograms"].items()):
+        p = prom_name(name)
+        lines.append(f"# TYPE {p} histogram")
+        cumulative = 0
+        for boundary, count in zip(hist["boundaries"], hist["counts"]):
+            cumulative += count
+            lines.append(f'{p}_bucket{{le="{_fmt(boundary)}"}} {cumulative}')
+        cumulative += hist["counts"][-1]
+        lines.append(f'{p}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{p}_sum {_fmt(hist['sum'])}")
+        lines.append(f"{p}_count {_fmt(hist['count'])}")
+
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

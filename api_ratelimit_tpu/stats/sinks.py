@@ -43,6 +43,7 @@ class TestSink:
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, int] = {}
         self.timers: dict[str, list[float]] = {}
+        self.histograms: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     def flush_counter(self, name: str, delta: int) -> None:
@@ -57,8 +58,24 @@ class TestSink:
         with self._lock:
             self.timers.setdefault(name, []).append(ms)
 
+    def flush_histogram(self, name: str, snapshot: dict) -> None:
+        with self._lock:
+            self.histograms[name] = snapshot
+
     def flush(self) -> None:
         pass
+
+
+def format_statsd_ms(ms: float) -> str:
+    """Fixed-point millisecond value for a statsd '|ms' line.
+
+    `{ms:g}` emits exponential notation below 1e-4 (e.g. `1e-05`), which
+    statsd line parsers reject — sub-microsecond timings then poison the
+    whole datagram. Clamp to fixed-point with enough places for ns
+    resolution, then strip trailing zeros so common values stay compact
+    (1.5, not 1.500000)."""
+    out = f"{ms:.9f}".rstrip("0").rstrip(".")
+    return out or "0"
 
 
 class StatsdSink:
@@ -107,7 +124,7 @@ class StatsdSink:
         self._emit(f"{self._name(name)}:{value}|g")
 
     def flush_timer(self, name: str, ms: float) -> None:
-        self._emit(f"{self._name(name)}:{ms:g}|ms")
+        self._emit(f"{self._name(name)}:{format_statsd_ms(ms)}|ms")
 
     def flush(self) -> None:
         with self._lock:

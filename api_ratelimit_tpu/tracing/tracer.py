@@ -105,6 +105,9 @@ class Span:
     duration: float = 0.0  # monotonic-clock delta, immune to NTP steps
     tags: dict = field(default_factory=dict)
     logs: list = field(default_factory=list)  # [(timestamp, {k: v})]
+    # force_sample() sets this: a span the SERVICE decided must be kept
+    # (slow-request tail capture) even when B3 said sampled=0
+    forced_sample: bool = False
     _finished: bool = False
     _mono_start: float = 0.0
 
@@ -121,6 +124,15 @@ class Span:
 
     def log_kv(self, **fields) -> "Span":
         self.logs.append((time.time(), fields))
+        return self
+
+    def force_sample(self) -> "Span":
+        """Override head-based sampling for this span: a request that
+        landed in the top latency bucket must reach the trace buffer so
+        its histogram exemplar has a span to click through to, even when
+        the inbound B3 context said sampled=0."""
+        self.forced_sample = True
+        self.set_tag("sampling.forced", True)
         return self
 
     def finish(self) -> None:
@@ -263,6 +275,9 @@ class _NoopSpan(Span):
     def log_kv(self, **fields):
         return self
 
+    def force_sample(self):
+        return self  # never mutate the shared singleton
+
     def finish(self):
         pass
 
@@ -299,7 +314,8 @@ class RecordingTracer(Tracer):
         self._spans: list[Span] = []
 
     def _on_finish(self, span: Span) -> None:
-        if not span.context.sampled:  # honor B3 sampled=0
+        # honor B3 sampled=0 unless the service force-sampled (slow tail)
+        if not span.context.sampled and not span.forced_sample:
             return
         with self._lock:
             self._spans.append(span)
@@ -354,7 +370,8 @@ class CollectorTracer(Tracer):
         self._thread.start()
 
     def _on_finish(self, span: Span) -> None:
-        if not span.context.sampled:  # honor B3 sampled=0
+        # honor B3 sampled=0 unless the service force-sampled (slow tail)
+        if not span.context.sampled and not span.forced_sample:
             return
         try:
             self._queue.put_nowait(span)

@@ -36,10 +36,17 @@ Artifact field guide (round 5 additions):
   engine.parity.lossy_events/explained
                                   structural drift bound: every false_ok
                                   must be covered by drops + steals*limit
-  service.device_split            chain-timed device_ms vs readback_ms at
-                                  the batcher's observed median batch, and
-                                  p99_co_located_est_ms (= p99 minus the
-                                  result drain that rides the dev tunnel)
+  service.stages                  per-stage count/p50/p99 sourced from the
+                                  RUNTIME histograms recorded during the
+                                  drive (queue_wait/pack/launch/readback/
+                                  service_ms + batch_size) — the same
+                                  Store snapshot GET /metrics renders, so
+                                  BENCH and live telemetry cannot disagree
+  service.p99_co_located_est_ms   p99 minus the p50 blocking readback that
+                                  rides the dev tunnel
+  service.telemetry_overhead_pct  flat_per_second only: rate loss vs a
+                                  stats-scope-free rebuild of the stack
+                                  (the <5% telemetry budget)
   engine.sharded.{rate,rate_pipelined,rate_replicated,rate_single_device}
                                   cold-block sharded rows; host_cpus says
                                   whether the mesh could physically
@@ -644,56 +651,42 @@ def _drive_service(service, reqs, n_threads: int, per_thread: int):
     return total, elapsed, lat
 
 
-def _measure_device_split(cache, n_launches: int = 8) -> dict | None:
-    """Chain-time the device program at the batch size the service tier
-    actually coalesced to: device_ms (launch -> donated-state chain ready)
-    vs readback_ms (result drain). Through the dev tunnel the readback rides
-    a ~9ms network RTT that the measured service p99 inherits; a co-located
-    production host pays PCIe microseconds instead, so
-    p99 - readback_ms_per_launch is the honest co-located p99 estimate
-    (VERDICT r4 weak #4 — the split makes the artifact say which part is
-    the engine and which part is this environment's link)."""
-    import jax
-
-    from api_ratelimit_tpu.backends.tpu import _Item
-
-    eng = cache.engine
-    if not hasattr(eng, "launch_sizes") or getattr(eng, "_engine", None) is not None:
-        return None  # sidecar client or mesh engine: no single-chip chain
-    sizes = list(eng.launch_sizes)
-    if not sizes:
-        return None
-    bsz = max(1, int(np.median(sizes)))
-    rng = np.random.RandomState(7)
-    batches = []
-    for _ in range(n_launches + 1):
-        fps = rng.randint(1, 1 << 62, size=bsz, dtype=np.int64)
-        batches.append(
-            [
-                _Item(fp=int(f), hits=1, limit=1_000_000_000, divider=1, jitter=0)
-                for f in fps
-            ]
-        )
-    # warm the bucket's compile, then chain n_launches distinct batches
-    eng._collect(eng._launch_async(batches[-1]))
-    t0 = time.perf_counter()
-    tokens = [eng._launch_async(b) for b in batches[:n_launches]]
-    jax.block_until_ready(eng._state)
-    device_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for t in tokens:
-        eng._collect(t)
-    readback_s = time.perf_counter() - t0
-    return {
-        "batch_p50": bsz,
-        "device_ms_per_launch": round(device_s / n_launches * 1e3, 3),
-        "readback_ms_per_launch": round(readback_s / n_launches * 1e3, 3),
-    }
+# The runtime histogram names the service tier reports per-stage timings
+# from — the SAME Store snapshot GET /metrics renders, so BENCH artifacts
+# and live telemetry are one measurement and can never disagree (this
+# replaces the old chain-timed _measure_device_split estimates).
+_STAGE_HISTOGRAMS = (
+    ("service_ms", "ratelimit.service.call.should_rate_limit.latency_ms"),
+    ("queue_wait_ms", "ratelimit.batcher.queue_wait_ms"),
+    ("batch_size", "ratelimit.batcher.batch_size"),
+    ("pack_ms", "ratelimit.device.pack_ms"),
+    ("launch_ms", "ratelimit.device.launch_ms"),
+    ("readback_ms", "ratelimit.device.readback_ms"),
+)
 
 
-def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
-    """One service-level scenario: threads driving should_rate_limit through
-    the micro-batched TPU backend."""
+def _stage_timings(store) -> dict:
+    """Per-stage count/p50/p99 from the runtime histograms recorded DURING
+    the timed drive (queue wait, pack, async launch dispatch, blocking
+    readback, end-to-end service latency, plus the coalesced batch-size
+    distribution)."""
+    hists = store.metrics_snapshot()["histograms"]
+    out = {}
+    for short, name in _STAGE_HISTOGRAMS:
+        h = hists.get(name)
+        if h and h["count"]:
+            out[short] = {
+                "count": h["count"],
+                "p50": round(h["p50"], 4),
+                "p99": round(h["p99"], 4),
+            }
+    return out
+
+
+def _build_service(config_key: str, yaml_text: str, telemetry: bool):
+    """One service stack for a scenario; telemetry=False builds the same
+    stack with no stats scope on the backend (the A/B for recording
+    overhead). Returns (service, cache, store)."""
     import random
 
     from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
@@ -704,13 +697,6 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
     from api_ratelimit_tpu.stats.store import Store
     from api_ratelimit_tpu.utils.timeutil import RealTimeSource
 
-    # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
-    # parallel workers (test/redis/bench_test.go); oversubscribing a small
-    # box measures queueing, not the service (8 threads on the 1-core bench
-    # host tripled p99 vs 4). Floor of 4 keeps real cross-request
-    # coalescing in the batcher on any host.
-    n_threads = max(4, os.cpu_count() or 1)
-    per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
     store = Store(NullSink())
     local_cache = (
         LocalCache(max_entries=4096, time_source=RealTimeSource())
@@ -733,6 +719,7 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
         # beyond ~2 launches in flight buys nothing at service arrival rates.
         batch_window_seconds=0.0002,
         max_batch=8192,
+        stats_scope=store.scope("ratelimit") if telemetry else None,
     )
     service = RateLimitService(
         runtime=_StaticRuntime(yaml_text),
@@ -740,6 +727,31 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
         stats_scope=store.scope("ratelimit").scope("service"),
         time_source=RealTimeSource(),
     )
+    return service, cache, store
+
+
+def bench_service(
+    config_key: str,
+    yaml_text: str,
+    on_tpu: bool,
+    measure_telemetry_overhead: bool = False,
+) -> dict:
+    """One service-level scenario: threads driving should_rate_limit through
+    the micro-batched TPU backend. Per-stage timings come from the runtime
+    histograms the drive itself recorded (_stage_timings).
+
+    measure_telemetry_overhead: drive the same scenario a second time with
+    the backend's stats scope disabled and report the recording overhead as
+    a rate ratio (the <5% telemetry-cost budget, checked on
+    flat_per_second)."""
+    # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
+    # parallel workers (test/redis/bench_test.go); oversubscribing a small
+    # box measures queueing, not the service (8 threads on the 1-core bench
+    # host tripled p99 vs 4). Floor of 4 keeps real cross-request
+    # coalescing in the batcher on any host.
+    n_threads = max(4, os.cpu_count() or 1)
+    per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
+    service, cache, store = _build_service(config_key, yaml_text, telemetry=True)
     reqs = _requests_for(config_key, 2048)
     decisions_per_request = len(reqs[0].descriptors)
 
@@ -749,10 +761,7 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
 
     total, elapsed, lat = _drive_service(service, reqs, n_threads, per_thread)
     p99 = round(float(np.percentile(lat, 99)), 3)
-    try:
-        split = _measure_device_split(cache)
-    except Exception as e:  # the split is diagnostic; never sink the tier
-        split = {"error": str(e)[-200:]}
+    stages = _stage_timings(store)
     cache.close()
 
     result = {
@@ -764,15 +773,32 @@ def bench_service(config_key: str, yaml_text: str, on_tpu: bool) -> dict:
         "p99_ms": p99,
         "descriptors_per_request": decisions_per_request,
     }
-    if split and "error" not in split:
-        # co-located estimate: the measured p99 minus the per-launch result
-        # drain (which here rides the dev tunnel's RTT — see the link block;
-        # a co-located host replaces it with PCIe microseconds)
-        split["p99_co_located_est_ms"] = round(
-            max(0.0, p99 - split["readback_ms_per_launch"]), 3
+    if stages:
+        result["stages"] = stages
+    readback = stages.get("readback_ms")
+    if readback:
+        # co-located estimate: the measured p99 minus the typical blocking
+        # readback (which here rides the dev tunnel's RTT — see the link
+        # block; a co-located host replaces it with PCIe microseconds)
+        result["p99_co_located_est_ms"] = round(
+            max(0.0, p99 - readback["p50"]), 3
         )
-    if split:
-        result["device_split"] = split
+    if measure_telemetry_overhead:
+        service_off, cache_off, _ = _build_service(
+            config_key, yaml_text, telemetry=False
+        )
+        for r in reqs[:32]:
+            service_off.should_rate_limit(r)
+        total_off, elapsed_off, _lat = _drive_service(
+            service_off, reqs, n_threads, per_thread
+        )
+        cache_off.close()
+        rate_off = total_off * decisions_per_request / elapsed_off
+        result["rate_telemetry_off"] = round(rate_off)
+        if rate_off > 0:
+            result["telemetry_overhead_pct"] = round(
+                (1.0 - result["rate"] / rate_off) * 100.0, 2
+            )
     print(f"[service:{config_key}] {result}", file=sys.stderr)
     return result
 
@@ -1421,7 +1447,16 @@ def main() -> None:
             configs[key] = {"skipped": "budget"}
             continue
         try:
-            configs[key] = bench_service(key, yaml_text, on_tpu)
+            configs[key] = bench_service(
+                key,
+                yaml_text,
+                on_tpu,
+                # the telemetry-cost A/B (<5% budget) runs once, on the
+                # scenario with the least masking device time
+                measure_telemetry_overhead=(
+                    key == "flat_per_second" and left() > 100
+                ),
+            )
         except Exception as e:
             configs[key] = {"error": str(e)[-300:]}
         emit()

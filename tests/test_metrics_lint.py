@@ -1,0 +1,42 @@
+"""Fast tier-1 wrapper around tools/metrics_lint.py: the package's literal
+stat-name registrations must keep the dotted-lowercase convention and one
+stat kind per name (a counter/gauge clash would make the Prometheus
+renderer emit two # TYPE declarations for one family)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_linter():
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(REPO, "tools", "metrics_lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_stat_names_are_clean():
+    lint = _load_linter()
+    findings = lint.lint()
+    assert findings == [], "\n".join(findings)
+    # sanity: the walker actually saw the known registrations — an empty
+    # scan passing would make this lint vacuous
+    names = {name for name, _, _, _ in lint.iter_registrations()}
+    assert "config_load_success" in names
+    assert "queue_wait_ms" in names
+
+
+def test_linter_flags_violations(tmp_path):
+    lint = _load_linter()
+    bad = tmp_path / "bad_stats.py"
+    bad.write_text(
+        'a = scope.counter("CamelCase.name")\n'
+        'b = scope.counter("dup.name")\n'
+        'c = scope.gauge("dup.name")\n'
+    )
+    findings = lint.lint(str(tmp_path))
+    assert any("CamelCase.name" in f and "convention" in f for f in findings)
+    assert any("dup.name" in f and "conflicting types" in f for f in findings)

@@ -651,6 +651,188 @@ class TestBackendMatrixTopologies:
             master.close()
 
 
+PROM_SAMPLE = __import__("re").compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9]+(\.[0-9]+)?([eE][+-][0-9]+)?$"
+)
+PROM_COMMENT = __import__("re").compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary)$"
+)
+
+
+def test_metrics_endpoint_full_pipeline(tmp_path):
+    """The acceptance scrape: boot the REAL tpu backend, drive traffic, and
+    parse every line of GET /metrics — it must carry the whole per-stage
+    pipeline: total request latency histogram, batcher queue-wait +
+    batch-size histograms, device launch/readback histograms, slab
+    occupancy/eviction gauges, and the batcher queue-depth gauge."""
+    runtime_path, subdir, _ = make_runtime(tmp_path)
+    settings = Settings(
+        port=0,
+        grpc_port=0,
+        debug_port=0,
+        use_statsd=False,
+        runtime_path=runtime_path,
+        runtime_subdirectory=subdir,
+        backend_type="tpu",
+        tpu_slab_slots=1 << 12,
+        tpu_batch_window=0.0002,  # dispatcher mode: queue-wait is real
+        expiration_jitter_max_seconds=0,
+        log_level="ERROR",
+    )
+    runner = Runner(settings, sink=TestSink())
+    runner.run_background()
+    assert runner.wait_ready(10.0)
+    try:
+        with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+            stub = rls_grpc.RateLimitServiceV3Stub(ch)
+            for i in range(8):
+                stub.ShouldRateLimit(
+                    v3_request("basic", [[("key1", f"k{i}")]])
+                )
+        status, text = http_get(runner.server.debug_port, "/metrics")
+        assert status == 200
+
+        lines = text.strip().splitlines()
+        assert lines
+        for line in lines:  # every line parses as exposition format
+            assert PROM_SAMPLE.match(line) or PROM_COMMENT.match(line), line
+
+        required = [
+            # total request latency (histogram) + transport receive stage
+            "ratelimit_service_call_should_rate_limit_latency_ms_bucket",
+            "ratelimit_service_call_should_rate_limit_latency_ms_count",
+            "ratelimit_service_transport_grpc_ms_bucket",
+            # batcher: queue-wait histogram, batch-size distribution, depth
+            "ratelimit_batcher_queue_wait_ms_bucket",
+            "ratelimit_batcher_batch_size_bucket",
+            "ratelimit_batcher_queue_depth",
+            "ratelimit_batcher_inflight",
+            # device stages
+            "ratelimit_device_pack_ms_bucket",
+            "ratelimit_device_launch_ms_bucket",
+            "ratelimit_device_readback_ms_bucket",
+            # slab health gauges (evictions = steals/drops; occupancy)
+            "ratelimit_slab_steals",
+            "ratelimit_slab_drops",
+            "ratelimit_slab_occupancy",
+            "ratelimit_slab_live_slots",
+        ]
+        for name in required:
+            assert any(l.startswith(name) for l in lines), f"missing {name}"
+
+        # the request latency histogram actually observed the traffic
+        count_line = next(
+            l
+            for l in lines
+            if l.startswith(
+                "ratelimit_service_call_should_rate_limit_latency_ms_count"
+            )
+        )
+        assert int(count_line.rsplit(" ", 1)[1]) >= 8
+        # histograms are cumulative: the +Inf bucket equals the count
+        inf_line = next(
+            l
+            for l in lines
+            if l.startswith(
+                "ratelimit_service_call_should_rate_limit_latency_ms_bucket"
+            )
+            and 'le="+Inf"' in l
+        )
+        assert inf_line.rsplit(" ", 1)[1] == count_line.rsplit(" ", 1)[1]
+    finally:
+        runner.stop()
+
+
+def test_metrics_endpoint_can_be_disabled(tmp_path):
+    runtime_path, subdir, _ = make_runtime(tmp_path)
+    settings = Settings(
+        port=0,
+        grpc_port=0,
+        debug_port=0,
+        use_statsd=False,
+        runtime_path=runtime_path,
+        runtime_subdirectory=subdir,
+        backend_type="memory",
+        debug_metrics_enabled=False,
+        expiration_jitter_max_seconds=0,
+        log_level="ERROR",
+    )
+    runner = Runner(settings, sink=TestSink())
+    runner.run_background()
+    assert runner.wait_ready(10.0)
+    try:
+        assert http_get(runner.server.debug_port, "/metrics")[0] == 404
+        assert http_get(runner.server.debug_port, "/stats")[0] == 200
+    finally:
+        runner.stop()
+
+
+def test_slow_request_exemplar_links_to_forced_span(tmp_path, monkeypatch):
+    """The tail-capture acceptance path: a slow request (forced via the
+    service's debug_inject_latency_s test hook) lands in the top latency
+    bucket, attaches its trace id as the histogram exemplar, and
+    force-samples its span into /debug/traces EVEN THOUGH the client sent
+    x-b3-sampled: 0 — one click from p99 outlier to per-stage spans."""
+    from api_ratelimit_tpu import tracing
+
+    monkeypatch.setenv("K_TRACING_ENABLED", "true")
+    runtime_path, subdir, _ = make_runtime(tmp_path)
+    settings = Settings(
+        port=0,
+        grpc_port=0,
+        debug_port=0,
+        use_statsd=False,
+        runtime_path=runtime_path,
+        runtime_subdirectory=subdir,
+        backend_type="memory",
+        metrics_latency_buckets_ms="0.5,1,5,250",  # top bucket: >250ms
+        expiration_jitter_max_seconds=0,
+        log_level="ERROR",
+    )
+    runner = Runner(settings, sink=TestSink())
+    runner.run_background()
+    assert runner.wait_ready(10.0)
+    try:
+        trace_id = "feedfacefeedfacefeedfacefeedface"
+        b3_unsampled = (
+            ("x-b3-traceid", trace_id),
+            ("x-b3-spanid", "00000000000000cd"),
+            ("x-b3-sampled", "0"),
+        )
+        with grpc.insecure_channel(f"localhost:{runner.server.grpc_port}") as ch:
+            stub = rls_grpc.RateLimitServiceV3Stub(ch)
+            # fast + unsampled: honored — no span recorded, no exemplar
+            stub.ShouldRateLimit(
+                v3_request("basic", [[("key1", "fast")]]), metadata=b3_unsampled
+            )
+            spans = runner.tracer.finished_spans()
+            assert not any(s.context.trace_id == int(trace_id, 16) for s in spans)
+            snap = runner.stats_store.debug_snapshot()
+            key = "ratelimit.service.call.should_rate_limit.latency_ms"
+            assert snap[f"{key}.count"] >= 1
+            assert f"{key}.exemplar" not in snap
+
+            # force the slow path: > the 250ms top boundary
+            runner.service.debug_inject_latency_s = 0.3
+            stub.ShouldRateLimit(
+                v3_request("basic", [[("key1", "slow")]]), metadata=b3_unsampled
+            )
+
+        snap = runner.stats_store.debug_snapshot()
+        assert snap[f"{key}.exemplar"] == trace_id
+
+        # the matching span was force-sampled into /debug/traces
+        status, body = http_get(runner.server.debug_port, "/debug/traces")
+        assert status == 200
+        dump = json.loads(body)
+        forced = [s for s in dump["spans"] if s["trace_id"] == trace_id]
+        assert forced, "force-sampled span missing from /debug/traces"
+        assert any(s["tags"].get("sampling.forced") for s in forced)
+    finally:
+        runner.stop()
+        tracing.reset_global_tracer()
+
+
 def test_duration_until_reset_decays(running_server):
     """DurationUntilReset shrinks as the window ages
     (integration_test.go:476-487 asserts decay across a 2s sleep)."""

@@ -86,3 +86,26 @@ class TestSettings:
 
     def test_dataclass_is_plain(self):
         assert Settings().port == 8080
+
+    def test_metrics_knobs(self):
+        s = new_settings(
+            {
+                "DEBUG_METRICS_ENABLED": "false",
+                "METRICS_LATENCY_BUCKETS_MS": "5, 0.5,1,100",
+            }
+        )
+        assert s.debug_metrics_enabled is False
+        assert s.latency_buckets() == (0.5, 1.0, 5.0, 100.0)  # sorted
+        # default: endpoint on, store-default ladder
+        assert Settings().debug_metrics_enabled is True
+        assert Settings().latency_buckets() is None
+
+    def test_metrics_buckets_junk_raises(self):
+        with pytest.raises(ValueError):
+            new_settings(
+                {"METRICS_LATENCY_BUCKETS_MS": "1,abc"}
+            ).latency_buckets()
+        with pytest.raises(ValueError):
+            new_settings(
+                {"METRICS_LATENCY_BUCKETS_MS": "-1,5"}
+            ).latency_buckets()

@@ -1,6 +1,17 @@
 """Unit tests for the stats pipeline."""
 
-from api_ratelimit_tpu.stats import Store, TestSink, StatsdSink
+import threading
+import time
+
+from api_ratelimit_tpu.stats import (
+    Histogram,
+    StatsdSink,
+    Store,
+    TestSink,
+    Timer,
+    format_statsd_ms,
+    render_prometheus,
+)
 
 
 def test_counter_flush_delta(test_store):
@@ -51,3 +62,184 @@ def test_statsd_sink_format():
     sink.flush_timer("t", 1.5)
     sink.flush()
     assert sent == [b"ratelimit.x.y:3|c\nratelimit.g:7|g\nratelimit.t:1.5|ms"]
+
+
+def test_statsd_timer_fixed_point_not_exponential():
+    """{:g} emitted `1e-05` for sub-microsecond timings, which statsd line
+    parsers reject — values must stay fixed-point at any magnitude."""
+    sent = []
+    sink = StatsdSink("localhost", 0)
+    sink._send = sent.append  # type: ignore
+    sink.flush_timer("t", 1e-05)
+    sink.flush_timer("t", 0.0)
+    sink.flush_timer("t", 12345.678)
+    sink.flush()
+    lines = sent[0].decode().splitlines()
+    assert lines == ["t:0.00001|ms", "t:0|ms", "t:12345.678|ms"]
+    assert all("e" not in l.split(":")[1] for l in lines)
+    assert format_statsd_ms(2.5e-07) == "0.00000025"
+
+
+class TestTimerCap:
+    def test_samples_capped_and_drops_counted(self):
+        t = Timer("t")
+        for i in range(Timer.MAX_SAMPLES + 100):
+            t.add_value_ms(1.0)
+        assert len(t._samples) == Timer.MAX_SAMPLES
+        assert t.dropped() == 100
+        assert t.count() == Timer.MAX_SAMPLES + 100
+        # latch drains the buffer and recording resumes without drops
+        assert len(t.latch()) == Timer.MAX_SAMPLES
+        t.add_value_ms(2.0)
+        assert t.dropped() == 100
+        assert len(t._samples) == 1
+
+    def test_store_flush_reports_dropped_timer_summary(self, test_store):
+        store, sink = test_store
+        t = store.timer("lat")
+        t.add_value_ms(3.0)
+        snap = store.debug_snapshot()
+        assert snap["lat.count"] == 1
+        assert snap["lat.p50_ms"] == 3.0
+        assert snap["lat.p99_ms"] == 3.0
+
+
+class TestHistogram:
+    def test_bucketing_and_percentiles(self):
+        h = Histogram("h", boundaries=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.5, 3.0, 100.0):
+            h.record(v)
+        snap = h.snapshot()
+        assert snap["counts"] == [1, 2, 1, 1]  # (-inf,1],(1,2],(2,4],overflow
+        assert snap["count"] == 5
+        assert snap["sum"] == 106.5
+        assert 0 < snap["p50"] <= 2.0
+        assert snap["p99"] == 4.0  # overflow clamps to the last edge
+        assert h.percentile(0.5) == snap["p50"]
+
+    def test_exemplar_only_in_top_bucket(self):
+        h = Histogram("h", boundaries=(1.0, 10.0))
+        h.record(0.5, exemplar="fast-trace")
+        assert "exemplar" not in h.snapshot()
+        assert not h.is_slow(10.0)
+        assert h.is_slow(50.0)
+        h.record(50.0, exemplar="slow-trace")
+        ex = h.snapshot()["exemplar"]
+        assert ex["trace_id"] == "slow-trace"
+        assert ex["value"] == 50.0
+
+    def test_store_registration_cached_and_in_snapshot(self, test_store):
+        store, _ = test_store
+        a = store.scope("svc").histogram("lat_ms", boundaries=(1.0, 5.0))
+        b = store.scope("svc").histogram("lat_ms")
+        assert a is b  # first registration pins boundaries
+        a.record(0.5)
+        a.record(50.0)
+        snap = store.debug_snapshot()
+        assert snap["svc.lat_ms.count"] == 2
+        assert snap["svc.lat_ms.p99"] == 5.0
+
+    def test_recording_under_threads_loses_nothing(self):
+        h = Histogram("h", boundaries=(0.5, 1.0, 2.0, 4.0))
+        n_threads, per_thread = 8, 5000
+
+        def worker(tid):
+            for i in range(per_thread):
+                h.record((i % 40) / 8.0)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = h.snapshot()
+        assert snap["count"] == n_threads * per_thread
+        assert sum(snap["counts"]) == n_threads * per_thread
+
+    def test_recording_is_cheap(self):
+        """The <5% telemetry budget starts here: one record must stay in
+        the microsecond range (loose bound — this catches a lock or
+        allocation regression, not scheduler noise)."""
+        h = Histogram("h")
+        t0 = time.perf_counter()
+        for i in range(100_000):
+            h.record(1.25)
+        per_record = (time.perf_counter() - t0) / 100_000
+        assert per_record < 50e-6, f"record() cost {per_record * 1e6:.1f}us"
+
+
+class TestStoreConcurrency:
+    def test_flush_loop_start_stop_idempotent(self, test_store):
+        store, _ = test_store
+        store.start_flushing(interval_seconds=0.01)
+        first = store._flush_thread
+        store.start_flushing(interval_seconds=0.01)  # no second thread
+        assert store._flush_thread is first
+        store.stop_flushing()
+        assert store._flush_thread is None
+        store.stop_flushing()  # double stop is a no-op
+        # restart works after stop
+        store.start_flushing(interval_seconds=0.01)
+        assert store._flush_thread is not None and store._flush_thread.is_alive()
+        store.stop_flushing()
+
+    def test_registration_races_flush(self, test_store):
+        """Registering new stats while the flush loop runs must not skip,
+        duplicate, or crash — the reg lock covers the registry snapshot."""
+        store, sink = test_store
+        store.start_flushing(interval_seconds=0.001)
+        errors = []
+
+        def register(tid):
+            try:
+                for i in range(200):
+                    store.counter(f"c.{tid}.{i}").inc()
+                    store.gauge(f"g.{tid}.{i}").set(i)
+                    store.histogram(f"h.{tid}.{i}").record(1.0)
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=register, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        store.stop_flushing()
+        store.flush()  # final flush drains everything registered
+        assert not errors
+        assert len(sink.counters) == 4 * 200
+        assert all(v == 1 for v in sink.counters.values())
+
+
+def test_prometheus_render_roundtrip(test_store):
+    store, _ = test_store
+    store.scope("ratelimit").counter("hits").add(3)
+    store.gauge("depth").set(7)
+    t = store.timer("old_t")
+    t.add_value_ms(2.0)
+    h = store.histogram("lat_ms", boundaries=(1.0, 5.0))
+    h.record(0.5)
+    h.record(99.0)
+    text = render_prometheus(store)
+    lines = text.strip().splitlines()
+    # every line is either a TYPE comment or a parseable sample
+    import re
+
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9]+(\.[0-9]+)?$"
+    )
+    comment = re.compile(
+        r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary)$"
+    )
+    for line in lines:
+        assert sample.match(line) or comment.match(line), line
+    assert "ratelimit_hits 3" in lines
+    assert "depth 7" in lines
+    assert 'lat_ms_bucket{le="1"} 1' in lines
+    assert 'lat_ms_bucket{le="+Inf"} 2' in lines
+    assert "lat_ms_count 2" in lines
+    assert "old_t_count 1" in lines

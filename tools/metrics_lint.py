@@ -1,0 +1,110 @@
+"""Lint stat-name registrations across the package.
+
+Walks api_ratelimit_tpu/ for literal stat registrations —
+scope.counter("..."), .gauge("..."), .timer("..."), .histogram("...") —
+and fails on:
+
+  * names violating the dotted-lowercase convention
+    (``segment.segment`` where a segment is ``[a-z0-9_]+``); and
+  * the same literal name registered under CONFLICTING stat kinds
+    (e.g. a counter somewhere and a gauge elsewhere): the Prometheus
+    renderer would emit two # TYPE declarations for one family, which
+    scrapers reject.
+
+Names are literals as written at the call site (scope-relative); the
+convention check is what keeps the composed dotted paths well-formed.
+Dynamically composed names (f-strings, variables) are out of scope.
+
+Run standalone (``python tools/metrics_lint.py``; exit 1 on findings) or
+via the fast pytest wrapper in tests/test_metrics_lint.py, which is part
+of the tier-1 run. No jax import — this must stay cheap.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "api_ratelimit_tpu")
+
+_REGISTRATION = re.compile(
+    r"\.(?P<kind>counter|gauge|timer|histogram)\(\s*(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)"
+)
+_NAME_OK = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+# freecache parity names (limiter/local_cache.py): the reference exports
+# the Go library's camelCase counters verbatim so existing dashboards and
+# the prom-statsd-exporter mapping carry over (README "Switching from
+# kentik/api-ratelimit"); exempt from the convention, not from the
+# conflicting-kind check.
+NAME_ALLOWLIST = frozenset(
+    {
+        "hitCount",
+        "missCount",
+        "lookupCount",
+        "entryCount",
+        "expiredCount",
+        "evacuateCount",
+        "overwriteCount",
+    }
+)
+
+
+def iter_registrations(package_dir: str = PACKAGE):
+    """Yield (name, kind, file, line) for every literal registration."""
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, start=1):
+                    for m in _REGISTRATION.finditer(line):
+                        yield (
+                            m.group("name"),
+                            m.group("kind"),
+                            os.path.relpath(path, REPO),
+                            lineno,
+                        )
+
+
+def lint(package_dir: str = PACKAGE) -> list[str]:
+    """Returns a list of human-readable findings (empty = clean)."""
+    findings: list[str] = []
+    kinds_by_name: dict[str, dict[str, list[str]]] = {}
+    for name, kind, path, lineno in iter_registrations(package_dir):
+        site = f"{path}:{lineno}"
+        if name not in NAME_ALLOWLIST and not _NAME_OK.match(name):
+            findings.append(
+                f"{site}: stat name {name!r} violates the dotted-lowercase "
+                f"convention ([a-z0-9_] segments joined by '.')"
+            )
+        kinds_by_name.setdefault(name, {}).setdefault(kind, []).append(site)
+    for name, kinds in sorted(kinds_by_name.items()):
+        if len(kinds) > 1:
+            detail = "; ".join(
+                f"{kind} at {', '.join(sites)}" for kind, sites in sorted(kinds.items())
+            )
+            findings.append(
+                f"stat name {name!r} registered with conflicting types: {detail}"
+            )
+    return findings
+
+
+def main() -> int:
+    findings = lint()
+    if findings:
+        for finding in findings:
+            print(f"metrics-lint: {finding}", file=sys.stderr)
+        print(f"metrics-lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    count = sum(1 for _ in iter_registrations())
+    print(f"metrics-lint: OK ({count} literal registrations checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
