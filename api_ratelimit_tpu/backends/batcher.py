@@ -30,6 +30,8 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
+from ..limiter.cache import CacheError
+
 _CLOSE = object()
 
 
@@ -146,7 +148,11 @@ class MicroBatcher:
             t_enq = time.monotonic() if self._h_wait is not None else 0.0
             with self._direct_lock:
                 if self._closed:
-                    raise RuntimeError("batcher is closed")
+                    # CacheError, not a bare RuntimeError: a submit racing
+                    # shutdown must surface as a counted backend failure
+                    # (redis_error + a proper wire error), not an unhandled
+                    # 500 from the transport
+                    raise CacheError("batcher is closed")
                 if self._h_wait is not None:
                     self._h_wait.record((time.monotonic() - t_enq) * 1e3)
                     self._h_batch.record(count)
@@ -157,7 +163,7 @@ class MicroBatcher:
         future: Future = Future()
         with self._lock:
             if self._closed:
-                raise RuntimeError("batcher is closed")
+                raise CacheError("batcher is closed")  # see direct-mode note
             start = self._pending
             if self._block_mode:
                 self._items.append(items)

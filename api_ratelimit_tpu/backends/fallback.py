@@ -1,0 +1,255 @@
+"""Fail-open degradation ladder + circuit breaker.
+
+The reference service ships FailureModeDeny because a dead cache must
+degrade to a POLICY DECISION, not an error storm ("the request is assumed
+allowed on error", README.md:567-568). This module is that policy layer for
+every backend here: when the cache raises CacheError (sidecar transport
+exhausted its retries, breaker open and failing fast, Redis down, device
+launch failure), the service consults a FallbackLimiter instead of
+surfacing the error — see FAILURE_MODE_DENY in settings.py for the rungs:
+
+    deny      every descriptor answers OVER_LIMIT (deny-all)
+    allow     every descriptor answers OK (fail-open, the upstream default
+              posture: availability over enforcement)
+    degraded  a process-local in-memory fixed-window limiter
+              (backends/memory.py machinery) keeps APPROXIMATE enforcement:
+              per-process counts instead of the global slab, refilled
+              windows on restart — bounded error instead of none
+
+The degraded flag is sticky until the next successful primary decision, and
+is exported as the ratelimit.fallback.degraded gauge plus the /healthcheck
+body (HealthChecker.set_degraded_probe) so orchestrators can see an
+instance running on fallback policy while it keeps taking traffic.
+
+CircuitBreaker is the consecutive-failure breaker the sidecar client wraps
+around its transport (closed -> open -> half-open probe), kept here so the
+resilience primitives live in one module.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Sequence
+
+from ..limiter.base_limiter import BaseRateLimiter
+from ..models.config import RateLimit
+from ..models.descriptors import RateLimitRequest
+from ..models.response import Code, DescriptorStatus, DoLimitResponse
+from .memory import MemoryRateLimitCache
+
+logger = logging.getLogger("ratelimit.fallback")
+
+FAILURE_MODE_DENY = "deny"
+FAILURE_MODE_ALLOW = "allow"
+FAILURE_MODE_DEGRADED = "degraded"
+FAILURE_MODES = (FAILURE_MODE_DENY, FAILURE_MODE_ALLOW, FAILURE_MODE_DEGRADED)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker: closed -> open after
+    `threshold` consecutive failures; open fails fast for `reset_seconds`;
+    then ONE half-open probe is let through — success closes the breaker,
+    failure re-opens it for another reset window. threshold <= 0 disables
+    (always allows, records nothing).
+
+    on_transition(old_state, new_state) is invoked on every state change
+    (stat gauges); it must be cheap — it runs under the breaker lock.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    # numeric codes for the breaker_state gauge (gauges are ints)
+    STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(
+        self,
+        threshold: int,
+        reset_seconds: float,
+        clock=time.monotonic,
+        on_transition=None,
+    ):
+        self._threshold = int(threshold)
+        self._reset = float(reset_seconds)
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._open_until = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._threshold > 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """True when a request may proceed. While open, returns False until
+        the reset window elapses; the first caller after that becomes the
+        half-open probe (others keep failing fast until it resolves)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN and self._clock() >= self._open_until:
+                self._transition(self.HALF_OPEN)
+                self._probe_in_flight = True
+                return True
+            if self._state == self.HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._failures += 1
+            self._probe_in_flight = False
+            if self._state == self.HALF_OPEN or (
+                self._state == self.CLOSED and self._failures >= self._threshold
+            ):
+                self._open_until = self._clock() + self._reset
+                self._transition(self.OPEN)
+            elif self._state == self.OPEN:
+                # failures while open (e.g. requests racing the transition)
+                # push the probe window out — the backend is still dark
+                self._open_until = self._clock() + self._reset
+
+    def _transition(self, state: str) -> None:
+        prev, self._state = self._state, state
+        if self._on_transition is not None:
+            try:
+                self._on_transition(prev, state)
+            except Exception:  # stats must never take the breaker down
+                pass
+
+
+class FallbackLimiter:
+    """The degradation ladder the service consults on backend CacheError.
+
+    Stats (under <scope>.fallback):
+        deny / allow / local   requests answered by each rung (counters)
+        degraded               1 while running on fallback policy (gauge;
+                               sticky until the next primary success)
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        base_limiter: BaseRateLimiter | None = None,
+        scope=None,
+        local_max_keys: int = 1 << 16,
+    ):
+        if mode not in FAILURE_MODES:
+            raise ValueError(
+                f"failure mode must be one of {FAILURE_MODES}, got {mode!r}"
+            )
+        self.mode = mode
+        self._local = None
+        if mode == FAILURE_MODE_DEGRADED:
+            if base_limiter is None:
+                raise ValueError(
+                    "degraded failure mode needs a BaseRateLimiter for the "
+                    "local in-memory limiter"
+                )
+            self._local = MemoryRateLimitCache(
+                base_limiter, max_keys=local_max_keys
+            )
+        self._lock = threading.Lock()
+        self._degraded = False
+        self._reason = ""
+        self._g_degraded = None
+        self._c_deny = self._c_allow = self._c_local = None
+        if scope is not None:
+            fb = scope.scope("fallback")
+            self._g_degraded = fb.gauge("degraded")
+            self._g_degraded.set(0)
+            self._c_deny = fb.counter("deny")
+            self._c_allow = fb.counter("allow")
+            self._c_local = fb.counter("local")
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def degraded_reason(self) -> str | None:
+        """None while healthy; a short reason string while degraded — the
+        HealthChecker degraded-probe contract."""
+        with self._lock:
+            return self._reason if self._degraded else None
+
+    def note_success(self) -> None:
+        """Primary backend answered: leave the degraded state."""
+        with self._lock:
+            if not self._degraded:
+                return
+            self._degraded = False
+            self._reason = ""
+        if self._g_degraded is not None:
+            self._g_degraded.set(0)
+        logger.warning("backend recovered; leaving %s fallback", self.mode)
+
+    def do_limit(
+        self,
+        request: RateLimitRequest,
+        limits: Sequence[RateLimit | None],
+        error: Exception,
+    ) -> DoLimitResponse:
+        """Answer one request by fallback policy. Logs once per outage (on
+        the transition into degraded), not once per request — a dead
+        backend at service rates must not become a log storm."""
+        with self._lock:
+            entered = not self._degraded
+            self._degraded = True
+            self._reason = f"mode={self.mode}: {error}"
+        if self._g_degraded is not None:
+            self._g_degraded.set(1)
+        if entered:
+            logger.warning(
+                "backend error (%s); degrading to failure mode %r",
+                error,
+                self.mode,
+            )
+        if self.mode == FAILURE_MODE_DEGRADED:
+            if self._c_local is not None:
+                self._c_local.inc()
+            return self._local.do_limit(request, limits)
+        if self.mode == FAILURE_MODE_DENY:
+            if self._c_deny is not None:
+                self._c_deny.inc()
+            code = Code.OVER_LIMIT
+        else:
+            if self._c_allow is not None:
+                self._c_allow.inc()
+            code = Code.OK
+        statuses = []
+        for i in range(len(request.descriptors)):
+            limit = limits[i] if i < len(limits) else None
+            statuses.append(
+                DescriptorStatus(
+                    code=code,
+                    current_limit=limit.limit if limit is not None else None,
+                    limit_remaining=0,
+                )
+            )
+        return DoLimitResponse(descriptor_statuses=statuses)

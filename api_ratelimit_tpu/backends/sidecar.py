@@ -52,12 +52,26 @@ Transports (the address string selects one):
 
 TCP connections set TCP_NODELAY — the protocol is small length-framed RPCs
 and Nagle would add an RTT of latency to every decision.
+
+Resilience (client side): every SUBMIT runs under a per-RPC deadline
+(SIDECAR_RPC_DEADLINE, separate from SIDECAR_CONNECT_TIMEOUT), transport
+failures get bounded retries with exponential backoff + jitter
+(SIDECAR_RETRIES / SIDECAR_RETRY_BACKOFF[_MAX]), a pooled connection dying
+mid-RPC triggers ONE free redial after evicting the whole pool (a sidecar
+restart stales every pooled socket at once — paying one failed request per
+pooled socket would turn one restart into pool_size failures), and a
+consecutive-failure circuit breaker (backends/fallback.py:CircuitBreaker)
+fails fast while the sidecar is dark so frontends degrade to the
+FAILURE_MODE_DENY ladder instead of stacking up dial timeouts. Both ends
+consult an optional FaultInjector (testing/faults.py) so chaos tests can
+rehearse each of these paths deterministically.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import random
 import socket
 import ssl
 import struct
@@ -67,6 +81,7 @@ import time
 import numpy as np
 
 from ..limiter.cache import CacheError
+from .fallback import CircuitBreaker
 
 logger = logging.getLogger("ratelimit.sidecar")
 
@@ -172,8 +187,14 @@ class SlabSidecarServer:
         tls_cert: str = "",
         tls_key: str = "",
         tls_ca: str = "",
+        fault_injector=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
+
+        fault_injector: optional testing.faults.FaultInjector consulted at
+        site 'sidecar.server.submit' before each SUBMIT reaches the engine
+        (delay_ms = slow engine, error = error reply, drop = connection
+        drop without a response, partial_write = truncated response).
 
         socket_mode (unix only): filesystem mode for the socket node.
         Default 0o600 restricts to same-UID frontends; pass 0o660 and place
@@ -187,6 +208,7 @@ class SlabSidecarServer:
         tls_ca (tls only): when set, frontends must present a client
         certificate signed by this CA."""
         self._engine = engine
+        self._faults = fault_injector
         self._scheme, target = parse_sidecar_address(address)
         self._path = address
         self._tls_ctx = None
@@ -287,6 +309,21 @@ class SlabSidecarServer:
                         )
                         return
                     payload = n_raw + _recv_exact(conn, ITEM_ROWS * n * 4)
+                    if self._faults is not None:
+                        # chaos hook: the frame is fully read (so the
+                        # client's framing stays coherent), the response is
+                        # where the fault lands
+                        action = self._faults.fire("sidecar.server.submit")
+                        if action == "drop":
+                            return  # connection dies without a response
+                        if action == "error":
+                            conn.sendall(self._error("injected fault"))
+                            continue
+                        if action == "partial_write":
+                            # status byte without the counts, then close —
+                            # the client sees a mid-frame connection loss
+                            conn.sendall(b"\x00")
+                            return
                     try:
                         if getattr(self._engine, "block_mode", False):
                             # block-native engine: the wire block IS the
@@ -303,6 +340,15 @@ class SlabSidecarServer:
                             b"\x00" + _U32.pack(len(out)) + out.tobytes()
                         )
                     except Exception as e:  # noqa: BLE001 - surface to client
+                        if self._stop.is_set():
+                            # shutting down: let the connection die instead
+                            # of answering with an error reply. A transport
+                            # failure is safely retryable (the closed
+                            # engine never executed the batch), so a
+                            # restarting sidecar costs clients a redial
+                            # instead of a failed request; an error reply
+                            # is never retried.
+                            return
                         logger.exception("sidecar submit failed")
                         conn.sendall(self._error(str(e)))
         except (ConnectionError, OSError):
@@ -351,6 +397,15 @@ class SidecarEngineClient:
         tls_key: str = "",
         tls_server_name: str = "",
         scope=None,
+        connect_timeout: float | None = None,
+        rpc_deadline: float | None = None,
+        retries: int = 2,
+        retry_backoff: float = 0.01,
+        retry_backoff_max: float = 0.25,
+        breaker_threshold: int = 5,
+        breaker_reset: float = 5.0,
+        fault_injector=None,
+        sleep=time.sleep,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
         tls_ca: CA bundle the server cert must chain to (defaults to the
@@ -361,16 +416,70 @@ class SidecarEngineClient:
 
         scope: optional stats Scope; records <scope>.sidecar.rpc_ms — the
         frontend-side SUBMIT round trip (socket + the sidecar's own
-        batcher/device stages), the frontend's analog of the in-process
-        launch+readback histograms."""
-        self._h_rpc = (
-            scope.scope("sidecar").histogram("rpc_ms")
-            if scope is not None
-            else None
-        )
+        batcher/device stages) — plus the resilience stats:
+        <scope>.sidecar.{retry,redial,breaker_open} counters and the
+        <scope>.sidecar.breaker_state gauge (0 closed / 1 half-open /
+        2 open).
+
+        connect_timeout / rpc_deadline: dial timeout vs per-RPC deadline
+        (send + full response read). Both default to the legacy `timeout`
+        so existing callers keep one-knob behavior; SIDECAR_CONNECT_TIMEOUT
+        and SIDECAR_RPC_DEADLINE set them separately in production.
+
+        retries / retry_backoff / retry_backoff_max: bounded retries for
+        TRANSPORT-level failures (dial errors, resets, deadline expiry)
+        with exponential backoff + full jitter. Error REPLIES from the
+        sidecar are application-level and never retried (the engine may
+        have applied the increment). Independent of the retry budget, a
+        POOLED connection that dies mid-RPC gets one free redial after
+        evicting the whole pool: a sidecar restart stales every pooled
+        socket at once, and the redial makes that restart cost zero failed
+        requests instead of pool_size.
+
+        breaker_threshold / breaker_reset: consecutive transport failures
+        that open the circuit, and the open->half-open probe delay.
+        threshold 0 disables the breaker. While open, submit() fails fast
+        with CacheError (no dialing) so the service's FAILURE_MODE_DENY
+        ladder answers instead of every request eating a timeout.
+
+        fault_injector: optional testing.faults.FaultInjector; consulted at
+        'sidecar.dial' per dial and 'sidecar.submit' per SUBMIT attempt."""
+        self._h_rpc = None
+        self._c_retry = self._c_redial = self._c_breaker_open = None
+        self._g_breaker_state = None
+        if scope is not None:
+            sc = scope.scope("sidecar")
+            self._h_rpc = sc.histogram("rpc_ms")
+            self._c_retry = sc.counter("retry")
+            self._c_redial = sc.counter("redial")
+            self._c_breaker_open = sc.counter("breaker_open")
+            self._g_breaker_state = sc.gauge("breaker_state")
+            self._g_breaker_state.set(0)
         self._path = address
         self._scheme, self._target = parse_sidecar_address(address)
         self._timeout = timeout
+        self._connect_timeout = (
+            timeout if connect_timeout is None else float(connect_timeout)
+        )
+        self._rpc_deadline = (
+            timeout if rpc_deadline is None else float(rpc_deadline)
+        )
+        self._retries = max(0, int(retries))
+        self._retry_backoff = max(0.0, float(retry_backoff))
+        self._retry_backoff_max = max(
+            self._retry_backoff, float(retry_backoff_max)
+        )
+        self._breaker_reset = float(breaker_reset)
+        self._breaker = CircuitBreaker(
+            breaker_threshold,
+            breaker_reset,
+            on_transition=self._on_breaker_transition,
+        )
+        self._faults = fault_injector
+        self._sleep = sleep
+        # full jitter over the exponential backoff: concurrent frontend
+        # threads retrying a restarted sidecar must not re-dial in lockstep
+        self._jitter = random.Random()
         self._tls_ctx = None
         self._tls_server_name = tls_server_name
         if self._scheme == "tls":
@@ -386,6 +495,8 @@ class SidecarEngineClient:
         # fail fast like the reference's startup PING (driver_impl.go:124-128).
         # The read is part of the check: under TLS 1.3 a rejected client
         # certificate only surfaces on the first read after the handshake.
+        # Deliberately not retried and not breaker-counted — a frontend
+        # booting against a dark sidecar should fail its boot loudly.
         conn = self._dial()
         try:
             conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
@@ -398,10 +509,36 @@ class SidecarEngineClient:
             raise CacheError(f"sidecar ping failed on {address}")
         self._release(conn)
 
+    def _on_breaker_transition(self, prev: str, state: str) -> None:
+        if self._g_breaker_state is not None:
+            self._g_breaker_state.set(CircuitBreaker.STATE_CODES[state])
+        if state == CircuitBreaker.OPEN:
+            if self._c_breaker_open is not None:
+                self._c_breaker_open.inc()
+            logger.warning(
+                "sidecar circuit OPEN on %s: failing fast for %.3fs",
+                self._path,
+                self._breaker_reset,
+            )
+        elif state == CircuitBreaker.CLOSED and prev != CircuitBreaker.CLOSED:
+            logger.info("sidecar circuit closed on %s", self._path)
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """The transport circuit breaker (tests/debug observability)."""
+        return self._breaker
+
     def _dial(self) -> socket.socket:
+        if self._faults is not None:
+            action = self._faults.fire("sidecar.dial")
+            if action is not None:
+                raise CacheError(
+                    f"cannot reach slab sidecar at {self._path}: "
+                    f"injected fault: {action}"
+                )
         if self._scheme == "unix":
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            conn.settimeout(self._timeout)
+            conn.settimeout(self._connect_timeout)
             try:
                 conn.connect(self._target)
             except OSError as e:
@@ -409,9 +546,12 @@ class SidecarEngineClient:
                 raise CacheError(
                     f"cannot reach slab sidecar at {self._path}: {e}"
                 )
+            conn.settimeout(self._rpc_deadline)
             return conn
         try:
-            conn = socket.create_connection(self._target, timeout=self._timeout)
+            conn = socket.create_connection(
+                self._target, timeout=self._connect_timeout
+            )
         except OSError as e:
             raise CacheError(f"cannot reach slab sidecar at {self._path}: {e}")
         try:
@@ -424,13 +564,17 @@ class SidecarEngineClient:
         except OSError as e:
             conn.close()
             raise CacheError(f"sidecar TLS handshake failed on {self._path}: {e}")
+        conn.settimeout(self._rpc_deadline)
         return conn
 
-    def _acquire(self) -> socket.socket:
+    def _acquire(self) -> tuple[socket.socket, bool]:
+        """(connection, came_from_pool). The pooled flag drives the free
+        redial: only an IDLE-STALE socket qualifies (a fresh dial that dies
+        mid-RPC is a live failure, not a restart artifact)."""
         with self._pool_lock:
             if self._pool:
-                return self._pool.pop()
-        return self._dial()
+                return self._pool.pop(), True
+        return self._dial(), False
 
     def _release(self, conn: socket.socket) -> None:
         with self._pool_lock:
@@ -439,32 +583,93 @@ class SidecarEngineClient:
                 return
         conn.close()
 
+    def _evict_pool(self) -> None:
+        """Close every pooled connection. Called on the first detected
+        stale-socket death (ECONNRESET/EPIPE on a pooled conn): a sidecar
+        restart stales the WHOLE pool, and evicting it all at once keeps
+        one detected restart from becoming pool_size serial failures."""
+        with self._pool_lock:
+            stale, self._pool = self._pool, []
+        for conn in stale:
+            conn.close()
+
+    def _backoff(self, attempt: int) -> float:
+        """Exponential backoff with full jitter for retry `attempt` (1-based)."""
+        ceiling = min(
+            self._retry_backoff_max,
+            self._retry_backoff * (2 ** (attempt - 1)),
+        )
+        return self._jitter.uniform(0.0, ceiling)
+
     def submit(self, items) -> list[int]:
         if not items:
             return []
         t0 = time.perf_counter() if self._h_rpc is not None else 0.0
-        conn = self._acquire()
-        try:
-            conn.sendall(
-                _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+        if not self._breaker.allow():
+            raise CacheError(
+                f"sidecar circuit open on {self._path}: failing fast"
             )
-            status = _recv_exact(conn, 1)
-            if status == b"\x01":
-                (ln,) = _U32.unpack(_recv_exact(conn, _U32.size))
-                message = _recv_exact(conn, ln).decode()
-                self._release(conn)
-                raise CacheError(f"sidecar error: {message}")
-            (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
-            out = np.frombuffer(_recv_exact(conn, 4 * n), dtype=np.uint32)
+        request = _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+        attempt = 0
+        redialed = False
+        while True:
+            try:
+                conn, pooled = self._acquire()
+            except CacheError as e:
+                # dial failure: transport-level, retried under the budget
+                attempt += 1
+                if attempt > self._retries:
+                    self._breaker.record_failure()
+                    raise
+                if self._c_retry is not None:
+                    self._c_retry.inc()
+                self._sleep(self._backoff(attempt))
+                continue
+            try:
+                if self._faults is not None:
+                    action = self._faults.fire("sidecar.submit")
+                    if action is not None:
+                        raise ConnectionError(f"injected fault: {action}")
+                conn.sendall(request)
+                status = _recv_exact(conn, 1)
+                if status == b"\x01":
+                    (ln,) = _U32.unpack(_recv_exact(conn, _U32.size))
+                    message = _recv_exact(conn, ln).decode()
+                    self._release(conn)
+                    # an error REPLY rode a healthy transport: application-
+                    # level, never retried (the increment may have been
+                    # applied), resets the breaker's failure streak
+                    self._breaker.record_success()
+                    raise CacheError(f"sidecar error: {message}")
+                (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
+                out = np.frombuffer(_recv_exact(conn, 4 * n), dtype=np.uint32)
+            except CacheError:
+                raise
+            except (OSError, ConnectionError) as e:
+                conn.close()
+                if pooled and not redialed:
+                    # idle-stale pooled socket (sidecar restart signature):
+                    # the whole pool is stale — evict it and redial once for
+                    # free, outside the retry budget, so a restart costs
+                    # zero failed requests
+                    redialed = True
+                    self._evict_pool()
+                    if self._c_redial is not None:
+                        self._c_redial.inc()
+                    continue
+                attempt += 1
+                if attempt > self._retries:
+                    self._breaker.record_failure()
+                    raise CacheError(f"sidecar transport failure: {e}") from e
+                if self._c_retry is not None:
+                    self._c_retry.inc()
+                self._sleep(self._backoff(attempt))
+                continue
             self._release(conn)
+            self._breaker.record_success()
             if self._h_rpc is not None:
                 self._h_rpc.record((time.perf_counter() - t0) * 1e3)
             return out.tolist()
-        except CacheError:
-            raise
-        except (OSError, ConnectionError) as e:
-            conn.close()
-            raise CacheError(f"sidecar transport failure: {e}") from e
 
     def flush(self) -> None:
         pass  # submits are synchronous end to end
@@ -477,7 +682,9 @@ class SidecarEngineClient:
             self._pool.clear()
 
 
-def new_sidecar_cache_from_settings(settings, base_limiter, stats_scope=None):
+def new_sidecar_cache_from_settings(
+    settings, base_limiter, stats_scope=None, fault_injector=None
+):
     """BACKEND_TYPE=tpu-sidecar factory: a TpuRateLimitCache whose device
     driver is the remote sidecar (runner.py backend switch)."""
     from .tpu import TpuRateLimitCache
@@ -491,5 +698,13 @@ def new_sidecar_cache_from_settings(settings, base_limiter, stats_scope=None):
             tls_key=settings.sidecar_tls_key,
             tls_server_name=settings.sidecar_tls_server_name,
             scope=stats_scope,
+            connect_timeout=settings.sidecar_connect_timeout,
+            rpc_deadline=settings.sidecar_rpc_deadline,
+            retries=settings.sidecar_retries,
+            retry_backoff=settings.sidecar_retry_backoff,
+            retry_backoff_max=settings.sidecar_retry_backoff_max,
+            breaker_threshold=settings.sidecar_breaker_threshold,
+            breaker_reset=settings.sidecar_breaker_reset,
+            fault_injector=fault_injector,
         ),
     )

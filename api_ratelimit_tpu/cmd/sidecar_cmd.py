@@ -78,6 +78,21 @@ def main() -> None:
         scope=scope,
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
+
+    # FAULT_INJECT chaos hook (site sidecar.server.submit): lets staging
+    # rehearse slow-engine / error-reply / dropped-connection behavior on
+    # the device-owner side; junk specs fail the boot here.
+    fault_injector = None
+    fault_rules = settings.fault_rules()
+    if fault_rules:
+        from ..testing.faults import FaultInjector
+
+        fault_injector = FaultInjector(
+            fault_rules, seed=settings.fault_inject_seed
+        )
+        logger.warning(
+            "FAULT_INJECT active (%d rule(s)) — chaos mode", len(fault_rules)
+        )
     debug = new_debug_server(
         "",
         settings.debug_port,
@@ -93,6 +108,7 @@ def main() -> None:
         tls_cert=settings.sidecar_tls_cert,
         tls_key=settings.sidecar_tls_key,
         tls_ca=settings.sidecar_tls_ca,
+        fault_injector=fault_injector,
     )
 
     stop = threading.Event()

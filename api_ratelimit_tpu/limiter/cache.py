@@ -4,6 +4,14 @@ Reference parity: src/limiter/cache.go:15-33. A nil/None limit means the
 descriptor is unchecked. flush() joins asynchronous work (used by tests and
 by backends that settle asynchronously, like the reference memcache backend
 and this framework's micro-batched TPU backend).
+
+Failure contract: a backend signals ANY failure by raising CacheError —
+transport exhausted its retries, circuit breaker open, device launch
+failure, closed batcher. That single typed channel is what the service's
+FAILURE_MODE_DENY degradation ladder keys off (backends/fallback.py):
+with a ladder configured the error becomes a policy decision (deny-all /
+fail-open / degraded local limiting) instead of a wire error, so backends
+must never let raw OSErrors or RuntimeErrors escape do_limit.
 """
 
 from __future__ import annotations

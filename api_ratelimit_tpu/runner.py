@@ -82,12 +82,17 @@ def setup_logging(settings: Settings) -> None:
 
 
 def create_limiter(
-    settings: Settings, base: BaseRateLimiter, stats_store: Store
+    settings: Settings,
+    base: BaseRateLimiter,
+    stats_store: Store,
+    fault_injector=None,
 ) -> RateLimitCache:
     """BackendType switch (runner.go:43-64). The TPU backends get the
     `ratelimit` scope so the per-stage pipeline histograms
     (batcher.queue_wait_ms, device.{pack,launch,readback}_ms,
-    sidecar.rpc_ms) land in the same store /metrics scrapes."""
+    sidecar.rpc_ms) land in the same store /metrics scrapes.
+    fault_injector (FAULT_INJECT) reaches the sidecar client's chaos
+    sites."""
     backend = settings.backend_type
     scope = stats_store.scope("ratelimit")
     if backend == "tpu":
@@ -113,7 +118,9 @@ def create_limiter(
     if backend == "tpu-sidecar":
         from .backends.sidecar import new_sidecar_cache_from_settings
 
-        return new_sidecar_cache_from_settings(settings, base, stats_scope=scope)
+        return new_sidecar_cache_from_settings(
+            settings, base, stats_scope=scope, fault_injector=fault_injector
+        )
     if backend == "memory":
         return MemoryRateLimitCache(base)
     if backend == "redis":
@@ -144,6 +151,8 @@ class Runner:
         self.service: RateLimitService | None = None
         self.runtime: DirectoryRuntimeLoader | None = None
         self.tracer = None
+        self.fallback = None
+        self.fault_injector = None
         self._ready = threading.Event()
 
     def get_stats_store(self) -> Store:
@@ -196,7 +205,26 @@ class Runner:
             local_cache=local_cache,
             near_limit_ratio=settings.near_limit_ratio,
         )
-        cache = create_limiter(settings, base, self.stats_store)
+
+        # Fault injector (FAULT_INJECT) — chaos rehearsal for the
+        # resilience ladder; a junk spec fails the boot here, like a junk
+        # bucket ladder.
+        self.fault_injector = None
+        fault_rules = settings.fault_rules()
+        if fault_rules:
+            from .testing.faults import FaultInjector
+
+            self.fault_injector = FaultInjector(
+                fault_rules, seed=settings.fault_inject_seed
+            )
+            logger.warning(
+                "FAULT_INJECT active (%d rule(s)) — chaos mode",
+                len(fault_rules),
+            )
+
+        cache = create_limiter(
+            settings, base, self.stats_store, self.fault_injector
+        )
 
         # Slab health gauges (ratelimit.slab.*) for engines that expose a
         # snapshot — the in-process single-chip and mesh-sharded engines do;
@@ -217,6 +245,22 @@ class Runner:
             watcher=settings.runtime_watcher,
             safety_rescan_seconds=settings.runtime_safety_rescan,
         )
+        # Degradation ladder (FAILURE_MODE_DENY): when configured, backend
+        # CacheErrors degrade to a policy decision (deny / fail-open /
+        # local in-memory limiting) and /healthcheck reports the degraded
+        # state in its body while staying 200 (fallback.py rationale).
+        self.fallback = None
+        failure_mode = settings.failure_mode()
+        if failure_mode is not None:
+            from .backends.fallback import FallbackLimiter
+
+            self.fallback = FallbackLimiter(
+                failure_mode, base_limiter=base, scope=self.scope
+            )
+            self.server.health.set_degraded_probe(
+                self.fallback.degraded_reason
+            )
+
         self.service = RateLimitService(
             runtime=self.runtime,
             cache=cache,
@@ -224,6 +268,7 @@ class Runner:
             time_source=RealTimeSource(),
             runtime_watch_root=settings.runtime_watch_root,
             max_sleeping_routines=settings.max_sleeping_routines,
+            fallback=self.fallback,
         )
 
         def dump_config() -> str:

@@ -36,6 +36,17 @@ class HealthChecker:
         self._cond = threading.Condition()
         self._version = 0  # bumped per transition; lets Watch detect changes
         self._watchers = 0
+        self._degraded_probe = None
+
+    def set_degraded_probe(self, probe) -> None:
+        """probe() -> None while the backend is healthy, or a short reason
+        string while the service is running on the FAILURE_MODE_DENY
+        fallback ladder (backends/fallback.py). Degradation is reported in
+        the /healthcheck BODY only — the status stays 200 and gRPC stays
+        SERVING, because a degraded fail-open instance must keep taking
+        traffic (draining it would turn a backend outage into a serving
+        outage, the exact storm the ladder exists to prevent)."""
+        self._degraded_probe = probe
 
     def ok(self) -> bool:
         with self._cond:
@@ -127,4 +138,12 @@ class HealthChecker:
     # -- HTTP surface (handler contract used by http_server) --
 
     def http_response(self) -> tuple[int, str]:
-        return (200, "OK") if self.ok() else (500, "")
+        if not self.ok():
+            return (500, "")
+        probe = self._degraded_probe
+        reason = probe() if probe is not None else None
+        if reason:
+            # body keeps the "OK" prefix so checkers that string-match the
+            # healthy body keep passing; orchestrators see the suffix
+            return (200, f"OK (degraded: {reason})")
+        return (200, "OK")

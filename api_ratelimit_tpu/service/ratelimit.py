@@ -84,9 +84,16 @@ class RateLimitService:
         max_sleeping_routines: int = 0,
         config_loader: Callable[[list[ConfigFile]], RateLimitConfig] | None = None,
         report_detail_sampler: Sampler | None = None,
+        fallback=None,
     ):
+        """fallback: optional backends.fallback.FallbackLimiter — the
+        FAILURE_MODE_DENY degradation ladder. When set, a backend
+        CacheError no longer propagates: redis_error is still counted, and
+        the fallback answers the request (deny-all / fail-open / degraded
+        local limiting). None keeps the legacy raise-through behavior."""
         self._runtime = runtime
         self._cache = cache
+        self._fallback = fallback
         self._stats = _ServiceStats(stats_scope)
         # per-rule stats live under <scope>.rate_limit.<domain>.<composite>
         self._rl_stats_scope = stats_scope.scope("rate_limit")
@@ -242,7 +249,26 @@ class RateLimitService:
                 sleep_on_throttle = sleep_on_throttle or limit.sleep_on_throttle
                 report_details = report_details or limit.report_details
 
-        do_limit_response = self._cache.do_limit(request, limits)
+        try:
+            do_limit_response = self._cache.do_limit(request, limits)
+        except CacheError as e:
+            # Degradation ladder (FAILURE_MODE_DENY): a dead backend — or
+            # the sidecar breaker failing fast while open — degrades to a
+            # policy decision instead of an error storm. redis_error is
+            # counted HERE because the exception no longer reaches the
+            # boundary counter in should_rate_limit.
+            if self._fallback is None:
+                raise
+            self._stats.redis_error.add(1)
+            span = active_span()
+            if span is not None:
+                span.log_kv(
+                    event="fallback", failure_mode=self._fallback.mode
+                )
+            do_limit_response = self._fallback.do_limit(request, limits, e)
+        else:
+            if self._fallback is not None:
+                self._fallback.note_success()
         assert_(len(limits) == len(do_limit_response.descriptor_statuses))
 
         if sleep_on_throttle and do_limit_response.throttle_millis > 0:

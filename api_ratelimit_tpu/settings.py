@@ -118,6 +118,32 @@ class Settings:
     sidecar_tls_key: str = ""
     sidecar_tls_ca: str = ""
     sidecar_tls_server_name: str = ""
+    # --- resilience ladder (this framework; FAILURE_MODE_DENY keeps the
+    # upstream knob name) ---
+    # What the service answers when the backend raises CacheError (dead
+    # sidecar, open breaker, Redis down). Boolean values keep the upstream
+    # meaning — true = deny-all, false = fail-open (return OK, count
+    # redis_error) — plus "degraded": a process-local in-memory
+    # fixed-window limiter keeps approximate enforcement for the outage.
+    # Empty (the default) preserves the legacy behavior: the error
+    # propagates to the transport as a wire error.
+    failure_mode_deny: str = ""
+    # sidecar client hardening: dial timeout vs per-RPC deadline, bounded
+    # transport retries (exponential backoff + full jitter), and the
+    # consecutive-failure circuit breaker (threshold 0 disables; reset is
+    # the open -> half-open probe delay). Durations accept Go strings.
+    sidecar_connect_timeout: float = 5.0
+    sidecar_rpc_deadline: float = 30.0
+    sidecar_retries: int = 2
+    sidecar_retry_backoff: float = 0.01
+    sidecar_retry_backoff_max: float = 0.25
+    sidecar_breaker_threshold: int = 5
+    sidecar_breaker_reset: float = 5.0
+    # fault injection (testing/faults.py): comma-separated
+    # site:kind:value rules, e.g.
+    # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
+    fault_inject: str = ""
+    fault_inject_seed: int = 0
 
     def latency_buckets(self) -> tuple[float, ...] | None:
         """Parsed METRICS_LATENCY_BUCKETS_MS, or None for the default.
@@ -135,6 +161,38 @@ class Settings:
                 f"got {raw!r}"
             )
         return buckets
+
+    def failure_mode(self) -> str | None:
+        """Parsed FAILURE_MODE_DENY: None (empty — legacy raise-through),
+        'deny', 'allow', or 'degraded'. Upstream boolean values keep their
+        meaning (true = deny-all, false = fail-open); junk fails the boot
+        like latency_buckets() does."""
+        v = self.failure_mode_deny.strip().lower()
+        if v == "":
+            return None
+        if v in ("1", "t", "true", "yes", "on", "deny"):
+            return "deny"
+        if v in ("0", "f", "false", "no", "off", "allow"):
+            return "allow"
+        if v == "degraded":
+            return "degraded"
+        raise ValueError(
+            f"FAILURE_MODE_DENY must be a boolean, 'degraded', or empty, "
+            f"got {self.failure_mode_deny!r}"
+        )
+
+    def fault_rules(self):
+        """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
+        ValueError on junk — a typo'd chaos spec must fail the boot, not
+        silently inject nothing."""
+        from .testing.faults import parse_fault_spec
+
+        try:
+            return parse_fault_spec(self.fault_inject)
+        except ValueError as e:
+            raise ValueError(
+                f"bad env var FAULT_INJECT={self.fault_inject!r}: {e}"
+            ) from e
 
 
 _FIELD_ENV: list[tuple[str, str, Callable]] = [
@@ -197,6 +255,20 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("sidecar_tls_key", "SIDECAR_TLS_KEY", str),
     ("sidecar_tls_ca", "SIDECAR_TLS_CA", str),
     ("sidecar_tls_server_name", "SIDECAR_TLS_SERVER_NAME", str),
+    ("failure_mode_deny", "FAILURE_MODE_DENY", str),
+    ("sidecar_connect_timeout", "SIDECAR_CONNECT_TIMEOUT", _parse_duration_seconds),
+    ("sidecar_rpc_deadline", "SIDECAR_RPC_DEADLINE", _parse_duration_seconds),
+    ("sidecar_retries", "SIDECAR_RETRIES", int),
+    ("sidecar_retry_backoff", "SIDECAR_RETRY_BACKOFF", _parse_duration_seconds),
+    (
+        "sidecar_retry_backoff_max",
+        "SIDECAR_RETRY_BACKOFF_MAX",
+        _parse_duration_seconds,
+    ),
+    ("sidecar_breaker_threshold", "SIDECAR_BREAKER_THRESHOLD", int),
+    ("sidecar_breaker_reset", "SIDECAR_BREAKER_RESET", _parse_duration_seconds),
+    ("fault_inject", "FAULT_INJECT", str),
+    ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]
 
 

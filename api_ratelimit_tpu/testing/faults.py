@@ -1,0 +1,163 @@
+"""Deterministic fault-injection harness (FAULT_INJECT).
+
+Chaos testing for the resilience ladder: an env-configurable injector that
+the sidecar client and server consult at named sites, so tests (and
+operators in staging) can rehearse connection drops, latency spikes, error
+replies, and partial writes without real infrastructure failures. The
+reference gets the equivalent coverage from live fakes (miniredis, stunnel
+kill -9 in integration_test.go); here the injector makes every failure
+deterministic and seedable.
+
+Spec grammar (FAULT_INJECT env var; FAULT_INJECT_SEED seeds the RNG):
+
+    spec  := rule ("," rule)*
+    rule  := site ":" kind ":" value
+    site  := dotted lowercase id (the instrumentation point)
+    kind  := error | drop | partial_write     value = probability in (0, 1]
+           | delay_ms                         value = milliseconds >= 0
+
+e.g. FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
+
+delay_ms rules always fire (they model a slow link / slow engine, and sum
+when repeated); the probabilistic kinds are evaluated in spec order and the
+first one that trips wins. Junk specs raise ValueError so a typo'd spec
+fails the boot (settings.fault_rules()), like a typo'd bucket ladder.
+
+Sites wired in this codebase (backends/sidecar.py):
+
+    sidecar.dial            client: each dial of the sidecar address
+    sidecar.submit          client: each SUBMIT attempt (before the send)
+    sidecar.server.submit   server: each SUBMIT frame (before the engine)
+
+The injector is mutable at runtime (configure()/clear()) so chaos tests can
+clear faults mid-scenario — e.g. to watch a circuit breaker's half-open
+probe succeed once the outage "ends".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import re
+import threading
+import time
+
+FAULT_KINDS = ("error", "drop", "partial_write", "delay_ms")
+_PROB_KINDS = ("error", "drop", "partial_write")
+
+_SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class FaultRule:
+    site: str
+    kind: str
+    value: float
+
+
+def parse_fault_spec(spec: str) -> list[FaultRule]:
+    """Parse a FAULT_INJECT spec; raises ValueError on any malformed rule
+    (a junk spec must fail boot, not silently inject nothing)."""
+    rules: list[FaultRule] = []
+    spec = spec.strip()
+    if not spec:
+        return rules
+    for chunk in spec.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = [p.strip() for p in chunk.split(":")]
+        if len(parts) != 3:
+            raise ValueError(
+                f"fault rule {chunk!r} must be site:kind:value"
+            )
+        site, kind, raw = parts
+        if not _SITE_RE.match(site):
+            raise ValueError(
+                f"fault rule {chunk!r}: site must be dotted lowercase "
+                f"([a-z0-9_] segments joined by '.')"
+            )
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault rule {chunk!r}: kind must be one of "
+                f"{', '.join(FAULT_KINDS)}"
+            )
+        try:
+            value = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"fault rule {chunk!r}: value {raw!r} is not a number"
+            ) from None
+        if kind in _PROB_KINDS and not 0.0 < value <= 1.0:
+            raise ValueError(
+                f"fault rule {chunk!r}: {kind} probability must be in (0, 1]"
+            )
+        if kind == "delay_ms" and value < 0:
+            raise ValueError(
+                f"fault rule {chunk!r}: delay_ms must be >= 0"
+            )
+        rules.append(FaultRule(site, kind, value))
+    return rules
+
+
+class FaultInjector:
+    """Evaluates fault rules at named sites. Thread-safe; deterministic for
+    a given seed and fire() sequence. fire() sleeps for matched delay_ms
+    rules, then returns the first probabilistic action that trips
+    ('error' | 'drop' | 'partial_write') or None."""
+
+    def __init__(self, rules=(), seed: int = 0, sleep=time.sleep):
+        self._lock = threading.Lock()
+        self._sleep = sleep
+        self._seed = int(seed)
+        self._fired: dict[str, int] = {}
+        self.configure(rules)
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0, sleep=time.sleep):
+        return cls(parse_fault_spec(spec), seed=seed, sleep=sleep)
+
+    def configure(self, rules) -> None:
+        """Replace the active rule set (a string spec or parsed rules) and
+        re-seed the RNG, so every configure() starts a reproducible run."""
+        if isinstance(rules, str):
+            rules = parse_fault_spec(rules)
+        by_site: dict[str, list[FaultRule]] = {}
+        for rule in rules:
+            by_site.setdefault(rule.site, []).append(rule)
+        with self._lock:
+            self._by_site = by_site
+            self._rng = random.Random(self._seed)
+
+    def clear(self) -> None:
+        self.configure(())
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return bool(self._by_site)
+
+    def fired(self) -> dict[str, int]:
+        """Cumulative '<site>:<kind>' trip counts (tests/debugging);
+        survives configure()/clear() so a scenario can count across
+        phases."""
+        with self._lock:
+            return dict(self._fired)
+
+    def fire(self, site: str) -> str | None:
+        delay_ms = 0.0
+        action: str | None = None
+        with self._lock:
+            for rule in self._by_site.get(site, ()):
+                if rule.kind == "delay_ms":
+                    delay_ms += rule.value
+                elif action is None and self._rng.random() < rule.value:
+                    action = rule.kind
+            if delay_ms > 0:
+                key = f"{site}:delay_ms"
+                self._fired[key] = self._fired.get(key, 0) + 1
+            if action is not None:
+                key = f"{site}:{action}"
+                self._fired[key] = self._fired.get(key, 0) + 1
+        if delay_ms > 0:
+            self._sleep(delay_ms / 1e3)
+        return action

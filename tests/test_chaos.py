@@ -1,0 +1,698 @@
+"""Chaos suite: the resilience ladder under injected faults.
+
+Drives testing/faults.py (the FAULT_INJECT harness) against the sidecar
+client/server and the service-level FAILURE_MODE_DENY degradation ladder
+(backends/fallback.py): transient-fault retry absorption, free redial
+across a sidecar restart (zero failed requests), per-RPC deadline expiry
+against a slow engine, the breaker's closed -> open -> half-open -> closed
+cycle, and each failure-mode rung. Every scenario is deterministic: faults
+fire at probability 1.0 or from a seeded RNG, and backoffs use injected
+sleeps where wall time doesn't matter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from api_ratelimit_tpu.backends.fallback import (
+    FAILURE_MODE_ALLOW,
+    FAILURE_MODE_DEGRADED,
+    FAILURE_MODE_DENY,
+    CircuitBreaker,
+    FallbackLimiter,
+)
+from api_ratelimit_tpu.backends.sidecar import (
+    SidecarEngineClient,
+    SlabSidecarServer,
+)
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+from api_ratelimit_tpu.limiter.cache import CacheError
+from api_ratelimit_tpu.models import Code, Descriptor, RateLimitRequest
+from api_ratelimit_tpu.service import RateLimitService
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.testing.faults import FaultInjector, parse_fault_spec
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+def _make_engine(ts):
+    return SlabDeviceEngine(
+        time_source=ts,
+        n_slots=1 << 12,
+        buckets=(128, 1024),
+        max_batch=1024,
+        use_pallas=False,
+        block_mode=True,  # the production sidecar server runs block-native
+    )
+
+
+def _item(fp=7):
+    return [_Item(fp=fp, hits=1, limit=1_000_000, divider=60, jitter=0)]
+
+
+def _client(address, faults=None, **kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("retry_backoff", 0.001)
+    kw.setdefault("retry_backoff_max", 0.005)
+    kw.setdefault("breaker_threshold", 0)
+    return SidecarEngineClient(address, fault_injector=faults, **kw)
+
+
+@pytest.fixture
+def sidecar_tcp():
+    ts = FakeTimeSource(1_000_000)
+    server = SlabSidecarServer("tcp://127.0.0.1:0", _make_engine(ts))
+    yield server, f"tcp://127.0.0.1:{server.port}"
+    server.close()
+
+
+class TestFaultInjectorUnit:
+    def test_deterministic_for_a_seed(self):
+        rules = parse_fault_spec("x.y:error:0.5")
+        a = FaultInjector(rules, seed=42)
+        b = FaultInjector(rules, seed=42)
+        seq_a = [a.fire("x.y") for _ in range(50)]
+        seq_b = [b.fire("x.y") for _ in range(50)]
+        assert seq_a == seq_b
+        assert "error" in seq_a and None in seq_a  # 0.5 actually mixes
+
+    def test_delay_rules_sleep_and_sum(self):
+        slept = []
+        inj = FaultInjector(
+            parse_fault_spec("s:delay_ms:200,s:delay_ms:300"),
+            sleep=slept.append,
+        )
+        assert inj.fire("s") is None
+        assert slept == [0.5]
+        assert inj.fired() == {"s:delay_ms": 1}
+
+    def test_unmatched_site_is_free(self):
+        inj = FaultInjector(parse_fault_spec("a.b:error:1.0"))
+        assert inj.fire("other.site") is None
+
+    def test_configure_and_clear_at_runtime(self):
+        inj = FaultInjector()
+        assert not inj.enabled()
+        inj.configure("s:error:1.0")
+        assert inj.enabled() and inj.fire("s") == "error"
+        inj.clear()
+        assert not inj.enabled() and inj.fire("s") is None
+        assert inj.fired() == {"s:error": 1}  # counts survive clear()
+
+
+class TestCircuitBreakerUnit:
+    def _breaker(self, threshold=3, reset=10.0):
+        clock = FakeTimeSource(100)
+        transitions = []
+        breaker = CircuitBreaker(
+            threshold,
+            reset,
+            clock=lambda: clock.now,
+            on_transition=lambda a, b: transitions.append((a, b)),
+        )
+        return breaker, clock, transitions
+
+    def test_opens_after_consecutive_failures_only(self):
+        breaker, _, transitions = self._breaker(threshold=3)
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.record_success()  # streak broken
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.CLOSED
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        assert transitions == [("closed", "open")]
+
+    def test_open_fails_fast_then_half_open_probe_closes(self):
+        breaker, clock, _ = self._breaker(threshold=1, reset=10.0)
+        breaker.record_failure()
+        assert not breaker.allow()  # open: fail fast
+        clock.advance(11)
+        assert breaker.allow()  # this caller is the half-open probe
+        assert breaker.state == CircuitBreaker.HALF_OPEN
+        assert not breaker.allow()  # others fail fast while probing
+        breaker.record_success()
+        assert breaker.state == CircuitBreaker.CLOSED
+        assert breaker.allow()
+
+    def test_failed_probe_reopens(self):
+        breaker, clock, _ = self._breaker(threshold=1, reset=10.0)
+        breaker.record_failure()
+        clock.advance(11)
+        assert breaker.allow()
+        breaker.record_failure()  # probe failed
+        assert breaker.state == CircuitBreaker.OPEN
+        assert not breaker.allow()
+        clock.advance(11)
+        assert breaker.allow()  # next probe window
+
+    def test_threshold_zero_disables(self):
+        breaker = CircuitBreaker(0, 1.0)
+        for _ in range(10):
+            breaker.record_failure()
+        assert breaker.allow()
+        assert breaker.state == CircuitBreaker.CLOSED
+
+
+class _NShotFaults(FaultInjector):
+    """Fires the configured fault only for the first `n` trips — the
+    transient-glitch shape (network blip, not an outage)."""
+
+    def __init__(self, spec, n, seed=0):
+        super().__init__(parse_fault_spec(spec), seed=seed)
+        self._remaining = n
+
+    def fire(self, site):
+        if self._remaining <= 0:
+            return None
+        action = super().fire(site)
+        if action is not None:
+            self._remaining -= 1
+        return action
+
+
+class TestSidecarRetries:
+    def test_transient_fault_absorbed_by_retry(self, sidecar_tcp, test_store):
+        """One injected transport glitch must cost zero failed requests."""
+        _, address = sidecar_tcp
+        store, _ = test_store
+        faults = _NShotFaults("sidecar.submit:error:1.0", 1)
+        client = _client(address, faults, scope=store.scope("ratelimit"))
+        try:
+            assert client.submit(_item()) == [1]  # survived the glitch
+        finally:
+            client.close()
+        # the glitch hit the pooled (constructor-ping) conn, so the free
+        # redial absorbed it without spending the retry budget
+        assert faults.fired() == {"sidecar.submit:error": 1}
+        snap = store.debug_snapshot()
+        assert snap["ratelimit.sidecar.redial"] == 1
+        assert snap["ratelimit.sidecar.retry"] == 0
+
+    def test_persistent_faults_exhaust_bounded_retries(self, sidecar_tcp):
+        _, address = sidecar_tcp
+        faults = FaultInjector(parse_fault_spec("sidecar.submit:error:1.0"))
+        client = _client(address, faults, retries=2)
+        try:
+            with pytest.raises(CacheError, match="injected fault"):
+                client.submit(_item())
+        finally:
+            client.close()
+        # 1 free redial (pooled conn) + initial attempt + 2 retries
+        assert faults.fired()["sidecar.submit:error"] == 4
+
+    def test_deadline_expires_on_slow_engine(self, test_store):
+        """Per-RPC deadline: a wedged/slow sidecar engine must cost one
+        deadline, not an unbounded hang."""
+        ts = FakeTimeSource(1_000_000)
+        server_faults = FaultInjector(
+            parse_fault_spec("sidecar.server.submit:delay_ms:30000")
+        )
+        server = SlabSidecarServer(
+            "tcp://127.0.0.1:0", _make_engine(ts), fault_injector=server_faults
+        )
+        client = _client(
+            f"tcp://127.0.0.1:{server.port}", retries=0, rpc_deadline=0.05
+        )
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(CacheError, match="transport failure"):
+                client.submit(_item())
+            assert time.monotonic() - t0 < 5.0  # deadline, not the delay
+        finally:
+            client.close()
+            server_faults.clear()  # let the server thread's sleep stub go
+            server.close()
+
+    def test_server_side_drop_and_partial_write_are_retried(self, test_store):
+        """Connection drops and truncated responses from the server are
+        transport failures — absorbed by redial/retry."""
+        for kind in ("drop", "partial_write"):
+            ts = FakeTimeSource(1_000_000)
+            faults = _NShotFaults(f"sidecar.server.submit:{kind}:1.0", 1)
+            server = SlabSidecarServer(
+                "tcp://127.0.0.1:0", _make_engine(ts), fault_injector=faults
+            )
+            client = _client(f"tcp://127.0.0.1:{server.port}")
+            try:
+                assert client.submit(_item()) == [1]
+            finally:
+                client.close()
+                server.close()
+
+
+class TestBreakerCycle:
+    def test_open_half_open_close_cycle(self, sidecar_tcp, test_store):
+        """The core acceptance cycle: breaker opens after the configured
+        threshold, fails fast while open, recovers via the half-open probe
+        once faults clear."""
+        _, address = sidecar_tcp
+        store, _ = test_store
+        faults = FaultInjector(parse_fault_spec("sidecar.submit:error:1.0"))
+        client = _client(
+            address,
+            faults,
+            retries=0,
+            breaker_threshold=2,
+            breaker_reset=0.05,
+            scope=store.scope("ratelimit"),
+        )
+        try:
+            for _ in range(2):
+                with pytest.raises(CacheError, match="injected fault"):
+                    client.submit(_item())
+            assert client.breaker.state == CircuitBreaker.OPEN
+            before = faults.fired()["sidecar.submit:error"]
+            with pytest.raises(CacheError, match="circuit open"):
+                client.submit(_item())
+            # failing fast: no transport attempt was made while open
+            assert faults.fired()["sidecar.submit:error"] == before
+            snap = store.debug_snapshot()
+            assert snap["ratelimit.sidecar.breaker_open"] == 1
+            assert snap["ratelimit.sidecar.breaker_state"] == 2  # open
+
+            # faults clear; after the reset window the half-open probe
+            # closes the breaker and traffic flows again
+            faults.clear()
+            time.sleep(0.06)
+            assert client.submit(_item()) == [1]
+            assert client.breaker.state == CircuitBreaker.CLOSED
+            assert client.submit(_item()) == [2]
+            snap = store.debug_snapshot()
+            assert snap["ratelimit.sidecar.breaker_state"] == 0  # closed
+        finally:
+            client.close()
+
+    def test_failed_probe_reopens_breaker(self, sidecar_tcp):
+        _, address = sidecar_tcp
+        faults = FaultInjector(parse_fault_spec("sidecar.submit:error:1.0"))
+        client = _client(
+            address, faults, retries=0, breaker_threshold=1, breaker_reset=0.05
+        )
+        try:
+            with pytest.raises(CacheError, match="injected fault"):
+                client.submit(_item())
+            assert client.breaker.state == CircuitBreaker.OPEN
+            time.sleep(0.06)
+            # the probe goes to the wire (faults still on) and fails
+            with pytest.raises(CacheError, match="injected fault"):
+                client.submit(_item())
+            assert client.breaker.state == CircuitBreaker.OPEN
+        finally:
+            client.close()
+
+
+class TestSidecarRestart:
+    def test_restart_is_free_without_retry_budget(self, test_store):
+        """The one-shot redial alone (retries=0) absorbs a sidecar restart
+        detected on a pooled connection."""
+        ts = FakeTimeSource(1_000_000)
+        engine = _make_engine(ts)
+        server = SlabSidecarServer("tcp://127.0.0.1:0", engine)
+        port = server.port
+        client = _client(f"tcp://127.0.0.1:{port}", retries=0)
+        try:
+            assert client.submit(_item()) == [1]
+            server.close()
+            server = SlabSidecarServer(
+                f"tcp://127.0.0.1:{port}", _make_engine(ts)
+            )
+            # the pooled conn is stale -> evict-all + free redial; counters
+            # continue on the fresh slab (soft state)
+            assert client.submit(_item()) == [1]
+        finally:
+            client.close()
+            server.close()
+
+    def test_restart_under_load_zero_failed_requests(self, test_store):
+        """The acceptance bar: a sidecar restart while 4 threads hammer it
+        costs ZERO failed requests — stale pooled sockets redial, requests
+        in the dial gap ride the retry budget."""
+        ts = FakeTimeSource(1_000_000)
+        server = SlabSidecarServer("tcp://127.0.0.1:0", _make_engine(ts))
+        port = server.port
+        client = SidecarEngineClient(
+            f"tcp://127.0.0.1:{port}",
+            retries=8,
+            retry_backoff=0.02,
+            retry_backoff_max=0.2,
+            breaker_threshold=0,
+        )
+        errors: list[Exception] = []
+        done = [0]
+        lock = threading.Lock()
+
+        def worker(k):
+            for i in range(30):
+                try:
+                    client.submit(_item(fp=k * 1000 + i))
+                except Exception as e:  # noqa: BLE001 - collected for assert
+                    with lock:
+                        errors.append(e)
+                else:
+                    with lock:
+                        done[0] += 1
+
+        threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let load build
+        server.close()
+        server2 = SlabSidecarServer(f"tcp://127.0.0.1:{port}", _make_engine(ts))
+        try:
+            for t in threads:
+                t.join(30.0)
+            assert errors == []
+            assert done[0] == 120
+        finally:
+            client.close()
+            server2.close()
+
+
+# -- the FAILURE_MODE_DENY ladder at the service level --
+
+LADDER_YAML = """
+domain: chaos
+descriptors:
+  - key: k
+    value: v
+    rate_limit: {unit: minute, requests_per_unit: 2}
+"""
+
+
+class _FakeRuntime:
+    def __init__(self, files):
+        self._files = dict(files)
+
+    def snapshot(self):
+        files = self._files
+
+        class Snap:
+            def keys(self):
+                return list(files)
+
+            def get(self, key):
+                return files[key]
+
+        return Snap()
+
+    def add_update_callback(self, cb):
+        pass
+
+
+class _FlakyCache:
+    """Raises CacheError while .down is True, else answers OK."""
+
+    def __init__(self):
+        self.down = True
+
+    def do_limit(self, request, limits):
+        if self.down:
+            raise CacheError("backend dark")
+        from api_ratelimit_tpu.models.response import (
+            DescriptorStatus,
+            DoLimitResponse,
+        )
+
+        return DoLimitResponse(
+            descriptor_statuses=[
+                DescriptorStatus(code=Code.OK) for _ in request.descriptors
+            ]
+        )
+
+    def flush(self):
+        pass
+
+
+def _ladder_service(mode, store):
+    ts = FakeTimeSource(1_000_000)
+    cache = _FlakyCache()
+    fallback = FallbackLimiter(
+        mode,
+        base_limiter=BaseRateLimiter(ts, near_limit_ratio=0.8),
+        scope=store.scope("ratelimit"),
+    )
+    svc = RateLimitService(
+        runtime=_FakeRuntime({"config.chaos": LADDER_YAML}),
+        cache=cache,
+        stats_scope=store.scope("ratelimit").scope("service"),
+        time_source=ts,
+        fallback=fallback,
+    )
+    return svc, cache, fallback
+
+
+def _req():
+    return RateLimitRequest(
+        domain="chaos",
+        descriptors=(Descriptor.of(("k", "v")),),
+        hits_addend=1,
+    )
+
+
+class TestFailureModeLadder:
+    def test_fail_open_returns_ok_and_counts_redis_error(self, test_store):
+        store, sink = test_store
+        svc, cache, fallback = _ladder_service(FAILURE_MODE_ALLOW, store)
+        overall, statuses, _ = svc.should_rate_limit(_req())
+        assert overall == Code.OK
+        assert statuses[0].code == Code.OK
+        assert fallback.degraded
+        assert "mode=allow" in fallback.degraded_reason()
+        store.flush()
+        assert (
+            sink.counters["ratelimit.service.call.should_rate_limit.redis_error"]
+            == 1
+        )
+        assert sink.counters["ratelimit.fallback.allow"] == 1
+        assert sink.gauges["ratelimit.fallback.degraded"] == 1
+        # backend heals: degraded state clears on the next success
+        cache.down = False
+        overall, _, _ = svc.should_rate_limit(_req())
+        assert overall == Code.OK
+        assert not fallback.degraded
+        assert fallback.degraded_reason() is None
+        store.flush()
+        assert sink.gauges["ratelimit.fallback.degraded"] == 0
+
+    def test_deny_mode_denies_all(self, test_store):
+        store, sink = test_store
+        svc, _, _ = _ladder_service(FAILURE_MODE_DENY, store)
+        overall, statuses, _ = svc.should_rate_limit(_req())
+        assert overall == Code.OVER_LIMIT
+        assert statuses[0].code == Code.OVER_LIMIT
+        assert statuses[0].current_limit.requests_per_unit == 2
+        store.flush()
+        assert sink.counters["ratelimit.fallback.deny"] == 1
+
+    def test_degraded_mode_keeps_local_enforcement(self, test_store):
+        """The degraded rung: during the outage the in-memory fixed-window
+        limiter still denies over-limit descriptors (limit 2/min)."""
+        store, sink = test_store
+        svc, _, fallback = _ladder_service(FAILURE_MODE_DEGRADED, store)
+        codes = [svc.should_rate_limit(_req())[0] for _ in range(3)]
+        assert codes == [Code.OK, Code.OK, Code.OVER_LIMIT]
+        assert fallback.degraded
+        store.flush()
+        assert sink.counters["ratelimit.fallback.local"] == 3
+        assert (
+            sink.counters["ratelimit.service.call.should_rate_limit.redis_error"]
+            == 3
+        )
+
+    def test_healthcheck_reports_degraded_body(self, test_store):
+        from api_ratelimit_tpu.server.health import HealthChecker
+
+        store, _ = test_store
+        svc, cache, fallback = _ladder_service(FAILURE_MODE_ALLOW, store)
+        health = HealthChecker()
+        health.set_degraded_probe(fallback.degraded_reason)
+        assert health.http_response() == (200, "OK")
+        svc.should_rate_limit(_req())
+        status, body = health.http_response()
+        assert status == 200  # degraded still serves; never drained
+        assert body.startswith("OK") and "degraded" in body
+        cache.down = False
+        svc.should_rate_limit(_req())
+        assert health.http_response() == (200, "OK")
+
+    def test_no_fallback_keeps_legacy_raise(self, test_store):
+        store, _ = test_store
+        ts = FakeTimeSource(1_000_000)
+        svc = RateLimitService(
+            runtime=_FakeRuntime({"config.chaos": LADDER_YAML}),
+            cache=_FlakyCache(),
+            stats_scope=store.scope("ratelimit").scope("service"),
+            time_source=ts,
+        )
+        with pytest.raises(CacheError):
+            svc.should_rate_limit(_req())
+
+
+class TestClosedBatcherIsCacheError:
+    """Satellite: a submit racing shutdown must surface as a counted
+    backend failure (CacheError), not an unhandled RuntimeError 500."""
+
+    def test_direct_mode(self):
+        from api_ratelimit_tpu.backends.batcher import MicroBatcher
+
+        b = MicroBatcher(lambda items: [0] * len(items), window_seconds=0.0)
+        b.close()
+        with pytest.raises(CacheError, match="batcher is closed"):
+            b.submit([1])
+
+    def test_windowed_mode(self):
+        from api_ratelimit_tpu.backends.batcher import MicroBatcher
+
+        b = MicroBatcher(lambda items: [0] * len(items), window_seconds=0.001)
+        b.close()
+        with pytest.raises(CacheError, match="batcher is closed"):
+            b.submit([1])
+
+
+class TestFullStackAcceptance:
+    """The issue's acceptance scenario end to end: a real runner with
+    BACKEND_TYPE=tpu-sidecar, FAULT_INJECT forcing 100% sidecar transport
+    errors, driven over real gRPC + HTTP."""
+
+    def _boot(self, tmp_path, sock, **settings_kw):
+        from api_ratelimit_tpu.runner import Runner
+        from api_ratelimit_tpu.settings import Settings
+
+        config_dir = tmp_path / "current" / "rl" / "config"
+        config_dir.mkdir(parents=True, exist_ok=True)
+        (config_dir / "c.yaml").write_text(
+            "domain: chaos\n"
+            "descriptors:\n"
+            "  - key: one\n"
+            "    rate_limit: {unit: minute, requests_per_unit: 1}\n"
+        )
+        settings = Settings(
+            port=0,
+            grpc_port=0,
+            debug_port=0,
+            use_statsd=False,
+            runtime_path=str(tmp_path / "current"),
+            runtime_subdirectory="rl",
+            backend_type="tpu-sidecar",
+            sidecar_socket=sock,
+            sidecar_retries=0,
+            sidecar_retry_backoff=0.001,
+            sidecar_breaker_threshold=0,
+            expiration_jitter_max_seconds=0,
+            log_level="ERROR",
+            **settings_kw,
+        )
+        runner = Runner(settings, sink=TestSink())
+        runner.run_background()
+        assert runner.wait_ready(10.0)
+        return runner
+
+    def _healthcheck(self, runner):
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://localhost:{runner.server.http_port}/healthcheck",
+            timeout=5,
+        ) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_fail_open_full_stack(self, tmp_path):
+        import grpc
+
+        from api_ratelimit_tpu.pb import rls_grpc, rls_v3
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        engine = SlabDeviceEngine(
+            time_source=RealTimeSource(),
+            n_slots=1 << 12,
+            buckets=(128, 1024),
+            max_batch=1024,
+            use_pallas=False,
+            block_mode=True,
+        )
+        sock = str(tmp_path / "slab.sock")
+        server = SlabSidecarServer(sock, engine)
+        runner = self._boot(
+            tmp_path,
+            sock,
+            failure_mode_deny="false",  # upstream fail-open posture
+            fault_inject="sidecar.submit:error:1.0",
+        )
+        try:
+            with grpc.insecure_channel(
+                f"localhost:{runner.server.grpc_port}"
+            ) as ch:
+                stub = rls_grpc.RateLimitServiceV3Stub(ch)
+                request = rls_v3.RateLimitRequest(domain="chaos")
+                d = request.descriptors.add()
+                d.entries.add(key="one", value="x")
+                # 100% transport errors + fail-open => OK every time
+                codes = [
+                    stub.ShouldRateLimit(request).overall_code
+                    for _ in range(3)
+                ]
+            assert codes == [rls_v3.RateLimitResponse.OK] * 3
+            snap = runner.stats_store.debug_snapshot()
+            assert (
+                snap["ratelimit.service.call.should_rate_limit.redis_error"]
+                == 3
+            )
+            assert snap["ratelimit.fallback.degraded"] == 1
+            status, body = self._healthcheck(runner)
+            assert status == 200 and "degraded" in body
+        finally:
+            runner.stop()
+            server.close()
+
+    def test_degraded_local_full_stack(self, tmp_path):
+        """Degraded rung over the wire: with the sidecar unreachable, the
+        in-memory fallback still denies the over-limit descriptor."""
+        import grpc
+
+        from api_ratelimit_tpu.pb import rls_grpc, rls_v3
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        engine = SlabDeviceEngine(
+            time_source=RealTimeSource(),
+            n_slots=1 << 12,
+            buckets=(128, 1024),
+            max_batch=1024,
+            use_pallas=False,
+            block_mode=True,
+        )
+        sock = str(tmp_path / "slab.sock")
+        server = SlabSidecarServer(sock, engine)
+        runner = self._boot(
+            tmp_path,
+            sock,
+            failure_mode_deny="degraded",
+            fault_inject="sidecar.submit:error:1.0",
+        )
+        try:
+            with grpc.insecure_channel(
+                f"localhost:{runner.server.grpc_port}"
+            ) as ch:
+                stub = rls_grpc.RateLimitServiceV3Stub(ch)
+                request = rls_v3.RateLimitRequest(domain="chaos")
+                d = request.descriptors.add()
+                d.entries.add(key="one", value="x")
+                codes = [
+                    stub.ShouldRateLimit(request).overall_code
+                    for _ in range(3)
+                ]
+            # limit is 1/minute: the local limiter allows one then denies
+            assert codes == [
+                rls_v3.RateLimitResponse.OK,
+                rls_v3.RateLimitResponse.OVER_LIMIT,
+                rls_v3.RateLimitResponse.OVER_LIMIT,
+            ]
+            status, body = self._healthcheck(runner)
+            assert status == 200 and "degraded" in body
+        finally:
+            runner.stop()
+            server.close()

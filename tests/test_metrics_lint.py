@@ -40,3 +40,45 @@ def test_linter_flags_violations(tmp_path):
     findings = lint.lint(str(tmp_path))
     assert any("CamelCase.name" in f and "convention" in f for f in findings)
     assert any("dup.name" in f and "conflicting types" in f for f in findings)
+
+
+def test_multiline_registrations_are_seen(tmp_path):
+    """A registration whose string literal sits on a continuation line
+    (black-style wrapping) must still be scanned — README drift checking
+    depends on the walker seeing every literal."""
+    lint = _load_linter()
+    (tmp_path / "wrapped.py").write_text(
+        "h = scope.histogram(\n"
+        '    "wrapped_name", boundaries=BUCKETS\n'
+        ")\n"
+    )
+    names = {n for n, _, _, _ in lint.iter_registrations(str(tmp_path))}
+    assert names == {"wrapped_name"}
+
+
+def test_readme_metric_names_exist_in_source():
+    """Drift check: every ratelimit.* metric documented in README.md must
+    still be registered somewhere in the package."""
+    lint = _load_linter()
+    names = lint.readme_metric_names()
+    # sanity: the extractor actually parses the README tables (an empty
+    # list would make the drift check vacuous)
+    assert "ratelimit.batcher.queue_wait_ms" in names
+    assert "ratelimit.fallback.degraded" in names  # PR-2 ladder gauge
+    findings = lint.lint_readme()
+    assert findings == [], "\n".join(findings)
+
+
+def test_readme_drift_is_flagged(tmp_path):
+    lint = _load_linter()
+    (tmp_path / "stats.py").write_text('a = scope.counter("real_name")\n')
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| `ratelimit.x.real_name` | fine |\n"
+        "| `ratelimit.x.ghost_name` | gone |\n"
+        "| `ratelimit.y.{real_name,ghost_name}` | brace expansion |\n"
+        "| `ratelimit.z.<domain>.anything` | placeholder skipped |\n"
+    )
+    findings = lint.lint_readme(str(tmp_path), str(readme))
+    assert len(findings) == 2
+    assert all("ghost_name" in f for f in findings)

@@ -109,3 +109,85 @@ class TestSettings:
             new_settings(
                 {"METRICS_LATENCY_BUCKETS_MS": "-1,5"}
             ).latency_buckets()
+
+
+class TestResilienceSettings:
+    """The PR-2 resilience knobs: sidecar retry/deadline/breaker, the
+    FAILURE_MODE_DENY ladder, and FAULT_INJECT parsing — junk must fail
+    boot like a typo'd bucket ladder."""
+
+    def test_sidecar_resilience_env_names(self):
+        s = new_settings(
+            {
+                "SIDECAR_CONNECT_TIMEOUT": "250ms",
+                "SIDECAR_RPC_DEADLINE": "2s",
+                "SIDECAR_RETRIES": "4",
+                "SIDECAR_RETRY_BACKOFF": "5ms",
+                "SIDECAR_RETRY_BACKOFF_MAX": "100ms",
+                "SIDECAR_BREAKER_THRESHOLD": "3",
+                "SIDECAR_BREAKER_RESET": "500ms",
+            }
+        )
+        assert s.sidecar_connect_timeout == pytest.approx(0.25)
+        assert s.sidecar_rpc_deadline == pytest.approx(2.0)
+        assert s.sidecar_retries == 4
+        assert s.sidecar_retry_backoff == pytest.approx(5e-3)
+        assert s.sidecar_retry_backoff_max == pytest.approx(0.1)
+        assert s.sidecar_breaker_threshold == 3
+        assert s.sidecar_breaker_reset == pytest.approx(0.5)
+
+    def test_resilience_defaults(self):
+        s = new_settings({})
+        assert s.failure_mode() is None  # legacy raise-through
+        assert s.fault_rules() == []
+        assert s.sidecar_retries == 2
+        assert s.sidecar_breaker_threshold == 5
+
+    def test_failure_mode_ladder_values(self):
+        # upstream boolean parity: true = deny-all, false = fail-open
+        assert new_settings({"FAILURE_MODE_DENY": "true"}).failure_mode() == "deny"
+        assert new_settings({"FAILURE_MODE_DENY": "deny"}).failure_mode() == "deny"
+        assert (
+            new_settings({"FAILURE_MODE_DENY": "false"}).failure_mode()
+            == "allow"
+        )
+        assert (
+            new_settings({"FAILURE_MODE_DENY": "allow"}).failure_mode()
+            == "allow"
+        )
+        assert (
+            new_settings({"FAILURE_MODE_DENY": "degraded"}).failure_mode()
+            == "degraded"
+        )
+
+    def test_failure_mode_junk_raises(self):
+        with pytest.raises(ValueError, match="FAILURE_MODE_DENY"):
+            new_settings({"FAILURE_MODE_DENY": "maybe"}).failure_mode()
+
+    def test_fault_inject_spec_parses(self):
+        s = new_settings(
+            {
+                "FAULT_INJECT": (
+                    "sidecar.submit:error:0.2,sidecar.submit:delay_ms:500"
+                ),
+                "FAULT_INJECT_SEED": "7",
+            }
+        )
+        rules = s.fault_rules()
+        assert [(r.site, r.kind, r.value) for r in rules] == [
+            ("sidecar.submit", "error", 0.2),
+            ("sidecar.submit", "delay_ms", 500.0),
+        ]
+        assert s.fault_inject_seed == 7
+
+    def test_fault_inject_junk_fails_boot(self):
+        for spec in (
+            "sidecar.submit:error",  # missing value
+            "sidecar.submit:explode:0.5",  # unknown kind
+            "sidecar.submit:error:1.5",  # probability out of range
+            "sidecar.submit:error:zero",  # non-numeric value
+            "BadSite:error:0.5",  # site convention
+            "sidecar.submit:delay_ms:-1",  # negative delay
+        ):
+            with pytest.raises(ValueError, match="FAULT_INJECT"):
+                new_settings({"FAULT_INJECT": spec}).fault_rules()

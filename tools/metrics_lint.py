@@ -15,6 +15,12 @@ Names are literals as written at the call site (scope-relative); the
 convention check is what keeps the composed dotted paths well-formed.
 Dynamically composed names (f-strings, variables) are out of scope.
 
+It also drift-checks the README: every backticked ``ratelimit.*`` metric
+name mentioned in README.md (brace alternations like ``{steals,drops}``
+expanded; ``<placeholder>`` tokens skipped) must resolve to a literal
+registration in the source — a renamed or deleted stat must not leave a
+stale name in the operator docs.
+
 Run standalone (``python tools/metrics_lint.py``; exit 1 on findings) or
 via the fast pytest wrapper in tests/test_metrics_lint.py, which is part
 of the tier-1 run. No jax import — this must stay cheap.
@@ -28,6 +34,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "api_ratelimit_tpu")
+README = os.path.join(REPO, "README.md")
 
 _REGISTRATION = re.compile(
     r"\.(?P<kind>counter|gauge|timer|histogram)\(\s*(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)"
@@ -61,14 +68,16 @@ def iter_registrations(package_dir: str = PACKAGE):
                 continue
             path = os.path.join(dirpath, filename)
             with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, start=1):
-                    for m in _REGISTRATION.finditer(line):
-                        yield (
-                            m.group("name"),
-                            m.group("kind"),
-                            os.path.relpath(path, REPO),
-                            lineno,
-                        )
+                text = f.read()
+            # whole-file scan: \s* spans newlines, so a registration whose
+            # string literal sits on a continuation line still counts
+            for m in _REGISTRATION.finditer(text):
+                yield (
+                    m.group("name"),
+                    m.group("kind"),
+                    os.path.relpath(path, REPO),
+                    text.count("\n", 0, m.start()) + 1,
+                )
 
 
 def lint(package_dir: str = PACKAGE) -> list[str]:
@@ -94,8 +103,62 @@ def lint(package_dir: str = PACKAGE) -> list[str]:
     return findings
 
 
+# backticked dotted stat paths in the README, e.g. `ratelimit.slab.loss_ppm`
+# or `ratelimit.sidecar.{retry,redial}`; `<domain>`-style placeholders make
+# a token unverifiable and are skipped
+_README_METRIC = re.compile(r"`(ratelimit\.[A-Za-z0-9_.{},<>]+)`")
+_BRACE = re.compile(r"\{([^{}]*)\}")
+
+
+def readme_metric_names(readme_path: str = README) -> list[str]:
+    """Concrete dotted stat names mentioned in the README (one level of
+    {a,b,c} alternation expanded; placeholder tokens skipped)."""
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        return []
+    names: set[str] = set()
+    for m in _README_METRIC.finditer(text):
+        token = m.group(1)
+        if "<" in token or ">" in token:
+            continue
+        expanded = [token]
+        while any("{" in t for t in expanded):
+            nxt = []
+            for t in expanded:
+                mm = _BRACE.search(t)
+                if mm is None:
+                    nxt.append(t)
+                    continue
+                for alt in mm.group(1).split(","):
+                    nxt.append(t[: mm.start()] + alt.strip() + t[mm.end():])
+            expanded = nxt
+        names.update(expanded)
+    return sorted(names)
+
+
+def lint_readme(
+    package_dir: str = PACKAGE, readme_path: str = README
+) -> list[str]:
+    """README drift check: every documented ratelimit.* metric must end in
+    a literal stat name registered somewhere in the package (registrations
+    are scope-relative, so the check is a dotted-suffix match)."""
+    findings: list[str] = []
+    literals = {name for name, _, _, _ in iter_registrations(package_dir)}
+    for name in readme_metric_names(readme_path):
+        if not any(
+            name == lit or name.endswith("." + lit) for lit in literals
+        ):
+            findings.append(
+                f"README.md: metric {name!r} does not match any literal "
+                f"stat registration in the package (renamed or deleted?)"
+            )
+    return findings
+
+
 def main() -> int:
-    findings = lint()
+    findings = lint() + lint_readme()
     if findings:
         for finding in findings:
             print(f"metrics-lint: {finding}", file=sys.stderr)
