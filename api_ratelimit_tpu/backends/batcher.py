@@ -30,9 +30,13 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
-from ..limiter.cache import CacheError
+from ..limiter.cache import CacheError, DeadlineExceededError
+from ..utils.deadline import current_deadline
+from .overload import BrownoutError, QueueFullError
 
 _CLOSE = object()
+
+FAULT_SITE_SUBMIT = "batcher.submit"  # testing/faults.py chaos site
 
 
 class BatcherStats:
@@ -64,6 +68,9 @@ class MicroBatcher:
         max_inflight: int = 2,
         block_mode: bool = False,
         scope=None,
+        max_queue: int = 0,
+        overload=None,
+        fault_injector=None,
     ):
         """block_mode: each submit() argument is ONE pre-packed uint32[6, n]
         column block (the sidecar wire format) instead of a sequence of
@@ -78,10 +85,31 @@ class MicroBatcher:
         records its per-stage telemetry — queue_wait_ms (submit enqueue ->
         batch take), batch_size (items per launch, pow-2 buckets) — and
         registers a StatGenerator exporting queue_depth / inflight gauges
-        at every flush/scrape."""
+        at every flush/scrape.
+
+        max_queue: hard bound on items awaiting a dispatcher take
+        (OVERLOAD_MAX_QUEUE); a submit that would exceed it raises
+        QueueFullError instantly instead of growing the queue without
+        bound. 0 keeps the legacy unbounded behavior.
+
+        overload: optional AdmissionController (backends/overload.py).
+        When set, the batcher feeds it the queue-wait EWMA brownout signal
+        (one observation per take), sheds new submits with BrownoutError
+        while the brownout is active, and reports deadline-expired drops.
+
+        fault_injector: optional FaultInjector consulted at site
+        'batcher.submit' before each enqueue — delay_ms stalls the caller,
+        queue_full raises QueueFullError — so chaos tests rehearse overload
+        deterministically (testing/faults.py)."""
         self._execute = execute
         self._window = float(window_seconds)
         self._max_batch = int(max_batch)
+        self._max_queue = int(max_queue)
+        self._overload = overload
+        self._faults = fault_injector
+        # deadline-expired items dropped before a launch (plain int — also
+        # mirrored into the overload controller's counter when one is wired)
+        self.deadline_drops = 0
         self._block_mode = bool(block_mode)
         self._lock = threading.Lock()
         self._items: list = []
@@ -133,19 +161,42 @@ class MicroBatcher:
 
     # -- client side --
 
+    def _admit(self) -> None:
+        """Admission gate shared by both modes: chaos site, then the
+        brownout shed. Runs BEFORE any queue/lock work — overload is
+        answered at the cheapest possible point."""
+        if self._faults is not None:
+            action = self._faults.fire(FAULT_SITE_SUBMIT)
+            if action == "queue_full":
+                raise QueueFullError("injected queue_full fault")
+        if self._overload is not None and self._overload.should_shed():
+            raise BrownoutError(
+                "batcher brownout: queue wait ewma over target"
+            )
+
+    def _expired(self, deadline: float | None) -> bool:
+        return deadline is not None and time.monotonic() >= deadline
+
     def submit(self, items) -> list:
         """Run `items` through the batch executor; returns their results in
         order. Blocks until results are available. In block mode, `items`
-        is one uint32[6, n] block and the return is its uint32[n] result."""
+        is one uint32[6, n] block and the return is its uint32[n] result.
+
+        The caller's propagated deadline (utils/deadline.py) is captured at
+        enqueue: work already expired — here, or by the time the dispatcher
+        takes it — resolves as DeadlineExceededError without ever occupying
+        batch slots."""
         count = items.shape[1] if self._block_mode else len(items)
         if count == 0:
             return []
+        self._admit()
+        deadline = current_deadline()
         if self._window <= 0:
             # direct mode: caller thread executes (single-flight via lock).
             # queue_wait here is the time spent blocked on the dispatch
             # lock behind another caller — the direct-mode analog of queue
             # time, and the signal that a window would start paying off.
-            t_enq = time.monotonic() if self._h_wait is not None else 0.0
+            t_enq = time.monotonic()
             with self._direct_lock:
                 if self._closed:
                     # CacheError, not a bare RuntimeError: a submit racing
@@ -153,9 +204,18 @@ class MicroBatcher:
                     # (redis_error + a proper wire error), not an unhandled
                     # 500 from the transport
                     raise CacheError("batcher is closed")
+                if self._expired(deadline):
+                    # time ran out waiting behind another caller's launch
+                    self._note_expired(1)
+                    raise DeadlineExceededError(
+                        "deadline expired before device dispatch"
+                    )
+                wait_ms = (time.monotonic() - t_enq) * 1e3
                 if self._h_wait is not None:
-                    self._h_wait.record((time.monotonic() - t_enq) * 1e3)
+                    self._h_wait.record(wait_ms)
                     self._h_batch.record(count)
+                if self._overload is not None:
+                    self._overload.observe_queue_wait(wait_ms)
                 if self._block_mode:
                     return self._execute([items])
                 return self._execute(list(items))
@@ -164,15 +224,27 @@ class MicroBatcher:
         with self._lock:
             if self._closed:
                 raise CacheError("batcher is closed")  # see direct-mode note
+            if self._max_queue > 0 and self._pending + count > self._max_queue:
+                raise QueueFullError(
+                    f"batcher queue full ({self._pending} pending, "
+                    f"max {self._max_queue})"
+                )
             start = self._pending
             if self._block_mode:
                 self._items.append(items)
             else:
                 self._items.extend(items)
             self._pending += count
-            self._futures.append((future, start, count, time.monotonic()))
+            self._futures.append(
+                (future, start, count, time.monotonic(), deadline)
+            )
             self._wakeup.notify()
         return future.result()
+
+    def _note_expired(self, n: int) -> None:
+        self.deadline_drops += n
+        if self._overload is not None:
+            self._overload.note_deadline_expired(n)
 
     def flush(self) -> None:
         """Block until everything enqueued so far has executed (including a
@@ -227,27 +299,72 @@ class MicroBatcher:
                 # A single oversized request is taken alone; the executor
                 # loops over buckets internally. Block mode: one submitted
                 # block per future, so taking k futures takes k blocks.
+                # Requests whose propagated deadline expired while queued
+                # are DROPPED here, before packing: they resolve as
+                # DeadlineExceededError and never consume batch slots.
                 futures = []
-                taken = 0
-                t_take = time.monotonic() if self._h_wait is not None else 0.0
-                for future, _start, count, ts in self._futures:
+                expired: list[Future] = []
+                taken = 0  # live items in this batch
+                dropped = 0  # expired items excised from the queue
+                kept: list[tuple[int, int]] = []  # (unit offset, unit len)
+                unit_cursor = 0
+                consumed = 0
+                head_wait_ms = 0.0
+                t_take = time.monotonic()
+                for future, _start, count, ts, dl in self._futures:
+                    units = 1 if self._block_mode else count
+                    if dl is not None and t_take >= dl:
+                        expired.append(future)
+                        dropped += count
+                        unit_cursor += units
+                        consumed += 1
+                        continue
                     if futures and taken + count > self._max_batch:
                         break
                     if self._h_wait is not None:
                         self._h_wait.record((t_take - ts) * 1e3)
+                    if not futures:
+                        # oldest live request's wait — the brownout signal
+                        head_wait_ms = (t_take - ts) * 1e3
                     futures.append((future, taken, count))
                     taken += count
-                if self._h_batch is not None:
+                    kept.append((unit_cursor, units))
+                    unit_cursor += units
+                    consumed += 1
+                if self._h_batch is not None and futures:
                     self._h_batch.record(taken)
-                n_units = len(futures) if self._block_mode else taken
-                items = self._items[:n_units]
-                self._items = self._items[n_units:]
-                self._pending -= taken
+                if dropped:
+                    items = []
+                    for off, units in kept:
+                        items.extend(self._items[off : off + units])
+                else:
+                    items = self._items[:unit_cursor]
+                self._items = self._items[unit_cursor:]
+                self._pending -= taken + dropped
+                removed = taken + dropped
                 self._futures = [
-                    (f, start - taken, count, ts)
-                    for f, start, count, ts in self._futures[len(futures) :]
+                    (f, start - removed, count, ts, dl)
+                    for f, start, count, ts, dl in self._futures[consumed:]
                 ]
-                self._inflight += 1
+                if futures:
+                    self._inflight += 1
+
+            if expired:
+                self._note_expired(len(expired))
+                exc = DeadlineExceededError(
+                    "deadline expired in batcher queue"
+                )
+                for future in expired:
+                    if not future.done():
+                        future.set_exception(exc)
+            if not futures:
+                # pure-expiry round: nothing to launch
+                with self._lock:
+                    if not self._items and not self._futures and not self._inflight:
+                        self._idle.notify_all()
+                continue
+            if self._overload is not None:
+                self._overload.observe_queue_wait(head_wait_ms)
 
             if self._collect_q is not None:
                 # double-buffered: launch now (fast), hand the blocking

@@ -47,9 +47,15 @@ from ..models.descriptors import RateLimitRequest
 from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint_many, split_fingerprints
-from ..ops.slab import make_slab, slab_live_slots, slab_step_after
+from ..ops.slab import (
+    make_slab,
+    slab_live_slots,
+    slab_step_after,
+    slab_sweep_expired,
+)
 from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
+from .overload import SlabSaturatedError
 
 _log = logging.getLogger(__name__)
 
@@ -97,13 +103,31 @@ class SlabDeviceEngine:
         mesh=None,
         block_mode: bool = False,
         scope=None,
+        max_queue: int = 0,
+        watermark_high: float = 0.0,
+        watermark_critical: float = 0.0,
+        overload=None,
+        fault_injector=None,
     ):
         """scope: optional stats Scope rooted at the service prefix (e.g.
         the runner's `ratelimit` scope). When set, the engine records the
         per-stage device histograms — <scope>.device.{pack_ms,launch_ms,
         readback_ms} — and hands <scope>.batcher to the micro-batcher for
         queue-wait/batch-size/depth telemetry. None (the default) keeps
-        the hot path entirely free of stats work."""
+        the hot path entirely free of stats work.
+
+        max_queue / overload / fault_injector are forwarded to the
+        micro-batcher (bounded queue + brownout shedding + the
+        batcher.submit chaos site; backends/batcher.py).
+
+        watermark_high / watermark_critical: slab-occupancy watermarks in
+        (0, 1]; 0 disables. Evaluated on the health_snapshot (stats-flush)
+        cadence — never per batch. Past HIGH an expired-slot sweep
+        (ops/slab.py slab_sweep_expired) reclaims window-ended slots and a
+        degraded probe raises (watermark_reason); past CRITICAL submits
+        raise SlabSaturatedError so new-key admission degrades to the
+        configured shed posture instead of silently stealing live
+        counters."""
         self._time_source = time_source
         self._near_limit_ratio = float(near_limit_ratio)
         if device is None:
@@ -155,6 +179,19 @@ class SlabDeviceEngine:
         self.launch_sizes: collections.deque = collections.deque(maxlen=4096)
         self._pending_health: list = []
         self._state_lock = threading.Lock()
+        # slab-saturation watermarks: state machine driven by the occupancy
+        # gauge on the health_snapshot cadence (_apply_watermarks); the
+        # submit paths read one boolean.
+        self._watermark_high = float(watermark_high)
+        self._watermark_critical = float(watermark_critical)
+        if 0 < self._watermark_critical < self._watermark_high:
+            raise ValueError(
+                f"critical watermark ({self._watermark_critical}) must not "
+                f"sit below the high watermark ({self._watermark_high})"
+            )
+        self._watermark_state = 0  # 0 normal / 1 high / 2 critical
+        self._saturated = False
+        self._sweeps_total = 0
         # Both modes run double-buffered: the dispatcher's launch (pack +
         # owner routing in mesh mode + async device dispatch) of batch k+1
         # overlaps the collector's blocking readback of batch k (ADVICE r3:
@@ -181,6 +218,9 @@ class SlabDeviceEngine:
                 execute_collect=self._execute_blocks_collect,
                 block_mode=True,
                 scope=batcher_scope,
+                max_queue=max_queue,
+                overload=overload,
+                fault_injector=fault_injector,
             )
         else:
             self._batcher = MicroBatcher(
@@ -190,6 +230,9 @@ class SlabDeviceEngine:
                 execute_launch=self._execute_launch,
                 execute_collect=self._execute_collect,
                 scope=batcher_scope,
+                max_queue=max_queue,
+                overload=overload,
+                fault_injector=fault_injector,
             )
 
     def _drain_health_locked(self) -> None:
@@ -202,13 +245,16 @@ class SlabDeviceEngine:
     def health_snapshot(self) -> dict:
         """Slab health for the stats tree (VERDICT round 1 weak #5): the two
         documented fail-open behaviors plus occupancy. live_slots is an
-        O(n_slots) device reduction — called on the stats-flush cadence."""
+        O(n_slots) device reduction — called on the stats-flush cadence.
+        The watermark policy rides this cadence: occupancy drives the
+        sweep/saturation state machine here, never in the hot path."""
         now = int(self._time_source.unix_now())
         if self._engine is not None:
             snap = self._engine.health_snapshot(now)
             with self._state_lock:
                 snap["decisions"] = self._decisions_total
             snap["loss_ppm"] = _loss_ppm(snap)
+            self._apply_watermarks(snap, now)
             return snap
         with self._state_lock:
             self._drain_health_locked()
@@ -221,13 +267,81 @@ class SlabDeviceEngine:
                 "occupancy": live / self._n_slots,
             }
         snap["loss_ppm"] = _loss_ppm(snap)
+        self._apply_watermarks(snap, now)
         return snap
+
+    def _apply_watermarks(self, snap: dict, now: int) -> None:
+        """Occupancy -> watermark state machine. Past HIGH: run one
+        expired-slot sweep (single-chip; the mesh engine owns its own
+        state and only gets the saturation flag) and refresh the
+        occupancy the snapshot reports. Past CRITICAL: flip the
+        saturation flag the submit paths read."""
+        high, crit = self._watermark_high, self._watermark_critical
+        if high <= 0 and crit <= 0:
+            snap["sweeps"] = self._sweeps_total
+            snap["watermark"] = 0
+            return
+        occ = snap["occupancy"]
+        if high > 0 and occ >= high and self._engine is None:
+            with self._state_lock:
+                self._state, swept = slab_sweep_expired(self._state, now)
+                self._sweeps_total += 1
+                live = int(slab_live_slots(self._state, now))
+            _log.warning(
+                "slab high watermark (occupancy %.3f >= %.3f): sweep "
+                "reclaimed %d window-ended slots",
+                occ,
+                high,
+                int(swept),
+            )
+            snap["live_slots"] = live
+            occ = snap["occupancy"] = live / self._n_slots
+        state = 0
+        if crit > 0 and occ >= crit:
+            state = 2
+        elif high > 0 and occ >= high:
+            state = 1
+        if state != self._watermark_state:
+            _log.warning(
+                "slab watermark state %d -> %d (occupancy %.3f)",
+                self._watermark_state,
+                state,
+                occ,
+            )
+        self._watermark_state = state
+        self._saturated = state == 2
+        snap["sweeps"] = self._sweeps_total
+        snap["watermark"] = state
+
+    def watermark_reason(self) -> str | None:
+        """HealthChecker degraded-probe contract: a reason string while the
+        slab sits past a watermark, else None."""
+        state = self._watermark_state
+        if state >= 2:
+            return (
+                f"slab saturated: occupancy >= critical watermark "
+                f"{self._watermark_critical:g}; new-key admission by policy"
+            )
+        if state == 1:
+            return (
+                f"slab pressure: occupancy >= high watermark "
+                f"{self._watermark_high:g}; sweeping expired slots"
+            )
+        return None
+
+    def _check_saturated(self) -> None:
+        if self._saturated:
+            raise SlabSaturatedError(
+                f"slab occupancy past critical watermark "
+                f"{self._watermark_critical:g}"
+            )
 
     def submit(self, items: list[_Item]) -> list[int]:
         """Batched fixed-window increment; returns each item's
         post-increment counter."""
         if self._block_batcher:
             raise RuntimeError("engine is in block_mode; use submit_block")
+        self._check_saturated()
         return self._batcher.submit(items)
 
     def flush(self) -> None:
@@ -393,6 +507,11 @@ class SlabDeviceEngine:
         were ever renamed)."""
         return self._block_batcher
 
+    @property
+    def saturated(self) -> bool:
+        """True while occupancy sits past the critical watermark."""
+        return self._saturated
+
     def submit_block(self, block: np.ndarray) -> np.ndarray:
         """Batched fixed-window increment over one uint32[6, n] column
         block (the sidecar wire layout: fp_lo, fp_hi, hits, limit, divider,
@@ -403,6 +522,7 @@ class SlabDeviceEngine:
         block with numpy row copies only. Requires block_mode=True."""
         if not self._block_batcher:
             raise RuntimeError("engine not in block_mode")
+        self._check_saturated()
         return self._batcher.submit(block)
 
     def _iter_block_chunks(self, blocks: list[np.ndarray]):
@@ -506,6 +626,8 @@ class SlabHealthStats:
                                    prefer their own windows.
         ratelimit.slab.live_slots  currently live (unexpired) slots
         ratelimit.slab.occupancy   live fraction x 1e6 (gauges are ints)
+        ratelimit.slab.sweeps      cumulative high-watermark sweep passes
+        ratelimit.slab.watermark   0 normal / 1 high / 2 critical
 
     Both lossy behaviors fail open (ops/slab.py:30-39); these gauges make
     the loss rate operable instead of silent. Works for the in-process
@@ -522,6 +644,8 @@ class SlabHealthStats:
             "loss_ppm": scope.gauge("loss_ppm"),
             "live_slots": scope.gauge("live_slots"),
             "occupancy": scope.gauge("occupancy"),
+            "sweeps": scope.gauge("sweeps"),
+            "watermark": scope.gauge("watermark"),
         }
 
     def generate_stats(self) -> None:
@@ -534,6 +658,8 @@ class SlabHealthStats:
         self._gauges["loss_ppm"].set(_loss_ppm(delta))
         self._gauges["live_slots"].set(snap["live_slots"])
         self._gauges["occupancy"].set(int(snap["occupancy"] * 1_000_000))
+        self._gauges["sweeps"].set(snap.get("sweeps", 0))
+        self._gauges["watermark"].set(snap.get("watermark", 0))
 
 
 class TpuRateLimitCache:
@@ -551,6 +677,11 @@ class TpuRateLimitCache:
         mesh=None,
         engine=None,
         stats_scope=None,
+        max_queue: int = 0,
+        watermark_high: float = 0.0,
+        watermark_critical: float = 0.0,
+        overload=None,
+        fault_injector=None,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -558,7 +689,11 @@ class TpuRateLimitCache:
 
         stats_scope: optional stats Scope (the runner's `ratelimit` root);
         forwarded to the in-process engine for device/batcher histograms.
-        A caller-provided engine owns its own telemetry wiring."""
+        A caller-provided engine owns its own telemetry wiring.
+
+        max_queue / watermark_* / overload / fault_injector: admission-
+        control wiring for the in-process engine (see SlabDeviceEngine);
+        ignored when a caller-provided engine is passed."""
         self._base = base_limiter
         # Prewarm the native host codec so the first request never pays the
         # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
@@ -577,6 +712,11 @@ class TpuRateLimitCache:
                 use_pallas=use_pallas,
                 mesh=mesh,
                 scope=stats_scope,
+                max_queue=max_queue,
+                watermark_high=watermark_high,
+                watermark_critical=watermark_critical,
+                overload=overload,
+                fault_injector=fault_injector,
             )
         self._engine_core = engine
         # (domain, entries, divider) -> fingerprint. Rate-limit traffic is

@@ -62,6 +62,38 @@ def main() -> None:
         devices = jax.devices()[: settings.tpu_mesh_devices]
         mesh = Mesh(np.array(devices), ("shard",))
 
+    # FAULT_INJECT chaos hook (sites sidecar.server.submit +
+    # batcher.submit): lets staging rehearse slow-engine / error-reply /
+    # dropped-connection / queue-full behavior on the device-owner side;
+    # junk specs fail the boot here.
+    fault_injector = None
+    fault_rules = settings.fault_rules()
+    if fault_rules:
+        from ..testing.faults import FaultInjector
+
+        fault_injector = FaultInjector(
+            fault_rules, seed=settings.fault_inject_seed
+        )
+        logger.warning(
+            "FAULT_INJECT active (%d rule(s)) — chaos mode", len(fault_rules)
+        )
+
+    # Overload admission control for the shared batcher: the sidecar is
+    # where every frontend's traffic coalesces, so the bounded queue and
+    # brownout live here too. A shed surfaces to frontends as an error
+    # reply -> CacheError -> their FAILURE_MODE_DENY posture answers.
+    from ..backends.overload import AdmissionController
+
+    overload = AdmissionController(
+        shed_mode=settings.shed_mode(),
+        max_queue=settings.overload_max_queue,
+        brownout_target_ms=settings.overload_brownout_target_ms,
+        brownout_exit_ms=settings.overload_brownout_exit_ms,
+        ewma_alpha=settings.overload_ewma_alpha,
+        scope=scope,
+    )
+    watermark_high, watermark_critical = settings.slab_watermarks()
+
     engine = SlabDeviceEngine(
         time_source=RealTimeSource(),
         near_limit_ratio=settings.near_limit_ratio,
@@ -76,23 +108,13 @@ def main() -> None:
         # items/s server ceiling at batch 8k, measured in PERF.md)
         block_mode=True,
         scope=scope,
+        max_queue=settings.overload_max_queue,
+        watermark_high=watermark_high,
+        watermark_critical=watermark_critical,
+        overload=overload,
+        fault_injector=fault_injector,
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
-
-    # FAULT_INJECT chaos hook (site sidecar.server.submit): lets staging
-    # rehearse slow-engine / error-reply / dropped-connection behavior on
-    # the device-owner side; junk specs fail the boot here.
-    fault_injector = None
-    fault_rules = settings.fault_rules()
-    if fault_rules:
-        from ..testing.faults import FaultInjector
-
-        fault_injector = FaultInjector(
-            fault_rules, seed=settings.fault_inject_seed
-        )
-        logger.warning(
-            "FAULT_INJECT active (%d rule(s)) — chaos mode", len(fault_rules)
-        )
     debug = new_debug_server(
         "",
         settings.debug_port,

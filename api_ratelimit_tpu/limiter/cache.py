@@ -29,6 +29,20 @@ class CacheError(Exception):
     (src/redis/driver_impl.go:50-54, src/service/ratelimit.go:276-281)."""
 
 
+class DeadlineExceededError(CacheError):
+    """The request's propagated deadline (utils/deadline.py) expired before
+    the backend could answer — raised by the micro-batcher when it drops
+    expired items ahead of a device launch, or by the service when a
+    request arrives already expired. The transport maps it to gRPC
+    DEADLINE_EXCEEDED / HTTP 504: a late answer is worthless to a caller
+    that already timed out, so expired work must abort, never queue.
+
+    Subclasses CacheError so a layer that only knows the generic failure
+    contract still treats it as a counted backend condition — but the
+    service handles it BEFORE the FAILURE_MODE_DENY ladder (a fallback
+    answer would still be late)."""
+
+
 class RateLimitCache(Protocol):
     def do_limit(
         self,

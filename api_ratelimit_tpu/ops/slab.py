@@ -56,7 +56,7 @@ import jax.numpy as jnp
 from .decide import DecideResult, decide, floor_div_exact_i32
 
 ROW_WIDTH = 8
-COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE = range(5)
+COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
 
 
 class SlabState(NamedTuple):
@@ -337,7 +337,11 @@ def _slab_update_sorted(
             s_after,
             cur_window.astype(jnp.uint32),
             expire_at.astype(jnp.uint32),
-            jnp.zeros_like(s_fp_lo),
+            # window length: lets the watermark sweep (slab_sweep_expired)
+            # reclaim slots whose fixed window ended even though their
+            # jittered TTL (expire_at) hasn't — the occupancy bloat the
+            # high watermark acts on
+            s_div.astype(jnp.uint32),
             jnp.zeros_like(s_fp_lo),
             jnp.zeros_like(s_fp_lo),
         ],
@@ -590,3 +594,32 @@ def slab_live_slots(state: SlabState, now) -> jnp.ndarray:
     """Occupancy gauge: an O(n_slots) reduction, so it runs on the
     stats-flush cadence, never in the per-batch hot path."""
     return live_slot_count(state.table, now)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def slab_sweep_expired(
+    state: SlabState, now
+) -> tuple[SlabState, jnp.ndarray]:
+    """High-watermark compaction pass: reclaim slots whose FIXED WINDOW has
+    ended but which are still 'live' by their jittered TTL.
+
+    expire_at = window TTL + up to EXPIRATION_JITTER_MAX_SECONDS of jitter
+    (the reference's thundering-herd smearing) — so a per-second counter
+    can pin a slot for minutes after its window closed. Those slots carry
+    no decision state (a rolled-over window restarts at base 0 on the next
+    touch, _slab_update_sorted's same_window gate), so zeroing them frees
+    occupancy without evicting any live counter. O(n_slots), triggered by
+    the SLAB_WATERMARK_HIGH policy on the stats cadence — never in the
+    per-batch hot path. Returns (state, uint32 count of reclaimed slots).
+
+    Rows written before the divider column existed (divider == 0) are left
+    alone — reclaiming them would need a guess at the window length."""
+    table = state.table
+    now = jnp.int32(now)
+    divider = table[:, COL_DIVIDER].astype(jnp.int32)
+    window_end = table[:, COL_WINDOW].astype(jnp.int32) + divider
+    live = table[:, COL_EXPIRE].astype(jnp.int32) > now
+    reclaim = live & (divider > 0) & (window_end <= now)
+    swept = jnp.sum(reclaim.astype(jnp.uint32), dtype=jnp.uint32)
+    table = jnp.where(reclaim[:, None], jnp.uint32(0), table)
+    return SlabState(table=table), swept

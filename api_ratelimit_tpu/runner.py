@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import random
+import signal as signal_module
 import sys
 import threading
 
@@ -86,13 +87,16 @@ def create_limiter(
     base: BaseRateLimiter,
     stats_store: Store,
     fault_injector=None,
+    overload=None,
 ) -> RateLimitCache:
     """BackendType switch (runner.go:43-64). The TPU backends get the
     `ratelimit` scope so the per-stage pipeline histograms
     (batcher.queue_wait_ms, device.{pack,launch,readback}_ms,
     sidecar.rpc_ms) land in the same store /metrics scrapes.
-    fault_injector (FAULT_INJECT) reaches the sidecar client's chaos
-    sites."""
+    fault_injector (FAULT_INJECT) reaches the sidecar client's and the
+    micro-batcher's chaos sites; overload (the AdmissionController) wires
+    the bounded-queue/brownout/watermark admission layer into the
+    in-process TPU engine."""
     backend = settings.backend_type
     scope = stats_store.scope("ratelimit")
     if backend == "tpu":
@@ -106,6 +110,7 @@ def create_limiter(
 
             devices = jax.devices()[: settings.tpu_mesh_devices]
             mesh = Mesh(np.array(devices), ("shard",))
+        watermark_high, watermark_critical = settings.slab_watermarks()
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
@@ -114,6 +119,11 @@ def create_limiter(
             use_pallas=None if settings.tpu_use_pallas else False,
             mesh=mesh,
             stats_scope=scope,
+            max_queue=settings.overload_max_queue,
+            watermark_high=watermark_high,
+            watermark_critical=watermark_critical,
+            overload=overload,
+            fault_injector=fault_injector,
         )
     if backend == "tpu-sidecar":
         from .backends.sidecar import new_sidecar_cache_from_settings
@@ -152,6 +162,7 @@ class Runner:
         self.runtime: DirectoryRuntimeLoader | None = None
         self.tracer = None
         self.fallback = None
+        self.overload = None
         self.fault_injector = None
         self._ready = threading.Event()
 
@@ -161,6 +172,22 @@ class Runner:
     def _build(self) -> None:
         settings = self.settings
         setup_logging(settings)
+
+        # Post-mortem muscle: faulthandler dumps every thread's stack on a
+        # hard fault, and SIGUSR2 dumps them on demand — the tool for "the
+        # service stopped answering, what is every worker doing?". The
+        # signal registration is main-thread-only (background/test boots
+        # skip it); enable() is safe anywhere.
+        import faulthandler
+
+        faulthandler.enable()
+        try:
+            if hasattr(signal_module, "SIGUSR2"):
+                faulthandler.register(
+                    signal_module.SIGUSR2, all_threads=True
+                )
+        except (ValueError, OSError):
+            pass  # not the main thread (run_background from a test)
 
         # Tracer from K_TRACING_* env, registered globally so the gRPC
         # interceptor and /json middleware pick it up (runner.go:90-95);
@@ -222,8 +249,25 @@ class Runner:
                 len(fault_rules),
             )
 
+        # Overload admission control (backends/overload.py): always built —
+        # the default knobs (no queue bound, no brownout) make it inert on
+        # the hot path while keeping the overload.* stats and the shed
+        # posture defined for watermark/fault-injected sheds.
+        from .backends.overload import AdmissionController
+
+        self.overload = AdmissionController(
+            shed_mode=settings.shed_mode(),
+            max_queue=settings.overload_max_queue,
+            brownout_target_ms=settings.overload_brownout_target_ms,
+            brownout_exit_ms=settings.overload_brownout_exit_ms,
+            ewma_alpha=settings.overload_ewma_alpha,
+            scope=self.scope,
+        )
+        self.server.health.add_degraded_probe(self.overload.degraded_reason)
+
         cache = create_limiter(
-            settings, base, self.stats_store, self.fault_injector
+            settings, base, self.stats_store, self.fault_injector,
+            self.overload,
         )
 
         # Slab health gauges (ratelimit.slab.*) for engines that expose a
@@ -236,6 +280,10 @@ class Runner:
             self.stats_store.add_stat_generator(
                 SlabHealthStats(engine, self.scope.scope("slab"))
             )
+        # Watermark degraded probe: slab pressure/saturation shows up in
+        # the /healthcheck body next to the fallback/overload reasons.
+        if engine is not None and hasattr(engine, "watermark_reason"):
+            self.server.health.add_degraded_probe(engine.watermark_reason)
 
         self.runtime = DirectoryRuntimeLoader(
             runtime_path=settings.runtime_path,
@@ -269,6 +317,10 @@ class Runner:
             runtime_watch_root=settings.runtime_watch_root,
             max_sleeping_routines=settings.max_sleeping_routines,
             fallback=self.fallback,
+            overload=self.overload,
+            # drain-aware pacing: once health flips for shutdown, throttle
+            # sleeps shed instead of pinning workers through the drain
+            draining_probe=lambda: not self.server.health.ok(),
         )
 
         def dump_config() -> str:

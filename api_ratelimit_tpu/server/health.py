@@ -36,17 +36,24 @@ class HealthChecker:
         self._cond = threading.Condition()
         self._version = 0  # bumped per transition; lets Watch detect changes
         self._watchers = 0
-        self._degraded_probe = None
+        self._degraded_probes: list = []
 
     def set_degraded_probe(self, probe) -> None:
-        """probe() -> None while the backend is healthy, or a short reason
-        string while the service is running on the FAILURE_MODE_DENY
-        fallback ladder (backends/fallback.py). Degradation is reported in
-        the /healthcheck BODY only — the status stays 200 and gRPC stays
-        SERVING, because a degraded fail-open instance must keep taking
-        traffic (draining it would turn a backend outage into a serving
-        outage, the exact storm the ladder exists to prevent)."""
-        self._degraded_probe = probe
+        """probe() -> None while healthy, or a short reason string while
+        the service runs degraded — on the FAILURE_MODE_DENY fallback
+        ladder (backends/fallback.py), shedding under overload admission
+        control (backends/overload.py), or past a slab watermark
+        (backends/tpu.py). Multiple probes stack; every firing reason is
+        reported. Degradation is reported in the /healthcheck BODY only —
+        the status stays 200 and gRPC stays SERVING, because a degraded
+        instance must keep taking traffic (draining it would turn a
+        backend outage or an overload into a serving outage, the exact
+        storm both ladders exist to prevent)."""
+        self._degraded_probes.append(probe)
+
+    # registration and stacking are the same operation; the alias keeps
+    # call sites readable when adding the Nth probe
+    add_degraded_probe = set_degraded_probe
 
     def ok(self) -> bool:
         with self._cond:
@@ -140,10 +147,16 @@ class HealthChecker:
     def http_response(self) -> tuple[int, str]:
         if not self.ok():
             return (500, "")
-        probe = self._degraded_probe
-        reason = probe() if probe is not None else None
-        if reason:
+        reasons = []
+        for probe in self._degraded_probes:
+            try:
+                reason = probe()
+            except Exception:  # a probe bug must not fail the healthcheck
+                continue
+            if reason:
+                reasons.append(reason)
+        if reasons:
             # body keeps the "OK" prefix so checkers that string-match the
             # healthy body keep passing; orchestrators see the suffix
-            return (200, f"OK (degraded: {reason})")
+            return (200, f"OK (degraded: {'; '.join(reasons)})")
         return (200, "OK")

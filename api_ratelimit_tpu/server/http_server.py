@@ -38,10 +38,12 @@ from typing import Callable
 
 from google.protobuf import json_format
 
-from ..limiter.cache import CacheError
+from ..backends.overload import OverloadError
+from ..limiter.cache import CacheError, DeadlineExceededError
 from ..pb import rls_v3
 from ..service.ratelimit import RateLimitService, ServiceError
 from .. import tracing
+from ..utils.deadline import deadline_scope
 from . import proto_adapter
 from .health import HealthChecker
 
@@ -142,16 +144,35 @@ class HttpServer:
 
 
 def add_json_handler(
-    server: HttpServer, service: RateLimitService, stats_scope=None
+    server: HttpServer,
+    service: RateLimitService,
+    stats_scope=None,
+    deadline_propagation: bool = True,
 ) -> None:
     """POST /json — HTTP/JSON mirror of the v3 RPC (server_impl.go:62-104).
     stats_scope (optional) records transport.json_ms: handler wall time —
-    body read + jsonpb conversion + the service call."""
+    body read + jsonpb conversion + the service call.
+
+    deadline_propagation reads Envoy's x-envoy-expected-rq-timeout-ms
+    request header (the HTTP twin of the gRPC deadline) and binds it via
+    utils/deadline.py, so expired work sheds with 504 instead of answering
+    late."""
     h_receive = (
         stats_scope.scope("transport").histogram("json_ms")
         if stats_scope is not None
         else None
     )
+
+    def _remaining_seconds(h: _Handler) -> float | None:
+        if not deadline_propagation:
+            return None
+        raw = h.headers.get("x-envoy-expected-rq-timeout-ms")
+        if not raw:
+            return None
+        try:
+            return float(raw) / 1e3
+        except ValueError:
+            return None  # junk header: no deadline, not a 400
 
     def handle(h: _Handler) -> None:
         # HTTP middleware span honoring inbound B3 headers
@@ -159,7 +180,8 @@ def add_json_handler(
         t0 = time.perf_counter() if h_receive is not None else 0.0
         with tracing.start_http_server_span("/json", h.headers) as span:
             with tracing.activate(span):
-                _handle_json(h)
+                with deadline_scope(_remaining_seconds(h)):
+                    _handle_json(h)
         if h_receive is not None:
             h_receive.record((time.perf_counter() - t0) * 1e3)
 
@@ -185,6 +207,15 @@ def add_json_handler(
             internal = proto_adapter.request_from_v3(req)
             overall, statuses, headers = service.should_rate_limit(internal)
             resp = proto_adapter.response_to_v3(overall, statuses, headers)
+        except DeadlineExceededError as e:
+            # the caller's propagated deadline passed: a late 200 helps
+            # nobody — 504, matching the gRPC DEADLINE_EXCEEDED mapping
+            h._write(504, f"Gateway Timeout: {e}\n".encode())
+            return
+        except OverloadError as e:
+            # shed by admission control (unavailable posture): retriable
+            h._write(503, f"Service Unavailable: {e}\n".encode())
+            return
         except (CacheError, ServiceError) as e:
             h._write(500, f"Internal Server Error: {e}\n".encode())
             return

@@ -44,9 +44,13 @@ class Server:
         stats_store,
         grpc_max_workers: int = 32,
         enable_metrics: bool = True,
+        deadline_propagation: bool = True,
     ):
         self.health = HealthChecker()
         self.stats_store = stats_store
+        # OVERLOAD_DEADLINE_PROPAGATION: capture the client deadline at the
+        # transport edge and thread it down (utils/deadline.py)
+        self._deadline_propagation = bool(deadline_propagation)
 
         # Server spans enter via the tracing interceptor (runner.go:95); the
         # interceptor resolves the global tracer per call, so it is a no-op
@@ -101,12 +105,27 @@ class Server:
         (runner.go:115-121). The transport receive histograms
         (<scope>.transport.{grpc_ms,json_ms}) hang off the same scope."""
         rls_grpc.add_v3_servicer(
-            RateLimitServicerV3(service, stats_scope), self.grpc_server
+            RateLimitServicerV3(
+                service,
+                stats_scope,
+                deadline_propagation=self._deadline_propagation,
+            ),
+            self.grpc_server,
         )
         rls_grpc.add_v2_servicer(
-            RateLimitServicerV2(service, stats_scope), self.grpc_server
+            RateLimitServicerV2(
+                service,
+                stats_scope,
+                deadline_propagation=self._deadline_propagation,
+            ),
+            self.grpc_server,
         )
-        add_json_handler(self.http, service, stats_scope)
+        add_json_handler(
+            self.http,
+            service,
+            stats_scope,
+            deadline_propagation=self._deadline_propagation,
+        )
 
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT/SIGHUP -> drain + stop (server_impl.go:255-269).
@@ -183,4 +202,5 @@ def new_server(settings, stats_store) -> Server:
         debug_port=settings.debug_port,
         stats_store=stats_store,
         enable_metrics=settings.debug_metrics_enabled,
+        deadline_propagation=settings.overload_deadline_propagation,
     )

@@ -24,12 +24,19 @@ import time
 from typing import Callable, Protocol, Sequence
 
 from ..assertx import assert_
+from ..backends.overload import (
+    SHED_MODE_ALLOW,
+    SHED_MODE_DENY,
+    BrownoutError,
+    OverloadError,
+)
 from ..config.loader import ConfigFile, RateLimitConfig, load_config
-from ..limiter.cache import CacheError, RateLimitCache
+from ..limiter.cache import CacheError, DeadlineExceededError, RateLimitCache
 from ..models.config import ConfigError, RateLimit
 from ..models.descriptors import RateLimitRequest
-from ..models.response import Code, DoLimitResponse, HeaderValue
+from ..models.response import Code, DescriptorStatus, DoLimitResponse, HeaderValue
 from ..tracing import active_span
+from ..utils import deadline as request_deadline
 from ..utils.sampler import BurstSampler, RandomSampler, Sampler
 from ..utils.timeutil import TimeSource
 
@@ -70,6 +77,9 @@ class _ServiceStats:
         call_scope = scope.scope("call.should_rate_limit")
         self.redis_error = call_scope.counter("redis_error")
         self.service_error = call_scope.counter("service_error")
+        # throttle sleeps skipped because the server was draining, browned
+        # out, or out of sleeper slots — pacing must never pin workers
+        self.sleep_shed = call_scope.counter("sleep_shed")
         self.latency = call_scope.histogram("latency_ms")
 
 
@@ -85,15 +95,30 @@ class RateLimitService:
         config_loader: Callable[[list[ConfigFile]], RateLimitConfig] | None = None,
         report_detail_sampler: Sampler | None = None,
         fallback=None,
+        overload=None,
+        draining_probe: Callable[[], bool] | None = None,
     ):
         """fallback: optional backends.fallback.FallbackLimiter — the
         FAILURE_MODE_DENY degradation ladder. When set, a backend
         CacheError no longer propagates: redis_error is still counted, and
         the fallback answers the request (deny-all / fail-open / degraded
-        local limiting). None keeps the legacy raise-through behavior."""
+        local limiting). None keeps the legacy raise-through behavior.
+
+        overload: optional backends.overload.AdmissionController — the
+        pressure-side ladder. Requests arriving during a brownout are shed
+        before any descriptor work, and OverloadError from the backend
+        (queue full, slab saturated) is answered by the configured shed
+        posture instead of the failure ladder. None treats OverloadError
+        like any CacheError (legacy).
+
+        draining_probe: () -> True while the server is draining (health
+        flipped for shutdown); used to skip throttle pacing sleeps so
+        shutdown can never be pinned by sleeping workers."""
         self._runtime = runtime
         self._cache = cache
         self._fallback = fallback
+        self._overload = overload
+        self._draining_probe = draining_probe
         self._stats = _ServiceStats(stats_scope)
         # per-rule stats live under <scope>.rate_limit.<domain>.<composite>
         self._rl_stats_scope = stats_scope.scope("rate_limit")
@@ -164,6 +189,22 @@ class RateLimitService:
         t_start = time.perf_counter()
         try:
             return self._worker(request)
+        except DeadlineExceededError as e:
+            # Shed, not a backend failure: no redis_error — the drop is
+            # counted in overload.deadline_expired where it happened. The
+            # transport maps this to DEADLINE_EXCEEDED / 504.
+            span = active_span()
+            if span is not None:
+                span.set_error(e)
+            raise
+        except OverloadError as e:
+            # unavailable-posture shed (or no controller wired): surfaces
+            # as UNAVAILABLE / 503; counted in overload.shed at the shed
+            # decision, never as redis_error
+            span = active_span()
+            if span is not None:
+                span.set_error(e)
+            raise
         except CacheError as e:
             self._stats.redis_error.add(1)
             span = active_span()
@@ -226,6 +267,22 @@ class RateLimitService:
             raise ServiceError("rate limit domain must not be empty")
         if not request.descriptors:
             raise ServiceError("rate limit descriptor list must not be empty")
+        # Admission control, cheapest-first (backends/overload.py): a
+        # request whose propagated deadline already passed aborts now — a
+        # late answer is worthless — and a brownout sheds BEFORE any
+        # config/descriptor work so overload costs O(1) per shed request.
+        if request_deadline.expired():
+            if self._overload is not None:
+                self._overload.note_deadline_expired()
+            raise DeadlineExceededError(
+                "request deadline expired before dispatch"
+            )
+        if self._overload is not None and self._overload.should_shed():
+            return self._shed_answer(
+                request,
+                (),
+                BrownoutError("admission brownout: shedding pre-dispatch"),
+            )
         config = self.get_current_config()
         if config is None:
             raise ServiceError("no rate limit configuration loaded")
@@ -251,6 +308,20 @@ class RateLimitService:
 
         try:
             do_limit_response = self._cache.do_limit(request, limits)
+        except DeadlineExceededError:
+            # expired in the batcher queue: abort, never answer late, and
+            # never consult the failure ladder (its answer would still be
+            # late)
+            raise
+        except OverloadError as e:
+            # Pressure ladder: queue full / slab saturated from the
+            # backend is a shed, answered by OVERLOAD_SHED_MODE policy.
+            # Without a controller the error surfaces to the transport
+            # (UNAVAILABLE) — overload is never routed to the FAILURE
+            # ladder, which would misread pressure as backend death.
+            if self._overload is None:
+                raise
+            return self._shed_answer(request, limits, e)
         except CacheError as e:
             # Degradation ladder (FAILURE_MODE_DENY): a dead backend — or
             # the sidecar breaker failing fast while open — degrades to a
@@ -269,6 +340,8 @@ class RateLimitService:
         else:
             if self._fallback is not None:
                 self._fallback.note_success()
+            if self._overload is not None:
+                self._overload.note_ok()
         assert_(len(limits) == len(do_limit_response.descriptor_statuses))
 
         if sleep_on_throttle and do_limit_response.throttle_millis > 0:
@@ -285,11 +358,57 @@ class RateLimitService:
         )
         return overall, statuses, headers
 
+    def _shed_answer(
+        self,
+        request: RateLimitRequest,
+        limits: Sequence[RateLimit | None],
+        error: OverloadError,
+    ) -> tuple[Code, list, list[HeaderValue]]:
+        """Answer one shed request by the configured posture
+        (OVERLOAD_SHED_MODE). `unavailable` re-raises — Envoy sees a
+        retriable UNAVAILABLE; `allow` fails open with an
+        `x-ratelimit-shed` header so upstreams can tell a shed OK from an
+        enforced one; `deny` answers OVER_LIMIT for every descriptor.
+        Mirrors FallbackLimiter's synthesized statuses — the two ladders
+        share response semantics, they just trigger on different causes."""
+        overload = self._overload
+        overload.note_shed(error)
+        span = active_span()
+        if span is not None:
+            span.log_kv(
+                event="overload_shed",
+                shed_mode=overload.shed_mode,
+                cause=error.token,
+            )
+        if overload.shed_mode == SHED_MODE_ALLOW:
+            code = Code.OK
+        elif overload.shed_mode == SHED_MODE_DENY:
+            code = Code.OVER_LIMIT
+        else:  # unavailable: the wire error IS the policy
+            raise error
+        statuses = []
+        for i in range(len(request.descriptors)):
+            limit = limits[i] if i < len(limits) else None
+            statuses.append(
+                DescriptorStatus(
+                    code=code,
+                    current_limit=limit.limit if limit is not None else None,
+                    limit_remaining=0,
+                )
+            )
+        return code, statuses, [HeaderValue("x-ratelimit-shed", error.token)]
+
     def _maybe_sleep(self, do_limit_response: DoLimitResponse) -> None:
         """Server-side pacing: sleep the handler instead of answering
         immediately, bounded by the sleeper semaphore (ratelimit.go:180-205).
         Traced as a child span carrying the sleep duration, with an error tag
-        when the semaphore is exhausted (ratelimit.go:181-204)."""
+        when the semaphore is exhausted (ratelimit.go:181-204).
+
+        Hardened for overload/shutdown: the sleep is SKIPPED (and
+        sleep_shed counted) while the server is draining or the admission
+        controller is browned out — pacing must never pin worker threads
+        when the process is trying to drain or shed; the remaining
+        throttle_millis still reaches the client via the detail header."""
         # Like the reference, the span is created before the semaphore check,
         # so a nil/None semaphore still emits an (empty) pacing span.
         parent = active_span()
@@ -302,6 +421,17 @@ class RateLimitService:
                 "throttling.sleep_ms", do_limit_response.throttle_millis
             )
         try:
+            if self._draining_probe is not None and self._draining_probe():
+                self._stats.sleep_shed.inc()
+                if throttle_span is not None:
+                    throttle_span.log_kv(event="throttling.drain_shed")
+                return
+            if self._overload is not None and self._overload.should_shed():
+                self._stats.sleep_shed.inc()
+                self._overload.note_sleep_shed()
+                if throttle_span is not None:
+                    throttle_span.log_kv(event="throttling.overload_shed")
+                return
             sem = self._sleeper_semaphore
             if sem is None:
                 return
@@ -318,9 +448,13 @@ class RateLimitService:
                     sem.release()
                 # throttled server-side by sleeping; don't also report it
                 do_limit_response.throttle_millis = 0
-            elif throttle_span is not None:
-                throttle_span.log_kv(event="throttling.sem_exhausted")
-                throttle_span.set_tag("error", True)
+            else:
+                # all sleeper slots busy: shed the sleep rather than queue
+                # more pinned threads behind the pacing semaphore
+                self._stats.sleep_shed.inc()
+                if throttle_span is not None:
+                    throttle_span.log_kv(event="throttling.sem_exhausted")
+                    throttle_span.set_tag("error", True)
         finally:
             if throttle_span is not None:
                 throttle_span.finish()

@@ -139,6 +139,29 @@ class Settings:
     sidecar_retry_backoff_max: float = 0.25
     sidecar_breaker_threshold: int = 5
     sidecar_breaker_reset: float = 5.0
+    # --- overload admission control (this framework; backends/overload.py)
+    # What a shed request is answered with: "unavailable" (gRPC UNAVAILABLE /
+    # HTTP 503, retriable by Envoy — the default), "allow" (fail open: OK +
+    # x-ratelimit-shed header), or "deny" (OVER_LIMIT for every descriptor).
+    overload_shed_mode: str = "unavailable"
+    # hard bound on items awaiting a batcher take; 0 = unbounded (legacy)
+    overload_max_queue: int = 0
+    # latency brownout: shed new submits while the EWMA of batcher queue
+    # wait exceeds the target; exit below OVERLOAD_BROWNOUT_EXIT_MS
+    # (default target/2 — the hysteresis gap). 0 disables the brownout.
+    overload_brownout_target_ms: float = 0.0
+    overload_brownout_exit_ms: float = 0.0
+    overload_ewma_alpha: float = 0.2
+    # capture the client deadline at the transport edge (gRPC
+    # time_remaining / x-envoy-expected-rq-timeout-ms) and drop expired
+    # work before device launches instead of answering late
+    overload_deadline_propagation: bool = True
+    # slab-saturation watermarks (occupancy fractions in (0, 1]; 0 = off):
+    # past HIGH an expired-slot sweep reclaims window-ended slots and the
+    # healthcheck reports pressure; past CRITICAL new submits shed by the
+    # OVERLOAD_SHED_MODE posture instead of silently evicting live counters
+    slab_watermark_high: float = 0.0
+    slab_watermark_critical: float = 0.0
     # fault injection (testing/faults.py): comma-separated
     # site:kind:value rules, e.g.
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -180,6 +203,40 @@ class Settings:
             f"FAILURE_MODE_DENY must be a boolean, 'degraded', or empty, "
             f"got {self.failure_mode_deny!r}"
         )
+
+    def shed_mode(self) -> str:
+        """Validated OVERLOAD_SHED_MODE. Junk fails the boot like a typo'd
+        bucket ladder — a misspelled shed posture must not silently become
+        a different policy."""
+        from .backends.overload import SHED_MODES
+
+        v = self.overload_shed_mode.strip().lower()
+        if v not in SHED_MODES:
+            raise ValueError(
+                f"OVERLOAD_SHED_MODE must be one of {', '.join(SHED_MODES)}, "
+                f"got {self.overload_shed_mode!r}"
+            )
+        return v
+
+    def slab_watermarks(self) -> tuple[float, float]:
+        """Validated (high, critical) occupancy watermarks; each 0 = off.
+        Junk (out of (0, 1], or critical below high) fails the boot."""
+        high = float(self.slab_watermark_high)
+        crit = float(self.slab_watermark_critical)
+        for name, v in (
+            ("SLAB_WATERMARK_HIGH", high),
+            ("SLAB_WATERMARK_CRITICAL", crit),
+        ):
+            if v < 0 or v > 1:
+                raise ValueError(
+                    f"{name} must be an occupancy fraction in [0, 1], got {v}"
+                )
+        if 0 < crit < high:
+            raise ValueError(
+                f"SLAB_WATERMARK_CRITICAL ({crit}) must not sit below "
+                f"SLAB_WATERMARK_HIGH ({high})"
+            )
+        return high, crit
 
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
@@ -267,6 +324,22 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ),
     ("sidecar_breaker_threshold", "SIDECAR_BREAKER_THRESHOLD", int),
     ("sidecar_breaker_reset", "SIDECAR_BREAKER_RESET", _parse_duration_seconds),
+    ("overload_shed_mode", "OVERLOAD_SHED_MODE", str),
+    ("overload_max_queue", "OVERLOAD_MAX_QUEUE", int),
+    (
+        "overload_brownout_target_ms",
+        "OVERLOAD_BROWNOUT_TARGET_MS",
+        float,
+    ),
+    ("overload_brownout_exit_ms", "OVERLOAD_BROWNOUT_EXIT_MS", float),
+    ("overload_ewma_alpha", "OVERLOAD_EWMA_ALPHA", float),
+    (
+        "overload_deadline_propagation",
+        "OVERLOAD_DEADLINE_PROPAGATION",
+        _parse_bool,
+    ),
+    ("slab_watermark_high", "SLAB_WATERMARK_HIGH", float),
+    ("slab_watermark_critical", "SLAB_WATERMARK_CRITICAL", float),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]

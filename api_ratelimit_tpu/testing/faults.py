@@ -13,7 +13,8 @@ Spec grammar (FAULT_INJECT env var; FAULT_INJECT_SEED seeds the RNG):
     spec  := rule ("," rule)*
     rule  := site ":" kind ":" value
     site  := dotted lowercase id (the instrumentation point)
-    kind  := error | drop | partial_write     value = probability in (0, 1]
+    kind  := error | drop | partial_write
+           | queue_full                       value = probability in (0, 1]
            | delay_ms                         value = milliseconds >= 0
 
 e.g. FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -23,11 +24,15 @@ when repeated); the probabilistic kinds are evaluated in spec order and the
 first one that trips wins. Junk specs raise ValueError so a typo'd spec
 fails the boot (settings.fault_rules()), like a typo'd bucket ladder.
 
-Sites wired in this codebase (backends/sidecar.py):
+Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
 
     sidecar.dial            client: each dial of the sidecar address
     sidecar.submit          client: each SUBMIT attempt (before the send)
     sidecar.server.submit   server: each SUBMIT frame (before the engine)
+    batcher.submit          micro-batcher: each submit before enqueue —
+                            delay_ms stalls the caller (a wedged queue),
+                            queue_full raises QueueFullError so chaos tests
+                            rehearse overload shedding deterministically
 
 The injector is mutable at runtime (configure()/clear()) so chaos tests can
 clear faults mid-scenario — e.g. to watch a circuit breaker's half-open
@@ -42,8 +47,8 @@ import re
 import threading
 import time
 
-FAULT_KINDS = ("error", "drop", "partial_write", "delay_ms")
-_PROB_KINDS = ("error", "drop", "partial_write")
+FAULT_KINDS = ("error", "drop", "partial_write", "queue_full", "delay_ms")
+_PROB_KINDS = ("error", "drop", "partial_write", "queue_full")
 
 _SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
@@ -104,7 +109,7 @@ class FaultInjector:
     """Evaluates fault rules at named sites. Thread-safe; deterministic for
     a given seed and fire() sequence. fire() sleeps for matched delay_ms
     rules, then returns the first probabilistic action that trips
-    ('error' | 'drop' | 'partial_write') or None."""
+    ('error' | 'drop' | 'partial_write' | 'queue_full') or None."""
 
     def __init__(self, rules=(), seed: int = 0, sleep=time.sleep):
         self._lock = threading.Lock()

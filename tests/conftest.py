@@ -33,7 +33,17 @@ import jax  # noqa: E402
 if not TPU_TESTS:
     jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test deadlock guard (the pytest-timeout "thread" method, without the
+# dependency — the container has no pytest_timeout): arm
+# faulthandler.dump_traceback_later before each test and cancel it after.
+# A shed/drain deadlock then surfaces as an all-thread stack dump plus a
+# hard exit within PYTEST_PER_TEST_TIMEOUT seconds, instead of eating the
+# whole 870s tier-1 budget silently. 0 disables.
+PER_TEST_TIMEOUT = float(os.environ.get("PYTEST_PER_TEST_TIMEOUT", "300"))
 
 
 def pytest_configure(config):
@@ -43,6 +53,17 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-second subprocess tests (bench artifact)"
     )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    if PER_TEST_TIMEOUT > 0:
+        faulthandler.dump_traceback_later(PER_TEST_TIMEOUT, exit=True)
+    try:
+        yield
+    finally:
+        if PER_TEST_TIMEOUT > 0:
+            faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture

@@ -147,7 +147,9 @@ def test_grpc_v3_error_on_empty_domain(running_server):
         stub = rls_grpc.RateLimitServiceV3Stub(ch)
         with pytest.raises(grpc.RpcError) as err:
             stub.ShouldRateLimit(v3_request("", [[("key1", "a")]]))
-        assert err.value.code() == grpc.StatusCode.UNKNOWN
+        # request/config errors are INTERNAL (retrying cannot help);
+        # backend failures map to UNAVAILABLE so Envoy can retry those
+        assert err.value.code() == grpc.StatusCode.INTERNAL
         assert "domain" in err.value.details()
     snap = runner.stats_store.debug_snapshot()
     assert snap["ratelimit.service.call.should_rate_limit.service_error"] == 1
@@ -562,7 +564,8 @@ class TestBackendMatrix:
             stub = rls_grpc.RateLimitServiceV3Stub(ch)
             with pytest.raises(grpc.RpcError) as err:
                 stub.ShouldRateLimit(v3_request("basic", [[("key1", "a")]]))
-            assert err.value.code() == grpc.StatusCode.UNKNOWN
+            # backend failure: UNAVAILABLE, the Envoy-retriable class
+            assert err.value.code() == grpc.StatusCode.UNAVAILABLE
         snap = runner.stats_store.debug_snapshot()
         assert snap["ratelimit.service.call.should_rate_limit.redis_error"] == 1
         runner.stop()
