@@ -256,6 +256,24 @@ class MicroBatcher:
             while self._items or self._futures or self._inflight:
                 self._idle.wait(timeout=0.05)
 
+    def drain(self) -> None:
+        """Graceful-drain quiesce: refuse new submits from now on, then
+        block until everything already enqueued (including a batch the
+        dispatcher took and any launch in flight) has executed. The
+        warm-restart handoff runs this before the final slab snapshot
+        (persist/snapshotter.py) so a planned restart captures every
+        decision that was admitted; unlike close(), worker threads are
+        left to wind down on their own and close() still follows."""
+        if self._window <= 0:
+            with self._direct_lock:
+                self._closed = True
+            return
+        with self._lock:
+            self._closed = True
+            self._wakeup.notify_all()
+            while self._items or self._futures or self._inflight:
+                self._idle.wait(timeout=0.05)
+
     def close(self) -> None:
         if self._window <= 0:
             with self._direct_lock:

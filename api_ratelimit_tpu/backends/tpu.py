@@ -48,7 +48,10 @@ from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint_many, split_fingerprints
 from ..ops.slab import (
+    ROW_WIDTH,
     make_slab,
+    slab_export_copy,
+    slab_import_rows,
     slab_live_slots,
     slab_step_after,
     slab_sweep_expired,
@@ -347,8 +350,65 @@ class SlabDeviceEngine:
     def flush(self) -> None:
         self._batcher.flush()
 
+    def drain(self) -> None:
+        """Graceful-drain quiesce: refuse new submits, finish everything
+        already queued (batcher drain). The warm-restart snapshotter calls
+        this before its final snapshot so a planned restart hands over
+        every admitted decision (persist/snapshotter.py)."""
+        self._batcher.drain()
+
     def close(self) -> None:
         self._batcher.close()
+
+    # -- warm restart (persist/): per-shard slab export/import --
+
+    @property
+    def shard_count(self) -> int:
+        """Snapshot shard layout: one file per device sub-table."""
+        if self._engine is not None:
+            return self._engine.shard_count
+        return 1
+
+    @property
+    def shard_slots(self) -> int:
+        """Rows per snapshot shard (the restore-time topology check)."""
+        if self._engine is not None:
+            return self._engine.shard_slots
+        return self._n_slots
+
+    def export_tables(self) -> list[np.ndarray]:
+        """Quiesce-and-copy for the snapshotter: under the state lock only
+        a device-side copy is dispatched — it sequences after every
+        in-flight launch on the device stream, so the launch pipeline
+        never waits on the D2H drain, which happens against the detached
+        copy after the lock is released."""
+        if self._engine is not None:
+            return self._engine.export_tables()
+        with self._state_lock:
+            copy = slab_export_copy(self._state)
+        return [np.asarray(copy)]
+
+    def import_tables(self, tables: list[np.ndarray]) -> None:
+        """Boot-time restore upload: replace the slab with reconciled
+        snapshot rows (persist/snapshotter.py validated shard layout and
+        applied the expiry reconciliation before calling)."""
+        if self._engine is not None:
+            self._engine.import_tables(tables)
+            return
+        if len(tables) != 1:
+            raise ValueError(
+                f"single-device slab restores from 1 shard, got {len(tables)}"
+            )
+        rows = np.asarray(tables[0], dtype=np.uint32)
+        if rows.shape != (self._n_slots, ROW_WIDTH):
+            raise ValueError(
+                f"snapshot table shape {rows.shape} does not match the "
+                f"configured slab ({self._n_slots}, {ROW_WIDTH})"
+            )
+        with self._state_lock:
+            self._state = jax.device_put(
+                slab_import_rows(rows), self._device
+            )
 
     # -- device execution (dispatcher thread / direct-mode caller only) --
 

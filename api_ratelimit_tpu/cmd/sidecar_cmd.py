@@ -8,7 +8,10 @@ through this process's slab (backends/sidecar.py).
 
 Honors the same TPU_* env knobs as the in-process backend: TPU_SLAB_SLOTS,
 TPU_BATCH_WINDOW (recommended: 100-500us — the cross-frontend coalescing
-window), TPU_BATCH_LIMIT, TPU_MESH_DEVICES, TPU_USE_PALLAS.
+window), TPU_BATCH_LIMIT, TPU_MESH_DEVICES, TPU_USE_PALLAS — and the
+SLAB_SNAPSHOT_* warm-restart knobs: the sidecar owns the slab, so the
+crash-safe snapshot/restore cycle (persist/) runs HERE, never in the
+frontends.
 
 Telemetry: the sidecar owns the device, so the device-stage histograms
 (batcher queue wait / batch size, pack/launch/readback) and the slab
@@ -115,6 +118,28 @@ def main() -> None:
         fault_injector=fault_injector,
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
+
+    # Warm restart (persist/): the sidecar IS the device owner, so the
+    # snapshot/restore cycle lives here — restore the shared slab before
+    # accepting the first frontend connection, snapshot on the
+    # SLAB_SNAPSHOT_INTERVAL_MS cadence, final copy on graceful shutdown.
+    snapshotter = None
+    snap_dir, snap_interval_ms, snap_stale_ms = settings.snapshot_config()
+    if snap_dir:
+        from ..persist.snapshotter import SlabSnapshotter
+
+        snapshotter = SlabSnapshotter(
+            engine,
+            snap_dir,
+            interval_ms=snap_interval_ms,
+            stale_after_ms=snap_stale_ms,
+            time_source=RealTimeSource(),
+            scope=scope,
+            fault_injector=fault_injector,
+        )
+        snapshotter.restore()
+        snapshotter.start()
+
     debug = new_debug_server(
         "",
         settings.debug_port,
@@ -143,6 +168,10 @@ def main() -> None:
         signal.signal(sig, on_signal)
     stop.wait()
     server.close()
+    if snapshotter is not None:
+        # frontends are disconnected; quiesce the batcher and hand the
+        # next process a slab with every admitted decision in it
+        snapshotter.drain()
     store.stop_flushing()
     debug.shutdown()
 

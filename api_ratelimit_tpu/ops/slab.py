@@ -579,6 +579,43 @@ def slab_step_decided(
     return state, _unsort(d.code, order).astype(jnp.uint8), health
 
 
+# --- warm-restart export/import (persist/) ----------------------------------
+#
+# The snapshot path must never stall the launch pipeline: export dispatches a
+# DEVICE-SIDE copy (sequenced after every in-flight step on the device
+# stream) and hands the detached buffer back — the caller blocks on the D2H
+# drain outside any lock, while subsequent steps keep donating the live
+# state. Import is the boot-time inverse: one H2D upload of a reconciled
+# host table (persist/snapshot.py reconcile_rows applies the expiry rules on
+# the host, where the restore-time clock lives).
+
+
+def slab_export_copy(state: SlabState) -> jnp.ndarray:
+    """Detached device-side copy of the row table (async dispatch; read it
+    back with np.asarray outside the state lock)."""
+    return jnp.array(state.table, copy=True)
+
+
+def slab_import_rows(rows, device=None) -> SlabState:
+    """Upload a reconciled (n_slots, ROW_WIDTH) uint32 host table as fresh
+    slab state; validates the shape so a wrong-topology snapshot can never
+    masquerade as a slab."""
+    import numpy as np
+
+    rows = np.asarray(rows, dtype=np.uint32)
+    if rows.ndim != 2 or rows.shape[1] != ROW_WIDTH:
+        raise ValueError(
+            f"slab rows must be (n_slots, {ROW_WIDTH}), got {rows.shape}"
+        )
+    n_slots = rows.shape[0]
+    if n_slots & (n_slots - 1):
+        raise ValueError(f"n_slots must be a power of two, got {n_slots}")
+    table = jnp.asarray(rows)
+    if device is not None:
+        table = jax.device_put(table, device)
+    return SlabState(table=table)
+
+
 def live_slot_count(table: jnp.ndarray, now) -> jnp.ndarray:
     """uint32 count of live (unexpired) rows — THE liveness definition,
     shared by the single-chip gauge below and the mesh-sharded reduction

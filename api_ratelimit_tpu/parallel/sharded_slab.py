@@ -43,6 +43,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases; accept
+# either home so the mesh engine works across the toolchain versions this
+# repo meets (the baked image ships 0.4.x, where only the experimental
+# module exists). When neither is present, surface one clear error at
+# engine/step construction instead of an AttributeError mid-trace —
+# tests skip on `shard_map is None` with a reason rather than failing
+# collection.
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # pragma: no cover - toolchain without shard_map
+        shard_map = None
+
+
+def _require_shard_map():
+    if shard_map is None:  # pragma: no cover - toolchain without shard_map
+        raise RuntimeError(
+            "this jax has neither jax.shard_map nor "
+            "jax.experimental.shard_map; the mesh-sharded slab engine "
+            "needs one of them (TPU_MESH_DEVICES must stay 0)"
+        )
+    return shard_map
+
 from ..ops.slab import (
     PACKED_OUT_ROWS,
     ROW_FP_HI,
@@ -145,7 +169,7 @@ def _sharded_body_after(
 
 def _build_step(mesh: Mesh, body, out_spec: P, **kw):
     axis = mesh.axis_names[0]
-    mapped = jax.shard_map(
+    mapped = _require_shard_map()(
         functools.partial(body, axis=axis, **kw),
         mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
@@ -235,7 +259,7 @@ def sharded_slab_step_after_compact(
     health[2]); state and blocks sharded on the leading axis, after sharded
     the same way (the host gathers and unscatters), health replicated."""
     axis = mesh.axis_names[0]
-    mapped = jax.shard_map(
+    mapped = _require_shard_map()(
         functools.partial(
             _sharded_body_after_compact,
             axis=axis,
@@ -292,7 +316,7 @@ class ShardedSlabEngine:
         self.drops_total = 0
         axis_name = axis
         self._live_slots = jax.jit(
-            jax.shard_map(
+            _require_shard_map()(
                 lambda table, now: jax.lax.psum(
                     live_slot_count(table, now), axis_name
                 ),
@@ -409,6 +433,51 @@ class ShardedSlabEngine:
         after_np = np.asarray(after_blocks)
         out[routed_idx] = after_np[routed_owner, within].astype(np.uint32)
         return out
+
+    # -- warm restart (persist/): per-shard slab export/import --
+
+    @property
+    def shard_count(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @property
+    def shard_slots(self) -> int:
+        return self.n_slots_global // self.shard_count
+
+    def export_tables(self) -> list[np.ndarray]:
+        """One host table per device sub-table, in shard order. Only the
+        device-side copy happens under the state lock (it sequences after
+        in-flight donating steps); the cross-device gather + D2H drain run
+        against the detached copy outside the lock."""
+        with self._state_lock:
+            copy = jnp.array(self._state, copy=True)
+        full = np.asarray(copy)
+        n_local = self.shard_slots
+        # P(axis, None) shards rows contiguously: shard i owns rows
+        # [i*n_local, (i+1)*n_local) — the same split import_tables inverts
+        return [
+            full[i * n_local : (i + 1) * n_local]
+            for i in range(self.shard_count)
+        ]
+
+    def import_tables(self, tables: list[np.ndarray]) -> None:
+        """Boot-time restore: reassemble the global table from per-shard
+        files and upload it with the slab's row sharding."""
+        n_dev = self.shard_count
+        if len(tables) != n_dev:
+            raise ValueError(
+                f"mesh slab restores from {n_dev} shards, got {len(tables)}"
+            )
+        full = np.concatenate(
+            [np.asarray(t, dtype=np.uint32) for t in tables], axis=0
+        )
+        if full.shape != (self.n_slots_global, ROW_WIDTH):
+            raise ValueError(
+                f"snapshot shards assemble to {full.shape}, slab is "
+                f"({self.n_slots_global}, {ROW_WIDTH})"
+            )
+        with self._state_lock:
+            self._state = jax.device_put(full, self._state_sharding)
 
     def _note_health(self, health) -> None:
         """Defer the tiny health readback off the hot path: park the device

@@ -1,0 +1,43 @@
+"""Warm restart: crash-safe slab snapshot/restore (the state-durability rung).
+
+PR 2 hardened the service against backend failure and PR 3 against
+overload; this package makes the STATE survive the process. A periodic,
+off-hot-path snapshotter copies the HBM slab to a CRC-protected, versioned
+file (snapshot.py: temp file + fsync + rename, so a crash mid-write leaves
+the previous snapshot intact), a boot-time restorer validates and
+reconciles it against the current clock before the first request, and a
+final snapshot rides the graceful-drain path so planned restarts lose ~0
+state. Snapshot files are per shard in mesh mode, mirroring the
+device-buffer-to-host-hierarchy tiering pattern (arxiv 2607.02574); the
+availability/accuracy trade it closes is the one distributed limiter
+designs call out (arxiv 2602.11741: a restarted limiter that forgets its
+windows fails open for a full window per key).
+
+snapshot.py holds the file format + reconcile rules (numpy only — the
+offline inspect CLI must not drag jax in); snapshotter.py holds the
+runtime service (periodic thread, boot restore, drain handoff, stats,
+staleness probe).
+"""
+
+from .snapshot import (
+    SNAPSHOT_VERSION,
+    SnapshotError,
+    SnapshotHeader,
+    load_snapshot,
+    read_header,
+    reconcile_rows,
+    write_snapshot,
+)
+from .snapshotter import SlabSnapshotter, snapshot_paths
+
+__all__ = [
+    "SNAPSHOT_VERSION",
+    "SnapshotError",
+    "SnapshotHeader",
+    "SlabSnapshotter",
+    "load_snapshot",
+    "read_header",
+    "reconcile_rows",
+    "snapshot_paths",
+    "write_snapshot",
+]

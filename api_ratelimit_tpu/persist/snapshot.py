@@ -1,0 +1,291 @@
+"""Slab snapshot file format: versioned header, CRC-protected payload,
+atomic replacement.
+
+One file per slab shard. Layout (all integers little-endian):
+
+    offset  size  field
+    0       8     magic          b"SLABSNP1"
+    8       4     version        format version (SNAPSHOT_VERSION)
+    12      4     flags          reserved, 0
+    16      8     created_at     unix seconds the copy was taken at
+    24      8     n_slots        rows in this shard's table
+    32      4     row_width      uint32 words per row (ops/slab.py ROW_WIDTH)
+    36      4     shard_index    which shard this file holds
+    40      4     shard_count    total shards the slab was split into
+    44      4     payload_crc    zlib.crc32 of the payload bytes
+    48      8     payload_len    payload byte length (n_slots*row_width*4)
+    56      4     header_crc     zlib.crc32 of bytes [0, 56)
+    60      ...   payload        the raw uint32 row table, C order
+
+Writes are crash-safe by construction: the bytes land in a same-directory
+temp file, fsync, then one atomic os.replace over the destination (and an
+fsync of the directory so the rename itself is durable) — a crash at any
+point leaves either the previous complete snapshot or none, never a torn
+one. The loader re-derives everything it trusts: magic/version/header CRC
+first, then payload length against both the header and the actual file
+size, then the payload CRC. Anything off raises SnapshotError — the caller
+boots cold rather than serving from a corrupt counter table.
+
+This module is numpy + stdlib only. tools/snapshot_inspect.py runs offline
+against these files and must never pay a jax import; the column constants
+below mirror ops/slab.py's row format (tests assert they stay equal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b"SLABSNP1"
+SNAPSHOT_VERSION = 1
+
+# Mirror of ops/slab.py's fused row format (tests/test_persist.py pins the
+# equivalence) — redeclared here so offline tools read rows without jax.
+ROW_WIDTH = 8
+COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
+
+_HEADER = struct.Struct("<8sIIqQIIIIQ")
+_HEADER_CRC = struct.Struct("<I")
+HEADER_SIZE = _HEADER.size + _HEADER_CRC.size  # 60 bytes
+
+FAULT_SITE_WRITE = "snapshot.write"  # testing/faults.py chaos site
+FAULT_SITE_LOAD = "snapshot.load"  # testing/faults.py chaos site
+
+
+class SnapshotError(Exception):
+    """A snapshot file failed validation (bad magic/version/CRC/shape) or
+    could not be read. The restore path answers every SnapshotError the
+    same way: reject the file, count snapshot.load_rejected, boot cold."""
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class SnapshotHeader:
+    version: int
+    created_at: int
+    n_slots: int
+    row_width: int
+    shard_index: int
+    shard_count: int
+    payload_crc: int
+    payload_len: int
+
+    def pack(self) -> bytes:
+        head = _HEADER.pack(
+            MAGIC,
+            self.version,
+            0,
+            self.created_at,
+            self.n_slots,
+            self.row_width,
+            self.shard_index,
+            self.shard_count,
+            self.payload_crc,
+            self.payload_len,
+        )
+        return head + _HEADER_CRC.pack(zlib.crc32(head))
+
+
+def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
+    if len(raw) < HEADER_SIZE:
+        raise SnapshotError(
+            f"{path}: truncated header ({len(raw)} bytes, need {HEADER_SIZE})"
+        )
+    (
+        magic,
+        version,
+        _flags,
+        created_at,
+        n_slots,
+        row_width,
+        shard_index,
+        shard_count,
+        payload_crc,
+        payload_len,
+    ) = _HEADER.unpack_from(raw)
+    if magic != MAGIC:
+        raise SnapshotError(f"{path}: bad magic {magic!r} (not a slab snapshot)")
+    (header_crc,) = _HEADER_CRC.unpack_from(raw, _HEADER.size)
+    if zlib.crc32(raw[: _HEADER.size]) != header_crc:
+        raise SnapshotError(f"{path}: header CRC mismatch")
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            f"{path}: snapshot version {version} != supported "
+            f"{SNAPSHOT_VERSION}"
+        )
+    header = SnapshotHeader(
+        version=version,
+        created_at=created_at,
+        n_slots=n_slots,
+        row_width=row_width,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        payload_crc=payload_crc,
+        payload_len=payload_len,
+    )
+    if header.payload_len != header.n_slots * header.row_width * 4:
+        raise SnapshotError(
+            f"{path}: payload_len {header.payload_len} does not match "
+            f"{header.n_slots} rows x {header.row_width} uint32 words"
+        )
+    return header
+
+
+def write_snapshot(
+    path: str,
+    table: np.ndarray,
+    created_at: int,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    fault_injector=None,
+) -> int:
+    """Atomically write one shard's row table; returns bytes written.
+
+    fault_injector (testing/faults.py) is consulted at site
+    'snapshot.write': 'error' raises OSError before any byte lands;
+    'torn_write' truncates the payload mid-row (rehearsing a crash the
+    atomic rename normally hides — the direct-write failure mode);
+    'corrupt' flips payload bytes AFTER the CRC was computed, so the file
+    is well-formed but fails its checksum on load. delay_ms stalls the
+    writer (a slow disk)."""
+    action = None
+    if fault_injector is not None:
+        action = fault_injector.fire(FAULT_SITE_WRITE)
+        if action == "error":
+            raise OSError(f"injected {FAULT_SITE_WRITE} error")
+    table = np.ascontiguousarray(table, dtype="<u4")
+    if table.ndim != 2:
+        raise ValueError(f"snapshot table must be 2-D, got {table.shape}")
+    payload = table.tobytes()
+    header = SnapshotHeader(
+        version=SNAPSHOT_VERSION,
+        created_at=int(created_at),
+        n_slots=table.shape[0],
+        row_width=table.shape[1],
+        shard_index=int(shard_index),
+        shard_count=int(shard_count),
+        payload_crc=zlib.crc32(payload),
+        payload_len=len(payload),
+    )
+    if action == "corrupt":
+        mutated = bytearray(payload)
+        mutated[len(mutated) // 2] ^= 0xFF
+        payload = bytes(mutated)
+    elif action == "torn_write":
+        payload = payload[: max(HEADER_SIZE, len(payload) // 2)]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(header.pack())
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # make the rename itself durable: fsync the directory entry
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+    return HEADER_SIZE + len(payload)
+
+
+def read_header(path: str) -> SnapshotHeader:
+    """Validate and return just the header (magic/version/CRC checked)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(HEADER_SIZE)
+    except OSError as e:
+        raise SnapshotError(f"{path}: {e}") from e
+    return _unpack_header(raw, path)
+
+
+def load_snapshot(
+    path: str, fault_injector=None
+) -> tuple[SnapshotHeader, np.ndarray]:
+    """Read and fully validate one snapshot file; returns (header, table).
+
+    fault_injector site 'snapshot.load': 'error' raises SnapshotError
+    before the read; 'corrupt' flips payload bytes in memory before the
+    CRC check (so validation must catch it); delay_ms stalls the loader.
+    Every validation failure raises SnapshotError — the caller boots cold."""
+    if fault_injector is not None:
+        action = fault_injector.fire(FAULT_SITE_LOAD)
+        if action == "error":
+            raise SnapshotError(f"{path}: injected {FAULT_SITE_LOAD} error")
+    else:
+        action = None
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise SnapshotError(f"{path}: {e}") from e
+    header = _unpack_header(raw, path)
+    payload = raw[HEADER_SIZE:]
+    if action == "corrupt" and payload:
+        mutated = bytearray(payload)
+        mutated[len(mutated) // 2] ^= 0xFF
+        payload = bytes(mutated)
+    if len(payload) != header.payload_len:
+        raise SnapshotError(
+            f"{path}: payload is {len(payload)} bytes, header says "
+            f"{header.payload_len} (torn write?)"
+        )
+    if zlib.crc32(payload) != header.payload_crc:
+        raise SnapshotError(f"{path}: payload CRC mismatch (corrupt)")
+    table = np.frombuffer(payload, dtype="<u4").reshape(
+        header.n_slots, header.row_width
+    )
+    # native-endian writable copy: the restore path reconciles in place
+    return header, table.astype(np.uint32)
+
+
+def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
+    """Reconcile a restored row table against the current clock.
+
+    Restore-time reality check, applied before the table touches the
+    device:
+
+      * rows whose jittered TTL passed (expire_at <= now) are DEAD — they
+        would be probe-reclaimed anyway; drop them so occupancy restarts
+        honest;
+      * rows whose FIXED WINDOW ended (window + divider <= now) carry no
+        decision state even while TTL-pinned — the next touch would roll
+        the window and restart at 0 (ops/slab.py same_window gate) — so
+        they are dropped too, exactly the population slab_sweep_expired
+        reclaims under the high watermark;
+      * live rows inside a still-open window keep their counts: these are
+        the counters a warm restart exists to preserve.
+
+    Rows written before the divider column existed (divider == 0) keep the
+    conservative TTL-only rule, like the sweep. Returns (reconciled copy,
+    {'restored', 'dropped_expired', 'dropped_window'} row counts)."""
+    table = np.array(table, dtype=np.uint32, copy=True)
+    if table.ndim != 2 or table.shape[1] < COL_DIVIDER + 1:
+        raise SnapshotError(
+            f"cannot reconcile table of shape {table.shape}: need at least "
+            f"{COL_DIVIDER + 1} row columns"
+        )
+    now = np.int64(now)
+    occupied = table.any(axis=1)
+    expire_at = table[:, COL_EXPIRE].astype(np.int64)
+    window = table[:, COL_WINDOW].astype(np.int64)
+    divider = table[:, COL_DIVIDER].astype(np.int64)
+    live = occupied & (expire_at > now)
+    window_ended = live & (divider > 0) & (window + divider <= now)
+    keep = live & ~window_ended
+    table[~keep] = 0
+    return table, {
+        "restored": int(np.sum(keep)),
+        "dropped_expired": int(np.sum(occupied & ~live)),
+        "dropped_window": int(np.sum(window_ended)),
+    }

@@ -164,6 +164,7 @@ class Runner:
         self.fallback = None
         self.overload = None
         self.fault_injector = None
+        self.snapshotter = None
         self._ready = threading.Event()
 
     def get_stats_store(self) -> Store:
@@ -285,6 +286,31 @@ class Runner:
         if engine is not None and hasattr(engine, "watermark_reason"):
             self.server.health.add_degraded_probe(engine.watermark_reason)
 
+        # Warm restart (persist/): restore the slab from the last snapshot
+        # BEFORE serving, then re-snapshot on a cadence off the hot path;
+        # the drain path (teardown) takes a final copy so planned restarts
+        # lose ~0 state. Only device-owning engines participate — sidecar
+        # FRONTENDS don't hold the slab, their device-owner process
+        # (cmd/sidecar_cmd.py) runs its own snapshotter.
+        snap_dir, snap_interval_ms, snap_stale_ms = settings.snapshot_config()
+        if snap_dir and engine is not None and hasattr(engine, "export_tables"):
+            from .persist.snapshotter import SlabSnapshotter
+
+            self.snapshotter = SlabSnapshotter(
+                engine,
+                snap_dir,
+                interval_ms=snap_interval_ms,
+                stale_after_ms=snap_stale_ms,
+                time_source=RealTimeSource(),
+                scope=self.scope,
+                fault_injector=self.fault_injector,
+            )
+            self.snapshotter.restore()
+            self.snapshotter.start()
+            # staleness is degraded-only: durability at risk must not
+            # drain an instance that is still serving fine from HBM
+            self.server.health.add_degraded_probe(self.snapshotter.stale_reason)
+
         self.runtime = DirectoryRuntimeLoader(
             runtime_path=settings.runtime_path,
             runtime_subdirectory=settings.runtime_subdirectory,
@@ -359,6 +385,11 @@ class Runner:
     def _teardown(self) -> None:
         if self.runtime is not None:
             self.runtime.stop()
+        if self.snapshotter is not None:
+            # drain handoff: quiesce the engine and take the final
+            # snapshot — the state the next process warm-boots from
+            snapshotter, self.snapshotter = self.snapshotter, None
+            snapshotter.drain()
         self.stats_store.stop_flushing()
         if self.tracer is not None:
             self.tracer.close()

@@ -142,11 +142,12 @@ class HealthChecker:
             (grpc.method_handlers_generic_handler(HEALTH_SERVICE_NAME, handlers),)
         )
 
-    # -- HTTP surface (handler contract used by http_server) --
-
-    def http_response(self) -> tuple[int, str]:
-        if not self.ok():
-            return (500, "")
+    def degraded_reasons(self) -> list[str]:
+        """Every currently-firing degraded reason, in registration order —
+        the one place probe evaluation (and its must-not-crash guard)
+        lives, shared by the /healthcheck body and anything else that
+        wants the degradation picture (tests, debug surfaces, the
+        warm-restart staleness probe's consumers)."""
         reasons = []
         for probe in self._degraded_probes:
             try:
@@ -155,6 +156,14 @@ class HealthChecker:
                 continue
             if reason:
                 reasons.append(reason)
+        return reasons
+
+    # -- HTTP surface (handler contract used by http_server) --
+
+    def http_response(self) -> tuple[int, str]:
+        if not self.ok():
+            return (500, "")
+        reasons = self.degraded_reasons()
         if reasons:
             # body keeps the "OK" prefix so checkers that string-match the
             # healthy body keep passing; orchestrators see the suffix

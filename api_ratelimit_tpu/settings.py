@@ -162,6 +162,19 @@ class Settings:
     # OVERLOAD_SHED_MODE posture instead of silently evicting live counters
     slab_watermark_high: float = 0.0
     slab_watermark_critical: float = 0.0
+    # --- warm restart (this framework; persist/) ---
+    # Directory for crash-safe slab snapshots; empty (the default)
+    # disables the whole subsystem. When set, the slab is restored from
+    # the newest valid snapshot before serving, re-snapshotted every
+    # SLAB_SNAPSHOT_INTERVAL_MS off the hot path, and a final copy rides
+    # the graceful-drain path — so planned restarts lose ~0 counter
+    # state and crashes lose at most one interval of traffic (which
+    # fails open). STALE_AFTER_MS bounds how old the last successful
+    # snapshot may get before the healthcheck reports degraded
+    # (0 = three intervals).
+    slab_snapshot_dir: str = ""
+    slab_snapshot_interval_ms: float = 10_000.0
+    slab_snapshot_stale_after_ms: float = 0.0
     # fault injection (testing/faults.py): comma-separated
     # site:kind:value rules, e.g.
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -237,6 +250,29 @@ class Settings:
                 f"SLAB_WATERMARK_HIGH ({high})"
             )
         return high, crit
+
+    def snapshot_config(self) -> tuple[str, float, float]:
+        """Validated (dir, interval_ms, stale_after_ms) for the warm-
+        restart snapshotter; dir == "" disables. Junk fails the boot like
+        every other knob: a typo'd interval must not silently become "no
+        durability". stale_after 0 defaults to three intervals."""
+        directory = self.slab_snapshot_dir.strip()
+        interval = float(self.slab_snapshot_interval_ms)
+        stale = float(self.slab_snapshot_stale_after_ms)
+        if interval <= 0:
+            raise ValueError(
+                f"SLAB_SNAPSHOT_INTERVAL_MS must be > 0, got {interval}"
+            )
+        if stale < 0:
+            raise ValueError(
+                f"SLAB_SNAPSHOT_STALE_AFTER_MS must be >= 0, got {stale}"
+            )
+        if 0 < stale < interval:
+            raise ValueError(
+                f"SLAB_SNAPSHOT_STALE_AFTER_MS ({stale}) must not sit "
+                f"below SLAB_SNAPSHOT_INTERVAL_MS ({interval})"
+            )
+        return directory, interval, stale if stale > 0 else 3.0 * interval
 
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
@@ -340,6 +376,17 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ),
     ("slab_watermark_high", "SLAB_WATERMARK_HIGH", float),
     ("slab_watermark_critical", "SLAB_WATERMARK_CRITICAL", float),
+    ("slab_snapshot_dir", "SLAB_SNAPSHOT_DIR", str),
+    (
+        "slab_snapshot_interval_ms",
+        "SLAB_SNAPSHOT_INTERVAL_MS",
+        float,
+    ),
+    (
+        "slab_snapshot_stale_after_ms",
+        "SLAB_SNAPSHOT_STALE_AFTER_MS",
+        float,
+    ),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]

@@ -14,7 +14,8 @@ Spec grammar (FAULT_INJECT env var; FAULT_INJECT_SEED seeds the RNG):
     rule  := site ":" kind ":" value
     site  := dotted lowercase id (the instrumentation point)
     kind  := error | drop | partial_write
-           | queue_full                       value = probability in (0, 1]
+           | queue_full | torn_write
+           | corrupt                          value = probability in (0, 1]
            | delay_ms                         value = milliseconds >= 0
 
 e.g. FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -33,6 +34,18 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
                             delay_ms stalls the caller (a wedged queue),
                             queue_full raises QueueFullError so chaos tests
                             rehearse overload shedding deterministically
+    snapshot.write          warm-restart snapshotter: each shard-file write
+                            (persist/snapshot.py) — error fails the write,
+                            torn_write truncates the payload mid-row,
+                            corrupt flips payload bytes AFTER the CRC was
+                            computed (a well-formed file that must fail
+                            its checksum on load), delay_ms models a slow
+                            disk
+    snapshot.load           boot-time restorer: each shard-file load —
+                            error rejects outright, corrupt flips bytes
+                            in memory before validation; either way the
+                            restore must count snapshot.load_rejected and
+                            boot a cold slab instead of crashing
 
 The injector is mutable at runtime (configure()/clear()) so chaos tests can
 clear faults mid-scenario — e.g. to watch a circuit breaker's half-open
@@ -47,8 +60,23 @@ import re
 import threading
 import time
 
-FAULT_KINDS = ("error", "drop", "partial_write", "queue_full", "delay_ms")
-_PROB_KINDS = ("error", "drop", "partial_write", "queue_full")
+FAULT_KINDS = (
+    "error",
+    "drop",
+    "partial_write",
+    "queue_full",
+    "torn_write",
+    "corrupt",
+    "delay_ms",
+)
+_PROB_KINDS = (
+    "error",
+    "drop",
+    "partial_write",
+    "queue_full",
+    "torn_write",
+    "corrupt",
+)
 
 _SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 

@@ -47,6 +47,13 @@ Artifact field guide (round 5 additions):
   service.telemetry_overhead_pct  flat_per_second only: rate loss vs a
                                   stats-scope-free rebuild of the stack
                                   (the <5% telemetry budget)
+  service.snapshot_overhead_pct   flat_per_second only: rate loss with the
+                                  warm-restart snapshotter (persist/)
+                                  running at a 100ms cadence, plus
+                                  p99_snapshot_on_ms and the number of
+                                  snapshots that landed mid-drive — the
+                                  "no measurable p99 regression" budget
+                                  for the quiesce-and-copy design
   engine.sharded.{rate,rate_pipelined,rate_replicated,rate_single_device}
                                   cold-block sharded rows; host_cpus says
                                   whether the mesh could physically
@@ -735,6 +742,7 @@ def bench_service(
     yaml_text: str,
     on_tpu: bool,
     measure_telemetry_overhead: bool = False,
+    measure_snapshot_overhead: bool = False,
 ) -> dict:
     """One service-level scenario: threads driving should_rate_limit through
     the micro-batched TPU backend. Per-stage timings come from the runtime
@@ -743,7 +751,15 @@ def bench_service(
     measure_telemetry_overhead: drive the same scenario a second time with
     the backend's stats scope disabled and report the recording overhead as
     a rate ratio (the <5% telemetry-cost budget, checked on
-    flat_per_second)."""
+    flat_per_second).
+
+    measure_snapshot_overhead: drive the same scenario a third time with
+    the warm-restart snapshotter (persist/) running at an aggressive 100ms
+    cadence against the live engine and report the rate/p99 cost as
+    snapshot_overhead_pct / p99_snapshot_on_ms — the "no measurable p99
+    regression" budget for the quiesce-and-copy design (the periodic
+    device-side copy rides the stream; only the D2H drain and file write
+    run on the snapshot thread)."""
     # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
     # parallel workers (test/redis/bench_test.go); oversubscribing a small
     # box measures queueing, not the service (8 threads on the 1-core bench
@@ -798,6 +814,43 @@ def bench_service(
         if rate_off > 0:
             result["telemetry_overhead_pct"] = round(
                 (1.0 - result["rate"] / rate_off) * 100.0, 2
+            )
+    if measure_snapshot_overhead:
+        import tempfile
+
+        from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        service_s, cache_s, _store_s = _build_service(
+            config_key, yaml_text, telemetry=True
+        )
+        for r in reqs[:32]:
+            service_s.should_rate_limit(r)
+        with tempfile.TemporaryDirectory() as snap_dir:
+            snapshotter = SlabSnapshotter(
+                cache_s.engine,
+                snap_dir,
+                interval_ms=100.0,
+                time_source=RealTimeSource(),
+            )
+            snapshotter.start()
+            try:
+                total_s, elapsed_s, lat_s = _drive_service(
+                    service_s, reqs, n_threads, per_thread
+                )
+            finally:
+                snapshotter.stop()
+            snapshots_taken = snapshotter.writes_total
+        cache_s.close()
+        rate_s = total_s * decisions_per_request / elapsed_s
+        result["rate_snapshot_on"] = round(rate_s)
+        result["p99_snapshot_on_ms"] = round(
+            float(np.percentile(lat_s, 99)), 3
+        )
+        result["snapshots_during_drive"] = snapshots_taken
+        if result["rate"] > 0:
+            result["snapshot_overhead_pct"] = round(
+                (1.0 - rate_s / result["rate"]) * 100.0, 2
             )
     print(f"[service:{config_key}] {result}", file=sys.stderr)
     return result
@@ -1454,6 +1507,11 @@ def main() -> None:
                 # the telemetry-cost A/B (<5% budget) runs once, on the
                 # scenario with the least masking device time
                 measure_telemetry_overhead=(
+                    key == "flat_per_second" and left() > 100
+                ),
+                # the durability-cost A/B rides the same scenario: an
+                # aggressive 100ms snapshot cadence must not move p99
+                measure_snapshot_overhead=(
                     key == "flat_per_second" and left() > 100
                 ),
             )

@@ -191,3 +191,67 @@ class TestResilienceSettings:
         ):
             with pytest.raises(ValueError, match="FAULT_INJECT"):
                 new_settings({"FAULT_INJECT": spec}).fault_rules()
+
+    def test_snapshot_knob_env_names(self):
+        s = new_settings(
+            {
+                "SLAB_SNAPSHOT_DIR": "/var/lib/ratelimit/snapshots",
+                "SLAB_SNAPSHOT_INTERVAL_MS": "2500",
+                "SLAB_SNAPSHOT_STALE_AFTER_MS": "30000",
+            }
+        )
+        assert s.slab_snapshot_dir == "/var/lib/ratelimit/snapshots"
+        assert s.slab_snapshot_interval_ms == pytest.approx(2500.0)
+        assert s.slab_snapshot_stale_after_ms == pytest.approx(30000.0)
+        assert s.snapshot_config() == (
+            "/var/lib/ratelimit/snapshots",
+            2500.0,
+            30000.0,
+        )
+
+    def test_snapshot_defaults_disabled(self):
+        s = new_settings({})
+        directory, interval_ms, stale_ms = s.snapshot_config()
+        assert directory == ""  # empty dir = warm restart off
+        assert interval_ms == pytest.approx(10_000.0)
+        # staleness defaults to three intervals
+        assert stale_ms == pytest.approx(30_000.0)
+
+    def test_snapshot_junk_fails_boot(self):
+        with pytest.raises(ValueError, match="SLAB_SNAPSHOT_INTERVAL_MS"):
+            new_settings(
+                {"SLAB_SNAPSHOT_INTERVAL_MS": "0"}
+            ).snapshot_config()
+        with pytest.raises(ValueError, match="SLAB_SNAPSHOT_INTERVAL_MS"):
+            new_settings(
+                {"SLAB_SNAPSHOT_INTERVAL_MS": "-5"}
+            ).snapshot_config()
+        with pytest.raises(ValueError, match="SLAB_SNAPSHOT_STALE_AFTER_MS"):
+            new_settings(
+                {"SLAB_SNAPSHOT_STALE_AFTER_MS": "-1"}
+            ).snapshot_config()
+        # staleness tighter than the write cadence would flap the probe
+        with pytest.raises(ValueError, match="SLAB_SNAPSHOT_STALE_AFTER_MS"):
+            new_settings(
+                {
+                    "SLAB_SNAPSHOT_INTERVAL_MS": "10000",
+                    "SLAB_SNAPSHOT_STALE_AFTER_MS": "500",
+                }
+            ).snapshot_config()
+        # non-numeric junk fails at parse time, like every other knob
+        with pytest.raises(ValueError, match="SLAB_SNAPSHOT_INTERVAL_MS"):
+            new_settings({"SLAB_SNAPSHOT_INTERVAL_MS": "soon"})
+
+    def test_snapshot_fault_sites_parse_from_env(self):
+        s = new_settings(
+            {
+                "FAULT_INJECT": (
+                    "snapshot.write:torn_write:1.0,snapshot.load:corrupt:0.5"
+                )
+            }
+        )
+        rules = s.fault_rules()
+        assert [(r.site, r.kind) for r in rules] == [
+            ("snapshot.write", "torn_write"),
+            ("snapshot.load", "corrupt"),
+        ]

@@ -1,0 +1,344 @@
+"""Restart-under-load chaos: the warm-restart acceptance tests.
+
+Three escalating scenarios against the durability contract:
+
+  * in-process crash simulation — traffic through the engine with periodic
+    snapshots, the process "dies" (engine abandoned, NO final snapshot), a
+    fresh engine restores: per-key overshoot vs the exact fixed-window
+    oracle (testing/oracle.py) is bounded by one snapshot interval of
+    traffic, and every disagreement fails OPEN (false_over == 0);
+  * graceful drain — the final drain snapshot makes the handoff lossless:
+    overshoot exactly 0;
+  * a REAL kill -9 — a subprocess owns the device, snapshots every K
+    batches, gets SIGKILLed mid-window; the restarted process restores and
+    its counters land within one snapshot interval of the true traffic.
+
+Plus the Runner-level wiring: SLAB_SNAPSHOT_DIR set => boot restores
+before serving and stop() writes the final drain snapshot.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+from api_ratelimit_tpu.testing.oracle import parity_report
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NOW = 1_700_000_000
+N_KEYS = 16
+LIMIT = 23
+SNAP_EVERY = 5  # batches per "snapshot interval" in the simulated runs
+
+
+def _engine(ts):
+    return SlabDeviceEngine(
+        ts, n_slots=1 << 12, use_pallas=False, buckets=(128,)
+    )
+
+
+def _batch(engine):
+    """One round: every key once, in key order. Returns the per-key
+    post-increment counters."""
+    return engine.submit(
+        [
+            _Item(fp=5000 + k, hits=1, limit=LIMIT, divider=100_000, jitter=0)
+            for k in range(N_KEYS)
+        ]
+    )
+
+
+def _codes(afters):
+    """Engine decision per item: 2 = OVER_LIMIT (after > limit), 1 = OK —
+    the same rule decide() applies on device."""
+    return [2 if after > LIMIT else 1 for after in afters]
+
+
+def _run_phase(engine, n_batches, ids, codes, snapshotter=None):
+    for i in range(n_batches):
+        afters = _batch(engine)
+        ids.extend(range(N_KEYS))
+        codes.extend(_codes(afters))
+        if snapshotter is not None and (i + 1) % SNAP_EVERY == 0:
+            snapshotter.snapshot_once()
+
+
+class TestCrashRestoreOracle:
+    def test_crash_overshoot_bounded_by_snapshot_interval(self, tmp_path):
+        """23 batches with a snapshot after every 5th (last at 20), crash
+        (no drain — batches 21..23 are forgotten), restore, 8 more batches
+        crossing the limit: vs the oracle the engine fails open for exactly
+        the 3 lost hits per key — bounded by one snapshot interval
+        (SNAP_EVERY) — and must NEVER fail closed."""
+        ts = FakeTimeSource(NOW)
+        ids: list[int] = []
+        codes: list[int] = []
+
+        eng = _engine(ts)
+        snap = SlabSnapshotter(eng, str(tmp_path), interval_ms=60_000,
+                               time_source=ts)
+        _run_phase(eng, 23, ids, codes, snapshotter=snap)
+        del eng  # kill -9 analog: no drain, no final snapshot
+
+        eng2 = _engine(ts)
+        snap2 = SlabSnapshotter(eng2, str(tmp_path), interval_ms=60_000,
+                                time_source=ts)
+        assert snap2.restore()["restored"] == N_KEYS
+        _run_phase(eng2, 8, ids, codes)  # restored counters resume at 20
+
+        report = parity_report(
+            np.asarray(ids, dtype=np.int64), np.asarray(codes), LIMIT
+        )
+        # fail-open only: the engine must never say OVER where truth is OK
+        assert report["false_over"] == 0
+        # the crash lost batches 21..23 => at most one snapshot interval of
+        # extra fail-open OKs per key (here exactly the 3 lost hits)
+        assert 0 < report["false_ok"] <= SNAP_EVERY * N_KEYS
+        # and the restored counters really continued (not a cold boot,
+        # which would fail open for LIMIT extra hits per key)
+        assert _batch(eng2)[0] == 20 + 8 + 1
+
+    def test_graceful_drain_is_lossless(self, tmp_path):
+        """Planned restart: drain writes the final snapshot AFTER the last
+        admitted batch, so the next process agrees with the oracle
+        everywhere — overshoot exactly 0."""
+        ts = FakeTimeSource(NOW)
+        ids: list[int] = []
+        codes: list[int] = []
+
+        eng = _engine(ts)
+        snap = SlabSnapshotter(eng, str(tmp_path), interval_ms=60_000,
+                               time_source=ts)
+        _run_phase(eng, 28, ids, codes, snapshotter=snap)  # 28th unsnapped
+        snap.drain()  # quiesce + final snapshot at batch 28
+
+        eng2 = _engine(ts)
+        snap2 = SlabSnapshotter(eng2, str(tmp_path), interval_ms=60_000,
+                                time_source=ts)
+        assert snap2.restore()["restored"] == N_KEYS
+        _run_phase(eng2, 5, ids, codes)
+
+        report = parity_report(
+            np.asarray(ids, dtype=np.int64), np.asarray(codes), LIMIT
+        )
+        assert report["false_over"] == 0
+        assert report["false_ok"] == 0  # ~0 loss for a planned restart
+        assert report["agreement"] == 1.0
+
+
+class TestRunnerWarmRestart:
+    """SLAB_SNAPSHOT_DIR wired through the composition root: restore
+    before serving, final snapshot on stop, staleness probe registered."""
+
+    BASIC = """\
+domain: warm
+descriptors:
+  - key: api
+    rate_limit: {unit: hour, requests_per_unit: 10}
+"""
+
+    def _settings(self, tmp_path, snap_dir):
+        from api_ratelimit_tpu.settings import Settings
+
+        config_dir = tmp_path / "current" / "ratelimit" / "config"
+        if not config_dir.exists():
+            config_dir.mkdir(parents=True)
+            (config_dir / "warm.yaml").write_text(self.BASIC)
+        return Settings(
+            port=0,
+            grpc_port=0,
+            debug_port=0,
+            use_statsd=False,
+            runtime_path=str(tmp_path / "current"),
+            runtime_subdirectory="ratelimit",
+            backend_type="tpu",
+            tpu_slab_slots=1 << 10,
+            tpu_use_pallas=False,
+            expiration_jitter_max_seconds=0,
+            local_cache_size_in_bytes=0,
+            slab_snapshot_dir=str(snap_dir),
+            slab_snapshot_interval_ms=60_000.0,
+            log_level="ERROR",
+        )
+
+    def _request(self, hits):
+        from api_ratelimit_tpu.models.descriptors import (
+            Descriptor,
+            RateLimitRequest,
+        )
+
+        return RateLimitRequest(
+            domain="warm",
+            descriptors=(Descriptor.of(("api", "user1")),),
+            hits_addend=hits,
+        )
+
+    def test_stop_snapshots_and_next_boot_restores(self, tmp_path):
+        from api_ratelimit_tpu.models.response import Code
+        from api_ratelimit_tpu.runner import Runner
+        from api_ratelimit_tpu.stats.sinks import TestSink
+
+        snap_dir = tmp_path / "snapshots"
+        runner = Runner(self._settings(tmp_path, snap_dir), sink=TestSink())
+        runner.run_background()
+        assert runner.wait_ready(10.0)
+        assert runner.snapshotter is not None
+        # the staleness probe is on the health surface (degraded-only)
+        assert runner.server.health.degraded_reasons() == []
+        code, _statuses, _headers = runner.service.should_rate_limit(
+            self._request(hits=10)
+        )
+        assert code == Code.OK  # 10/10 used
+        runner.stop()  # drain handoff: writes the final snapshot
+        assert (snap_dir / "slab.snap").exists()
+
+        runner2 = Runner(self._settings(tmp_path, snap_dir), sink=TestSink())
+        runner2.run_background()
+        assert runner2.wait_ready(10.0)
+        try:
+            assert runner2.snapshotter.restore_stats["restored"] == 1
+            # the restored counter carries the 10 used hits: one more is OVER
+            code, _statuses, _headers = runner2.service.should_rate_limit(
+                self._request(hits=1)
+            )
+            assert code == Code.OVER_LIMIT
+        finally:
+            runner2.stop()
+
+    def test_snapshot_disabled_by_default(self, tmp_path):
+        from api_ratelimit_tpu.runner import Runner
+        from api_ratelimit_tpu.settings import Settings
+        from api_ratelimit_tpu.stats.sinks import TestSink
+
+        settings = self._settings(tmp_path, tmp_path / "unused")
+        settings.slab_snapshot_dir = ""
+        runner = Runner(settings, sink=TestSink())
+        runner.run_background()
+        assert runner.wait_ready(10.0)
+        try:
+            assert runner.snapshotter is None
+        finally:
+            runner.stop()
+        assert not (tmp_path / "unused").exists()
+
+
+_CHILD = """\
+import json, os, sys, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+snap_dir, progress_path, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+engine = SlabDeviceEngine(
+    RealTimeSource(), n_slots=1 << 12, use_pallas=False, buckets=(128,)
+)
+snap = SlabSnapshotter(engine, snap_dir, interval_ms=3_600_000.0)
+restored = snap.restore()
+KEYS = [9000 + k for k in range(8)]
+
+
+def batch():
+    return engine.submit(
+        [
+            _Item(fp=k, hits=1, limit=1_000_000, divider=1_000_000, jitter=0)
+            for k in KEYS
+        ]
+    )
+
+
+if phase == "crash":
+    with open(progress_path, "a") as f:
+        for i in range(100_000):  # runs until SIGKILLed
+            afters = batch()
+            f.write(json.dumps([i, afters[0]]) + "\\n")
+            f.flush()
+            os.fsync(f.fileno())
+            if (i + 1) % 5 == 0:
+                snap.snapshot_once()
+            time.sleep(0.01)
+else:
+    final = None
+    for _ in range(20):
+        final = batch()
+    print(json.dumps({{"restored": restored, "final": final}}))
+"""
+
+
+class TestSigkillRestart:
+    def test_kill9_midwindow_restores_with_bounded_loss(self, tmp_path):
+        """The real thing: the device-owner process is SIGKILLed mid-window
+        (no drain, no atexit — nothing runs), a new process restores from
+        the last periodic snapshot (every 5 batches) and keeps counting.
+        The restored counters must land within one snapshot interval of
+        the true traffic: warm (not cold), never overcounting."""
+        child_py = tmp_path / "child.py"
+        child_py.write_text(_CHILD.format(repo=REPO))
+        snap_dir = str(tmp_path / "snaps")
+        progress = tmp_path / "progress.jsonl"
+        progress.touch()
+
+        proc = subprocess.Popen(
+            [sys.executable, str(child_py), snap_dir, str(progress), "crash"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            # wait until the child has demonstrably snapshotted at least
+            # twice (>= 12 batches), then kill -9 mid-stride
+            deadline = time.monotonic() + 120.0
+            batches_seen = 0
+            while time.monotonic() < deadline:
+                lines = progress.read_text().splitlines()
+                batches_seen = len(lines)
+                if batches_seen >= 12:
+                    break
+                if proc.poll() is not None:
+                    pytest.fail(
+                        f"child died early: {proc.stderr.read()[-2000:]}"
+                    )
+                time.sleep(0.05)
+            assert batches_seen >= 12, "child too slow to make traffic"
+            os.kill(proc.pid, signal.SIGKILL)
+        finally:
+            proc.wait(timeout=30)
+
+        # truth from the progress journal: b1 lines recorded; the device
+        # may be up to one batch ahead (killed between launch and journal)
+        lines = progress.read_text().splitlines()
+        b1 = len(lines)
+        last_batch, last_after = json.loads(lines[-1])
+        assert last_after == last_batch + 1  # journal is per-batch counters
+
+        out = subprocess.run(
+            [sys.executable, str(child_py), snap_dir, str(progress), "restore"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        result = json.loads(out.stdout)
+        assert result["restored"]["restored"] == 8  # all 8 key rows warm
+        finals = result["final"]
+        assert len(set(finals)) == 1  # every key saw identical traffic
+        final = finals[0]
+        # bounded loss: the crash forgot at most one snapshot interval
+        # (5 batches) of traffic...
+        assert final >= b1 + 20 - 5, (final, b1)
+        # ...and never invented traffic (true total is b1 or b1+1: the
+        # kill can land between the device update and the journal write)
+        assert final <= b1 + 1 + 20, (final, b1)

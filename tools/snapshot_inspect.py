@@ -1,0 +1,162 @@
+"""Offline slab-snapshot inspector: dump headers, verify CRCs, row stats.
+
+Operator muscle for the warm-restart subsystem (api_ratelimit_tpu/persist/):
+given snapshot files written by the SlabSnapshotter, print each file's
+header, verify both CRCs and the payload length, and summarize the rows —
+how many slots are occupied, how many would survive the restore
+reconciliation at a given clock, counter totals. Exit 1 if ANY file fails
+validation, so the tool doubles as a pre-restore health gate in deploy
+scripts:
+
+    python tools/snapshot_inspect.py /var/lib/ratelimit/snapshots/*.snap
+    python tools/snapshot_inspect.py --json --now 1754300000 slab.snap
+
+No jax import — inspection must run on any box (deploy tooling, a laptop
+with a copied snapshot), not just TPU hosts; the format lives in
+persist/snapshot.py which is numpy + stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
+    COL_COUNT,
+    COL_DIVIDER,
+    COL_EXPIRE,
+    COL_WINDOW,
+    SnapshotError,
+    load_snapshot,
+    reconcile_rows,
+)
+
+
+def inspect_file(path: str, now: int | None) -> dict:
+    """Fully validate one snapshot file and return its report dict;
+    raises SnapshotError on any validation failure."""
+    header, table = load_snapshot(path)
+    at = int(now) if now is not None else int(header.created_at)
+    occupied = table.any(axis=1)
+    expire_at = table[:, COL_EXPIRE].astype(np.int64)
+    live = occupied & (expire_at > at)
+    _reconciled, rec = reconcile_rows(table, at)
+    counts = table[:, COL_COUNT].astype(np.int64)
+    report = {
+        "path": path,
+        "valid": True,
+        "version": header.version,
+        "created_at": header.created_at,
+        "age_seconds": max(0, at - header.created_at),
+        "shard": f"{header.shard_index}/{header.shard_count}",
+        "n_slots": header.n_slots,
+        "row_width": header.row_width,
+        "bytes": os.path.getsize(path),
+        "rows": {
+            "occupied": int(np.sum(occupied)),
+            "live_at_now": int(np.sum(live)),
+            "restorable": rec["restored"],
+            "dropped_expired": rec["dropped_expired"],
+            "dropped_window": rec["dropped_window"],
+            "count_sum": int(counts[occupied].sum()) if occupied.any() else 0,
+            "count_max": int(counts[occupied].max()) if occupied.any() else 0,
+            "dividers": sorted(
+                int(d)
+                for d in np.unique(table[occupied, COL_DIVIDER])
+            )
+            if occupied.any()
+            else [],
+            "window_span_s": (
+                int(
+                    table[occupied, COL_WINDOW].astype(np.int64).max()
+                    - table[occupied, COL_WINDOW].astype(np.int64).min()
+                )
+                if occupied.any()
+                else 0
+            ),
+        },
+    }
+    return report
+
+
+def _print_text(report: dict) -> None:
+    rows = report["rows"]
+    print(f"{report['path']}:")
+    print(
+        f"  header  v{report['version']} shard {report['shard']} "
+        f"created_at={report['created_at']} "
+        f"(age {report['age_seconds']}s) "
+        f"{report['n_slots']} slots x {report['row_width']} words "
+        f"({report['bytes']} bytes)  CRC OK"
+    )
+    print(
+        f"  rows    occupied={rows['occupied']} live={rows['live_at_now']} "
+        f"restorable={rows['restorable']} "
+        f"dropped(expired={rows['dropped_expired']}, "
+        f"window_ended={rows['dropped_window']})"
+    )
+    print(
+        f"  counts  sum={rows['count_sum']} max={rows['count_max']} "
+        f"dividers={rows['dividers']} window_span={rows['window_span_s']}s"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Dump and verify slab snapshot files offline."
+    )
+    parser.add_argument("files", nargs="+", help="snapshot file(s)")
+    parser.add_argument(
+        "--json", action="store_true", help="emit one JSON array of reports"
+    )
+    parser.add_argument(
+        "--now",
+        type=int,
+        default=None,
+        help="clock (unix s) for liveness/reconcile stats; default: each "
+        "file's created_at (set this to time.time() to preview a restore "
+        "happening now)",
+    )
+    parser.add_argument(
+        "--wallclock",
+        action="store_true",
+        help="shorthand for --now=<current unix time>",
+    )
+    args = parser.parse_args(argv)
+    now = int(time.time()) if args.wallclock else args.now
+
+    reports: list[dict] = []
+    failed = 0
+    for path in args.files:
+        try:
+            reports.append(inspect_file(path, now))
+        except SnapshotError as e:
+            failed += 1
+            reports.append({"path": path, "valid": False, "error": str(e)})
+    if args.json:
+        print(json.dumps(reports, indent=2))
+    else:
+        for report in reports:
+            if report["valid"]:
+                _print_text(report)
+            else:
+                print(f"{report['path']}: INVALID — {report['error']}")
+    if failed:
+        print(
+            f"snapshot-inspect: {failed} of {len(args.files)} file(s) "
+            f"failed validation",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
