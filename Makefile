@@ -8,7 +8,7 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_artifact \
-        tests_integration tests_with_redis tests_tpu bench serve \
+        tests_integration tests_with_redis tests_tpu bench profile serve \
         check_config clean docker_image docker_tests
 
 all: compile
@@ -57,6 +57,11 @@ tests_tpu:
 # Decisions/sec + p99 benchmark; prints one JSON line. Run on TPU.
 bench:
 	$(PY) bench.py
+
+# Host-path profile: cProfile over the flat_per_second request loop
+# (tools/hotpath_profile.py; --legacy pins the pre-vectorization path).
+profile:
+	$(PY) -m tools.hotpath_profile
 
 # Unattended chip-window chain: waits for the (flaky) device tunnel and
 # runs linkprobe -> divtest -> attribution ladder -> TPU kernel tests ->

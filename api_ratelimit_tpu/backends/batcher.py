@@ -14,29 +14,71 @@ amortization (exactly like an unset pipeline window in the reference).
 
 Double-buffered mode (execute_launch/execute_collect provided): the
 dispatcher splits each batch into a fast LAUNCH (pack + async device
-dispatch, returns a token) and a blocking COLLECT (device readback), and a
-separate collector thread drains collects. Launch k+1 thus overlaps batch
-k's readback — the TPU analog of the reference keeping the next pipeline
-writing while the previous one's replies drain off the wire
-(src/redis/driver_impl.go:84-90). max_inflight bounds queued collects so
-latency stays bounded under backpressure.
+dispatch, returns a token) and a blocking COLLECT (device readback).
+Launch k+1 thus overlaps batch k's readback — the TPU analog of the
+reference keeping the next pipeline writing while the previous one's
+replies drain off the wire (src/redis/driver_impl.go:84-90).
+
+The collect runs in the CALLER threads (leader-collects): the dispatcher
+finishes its job at launch time by handing every future of the batch a
+collect ticket; the first waiter to wake redeems the whole batch's
+readback and the rest read their slices. Callers were going to block on
+exactly this readback anyway, so this removes a dedicated collector
+thread — and with it one cross-thread hand-off on every result path, a
+real scheduling cost on small hosts — while keeping the dispatcher free
+to launch the next batch. max_inflight still bounds un-collected launches
+(a semaphore held from launch to redemption) so latency stays bounded
+under backpressure.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
+import numpy as np
+
 from ..limiter.cache import CacheError, DeadlineExceededError
 from ..utils.deadline import current_deadline
 from .overload import BrownoutError, QueueFullError
 
-_CLOSE = object()
+_TICKET = object()  # marks a future result as a deferred-collect ticket
 
 FAULT_SITE_SUBMIT = "batcher.submit"  # testing/faults.py chaos site
+
+
+class _CollectTicket:
+    """Deferred readback hand-off (leader-collects): the first caller to
+    redeem runs the batch's blocking collect; every other caller of the
+    same batch reads the memoized result (or re-raises the memoized
+    error). The ticket owns the inflight bookkeeping — _finish_one runs
+    exactly once, whoever redeems first."""
+
+    __slots__ = ("_batcher", "_token", "_lock", "_results", "_error", "_done")
+
+    def __init__(self, batcher: "MicroBatcher", token):
+        self._batcher = batcher
+        self._token = token
+        self._lock = threading.Lock()
+        self._results = None
+        self._error: BaseException | None = None
+        self._done = False
+
+    def redeem(self):
+        with self._lock:
+            if not self._done:
+                try:
+                    self._results = self._batcher._execute_collect(self._token)
+                except BaseException as e:  # noqa: BLE001 - memo + reraise
+                    self._error = e
+                self._done = True
+                self._token = None
+                self._batcher._finish_one()
+        if self._error is not None:
+            raise self._error
+        return self._results
 
 
 class BatcherStats:
@@ -71,6 +113,7 @@ class MicroBatcher:
         max_queue: int = 0,
         overload=None,
         fault_injector=None,
+        arena_rows: int = 0,
     ):
         """block_mode: each submit() argument is ONE pre-packed uint32[6, n]
         column block (the sidecar wire format) instead of a sequence of
@@ -100,7 +143,23 @@ class MicroBatcher:
         fault_injector: optional FaultInjector consulted at site
         'batcher.submit' before each enqueue — delay_ms stalls the caller,
         queue_full raises QueueFullError — so chaos tests rehearse overload
-        deterministically (testing/faults.py)."""
+        deterministically (testing/faults.py).
+
+        arena_rows: block mode only — size (in items) of the preallocated
+        uint32[6, arena_rows] row ring submits write into. With a ring,
+        submit() COPIES the caller's block under the lock (one slot per
+        descriptor) and the queue holds views into the ring, so callers may
+        reuse a thread-local scratch block and the steady state allocates
+        nothing per request. Two ring buffers ping-pong: the dispatcher
+        packs taken views before its next take (same thread), so the ring
+        a batch was taken from is free again by the time the queue next
+        drains and the write side swaps to it. When the ring is full (or
+        the queue never fully drains under sustained overload) submits
+        fall back to an owned copy of the block — correctness is
+        unaffected, the per-request allocation just returns until the
+        queue drains. 0 keeps the legacy hand-off-ownership behavior
+        (sidecar wire blocks are one-shot buffers; copying them would be
+        pure waste)."""
         self._execute = execute
         self._window = float(window_seconds)
         self._max_batch = int(max_batch)
@@ -123,8 +182,16 @@ class MicroBatcher:
         self._last_end = float("-inf")  # monotonic end of the last execute
         self._idle = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
-        self._collector: threading.Thread | None = None
-        self._collect_q: queue.Queue | None = None
+        self._arenas = None
+        self._arena_idx = 0
+        self._arena_cursor = 0
+        self._arena_rows = 0
+        if self._block_mode and self._window > 0 and arena_rows > 0:
+            self._arena_rows = int(arena_rows)
+            self._arenas = [
+                np.empty((6, self._arena_rows), dtype=np.uint32),
+                np.empty((6, self._arena_rows), dtype=np.uint32),
+            ]
         self._h_wait = self._h_batch = None
         if scope is not None:
             from ..stats.store import DEFAULT_SIZE_BUCKETS
@@ -134,16 +201,13 @@ class MicroBatcher:
                 "batch_size", boundaries=DEFAULT_SIZE_BUCKETS
             )
             scope.add_stat_generator(BatcherStats(self, scope))
-        pipelined = execute_launch is not None and execute_collect is not None
+        self._pipelined = execute_launch is not None and execute_collect is not None
         self._execute_launch = execute_launch
         self._execute_collect = execute_collect
+        # bounds launches whose collects haven't been redeemed yet — the
+        # backpressure the bounded collector queue used to provide
+        self._inflight_sem = threading.Semaphore(max(1, int(max_inflight)))
         if self._window > 0:
-            if pipelined:
-                self._collect_q = queue.Queue(maxsize=max(1, int(max_inflight)))
-                self._collector = threading.Thread(
-                    target=self._collect_loop, name="tpu-collector", daemon=True
-                )
-                self._collector.start()
             self._thread = threading.Thread(
                 target=self._loop, name="tpu-batcher", daemon=True
             )
@@ -158,6 +222,15 @@ class MicroBatcher:
     def inflight(self) -> int:
         """Batches launched but not yet finished (racy read; stats only)."""
         return self._inflight
+
+    @property
+    def consumes_submits(self) -> bool:
+        """True when submit() fully consumes the caller's block before
+        returning (direct mode executes it; a row ring copies it) — i.e.
+        the caller may hand in a reusable scratch buffer. False means the
+        batcher retains the block and the caller must hand over
+        ownership."""
+        return self._window <= 0 or self._arenas is not None
 
     # -- client side --
 
@@ -231,6 +304,21 @@ class MicroBatcher:
                 )
             start = self._pending
             if self._block_mode:
+                arenas = self._arenas
+                if arenas is not None:
+                    cursor = self._arena_cursor
+                    if cursor + count <= self._arena_rows:
+                        # row ring: one slot per descriptor, written in
+                        # place; the queue holds a view, the caller keeps
+                        # its scratch
+                        arena = arenas[self._arena_idx]
+                        arena[:, cursor : cursor + count] = items
+                        items = arena[:, cursor : cursor + count]
+                        self._arena_cursor = cursor + count
+                    else:
+                        # ring full: decouple from the caller's scratch
+                        # with an owned copy (rare; see arena_rows note)
+                        items = np.array(items, dtype=np.uint32)
                 self._items.append(items)
             else:
                 self._items.extend(items)
@@ -239,7 +327,14 @@ class MicroBatcher:
                 (future, start, count, time.monotonic(), deadline)
             )
             self._wakeup.notify()
-        return future.result()
+        out = future.result()
+        if type(out) is tuple and len(out) == 4 and out[0] is _TICKET:
+            # leader-collects: this caller (or a batch-mate that woke
+            # first) runs the blocking readback right here
+            _, ticket, start, count = out
+            results = ticket.redeem()
+            return results[start : start + count]
+        return out
 
     def _note_expired(self, n: int) -> None:
         self.deadline_drops += n
@@ -284,8 +379,6 @@ class MicroBatcher:
             self._wakeup.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
-        if self._collector is not None:
-            self._collector.join(timeout=1.0)
 
     # -- dispatcher --
 
@@ -302,16 +395,44 @@ class MicroBatcher:
                 # executing have already waited >= one launch — launch them
                 # immediately instead of adding the window on top (the device
                 # execute time is itself the coalescing window under load).
+                # A batch still in flight is the same signal: its execute
+                # time IS the coalescing delay for everything queued behind
+                # it, so lingering on top would stack latency for nothing.
                 # submit() notifies on every enqueue, so wait on a deadline
                 # loop or the first straggler would end the window early
-                warm = self._futures and self._futures[0][3] <= self._last_end
+                warm = self._inflight > 0 or (
+                    self._futures and self._futures[0][3] <= self._last_end
+                )
                 if self._pending < self._max_batch and not warm:
-                    deadline = time.monotonic() + self._window
+                    # Lull cutoff: concurrent submitters arrive within each
+                    # other's host think time, far inside the window. Once
+                    # a quarter-window passes with NO new enqueue, the
+                    # straggler train has ended — launch now instead of
+                    # idling out the rest of the window (measured: the
+                    # full-window linger was the service tier's dominant
+                    # per-cycle cost at closed-loop concurrency; lingering
+                    # while warm measured strictly worse — the in-flight
+                    # launch already provides the coalescing delay).
+                    now = time.monotonic()
+                    deadline = now + self._window
+                    lull = self._window * 0.25
+                    last_pending = self._pending
+                    last_change = now
                     while self._pending < self._max_batch and not self._closed:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
+                        now = time.monotonic()
+                        if now >= deadline:
                             break
-                        self._wakeup.wait(timeout=remaining)
+                        if self._pending != last_pending:
+                            last_pending = self._pending
+                            last_change = now
+                        elif now - last_change >= lull:
+                            break
+                        self._wakeup.wait(
+                            timeout=min(
+                                deadline - now,
+                                lull - (now - last_change),
+                            )
+                        )
                 # Take whole requests only — a request's items never split
                 # across launches (its future completes from one result set).
                 # A single oversized request is taken alone; the executor
@@ -358,6 +479,13 @@ class MicroBatcher:
                 else:
                     items = self._items[:unit_cursor]
                 self._items = self._items[unit_cursor:]
+                if self._arenas is not None and not self._items:
+                    # queue drained: new submits write the OTHER ring. The
+                    # ring just taken is packed by this thread's launch
+                    # BEFORE the next take, so by the time the write side
+                    # swaps back to it, nothing references its rows.
+                    self._arena_idx ^= 1
+                    self._arena_cursor = 0
                 self._pending -= taken + dropped
                 removed = taken + dropped
                 self._futures = [
@@ -384,10 +512,12 @@ class MicroBatcher:
             if self._overload is not None:
                 self._overload.observe_queue_wait(head_wait_ms)
 
-            if self._collect_q is not None:
-                # double-buffered: launch now (fast), hand the blocking
-                # readback to the collector; the bounded put is the
-                # backpressure that caps in-flight launches
+            if self._pipelined:
+                # double-buffered: launch now (fast), defer the blocking
+                # readback to the callers via a collect ticket. The
+                # semaphore (held launch -> redemption) is the
+                # backpressure that caps un-collected launches.
+                self._inflight_sem.acquire()
                 try:
                     token = self._execute_launch(items)
                 except BaseException as e:  # noqa: BLE001 - propagate
@@ -396,7 +526,9 @@ class MicroBatcher:
                             future.set_exception(e)
                     self._finish_one()
                 else:
-                    self._collect_q.put((token, futures))
+                    ticket = _CollectTicket(self, token)
+                    for future, start, count in futures:
+                        future.set_result((_TICKET, ticket, start, count))
                 continue
 
             try:
@@ -409,32 +541,11 @@ class MicroBatcher:
                         future.set_exception(e)
             self._finish_one()
 
-        # shutdown: the _CLOSE put happens OUTSIDE self._lock — the bounded
-        # queue may be full, and the collector needs the lock (in
-        # _finish_one) to drain a slot; putting under the lock would
-        # deadlock close() with collects in flight.
-        if self._collect_q is not None:
-            self._collect_q.put(_CLOSE)
-
     def _finish_one(self) -> None:
         with self._lock:
             self._last_end = time.monotonic()
             self._inflight -= 1
             if not self._items and not self._futures and not self._inflight:
                 self._idle.notify_all()
-
-    def _collect_loop(self) -> None:
-        while True:
-            entry = self._collect_q.get()
-            if entry is _CLOSE:
-                return
-            token, futures = entry
-            try:
-                results = self._execute_collect(token)
-                for future, start, count in futures:
-                    future.set_result(results[start : start + count])
-            except BaseException as e:  # noqa: BLE001 - propagate to callers
-                for future, _, _ in futures:
-                    if not future.done():
-                        future.set_exception(e)
-            self._finish_one()
+        if self._pipelined:
+            self._inflight_sem.release()

@@ -604,12 +604,27 @@ class SidecarEngineClient:
     def submit(self, items) -> list[int]:
         if not items:
             return []
+        return self._submit_payload(encode_items(items)).tolist()
+
+    def submit_rows(self, block: np.ndarray) -> np.ndarray:
+        """Zero-object verb: the uint32[6, n] row block IS the wire layout,
+        so the request frame is one header + one buffer copy — no per-item
+        encode at all."""
+        n = block.shape[1]
+        if n == 0:
+            return np.empty(0, dtype=np.uint32)
+        payload = _U32.pack(n) + np.ascontiguousarray(
+            block, dtype=np.uint32
+        ).tobytes()
+        return self._submit_payload(payload)
+
+    def _submit_payload(self, payload: bytes) -> np.ndarray:
         t0 = time.perf_counter() if self._h_rpc is not None else 0.0
         if not self._breaker.allow():
             raise CacheError(
                 f"sidecar circuit open on {self._path}: failing fast"
             )
-        request = _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+        request = _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + payload
         attempt = 0
         redialed = False
         while True:
@@ -669,7 +684,7 @@ class SidecarEngineClient:
             self._breaker.record_success()
             if self._h_rpc is not None:
                 self._h_rpc.record((time.perf_counter() - t0) * 1e3)
-            return out.tolist()
+            return out
 
     def flush(self) -> None:
         pass  # submits are synchronous end to end

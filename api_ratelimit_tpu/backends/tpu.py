@@ -111,6 +111,7 @@ class SlabDeviceEngine:
         watermark_critical: float = 0.0,
         overload=None,
         fault_injector=None,
+        precompile: bool = False,
     ):
         """scope: optional stats Scope rooted at the service prefix (e.g.
         the runner's `ratelimit` scope). When set, the engine records the
@@ -118,6 +119,10 @@ class SlabDeviceEngine:
         readback_ms} — and hands <scope>.batcher to the micro-batcher for
         queue-wait/batch-size/depth telemetry. None (the default) keeps
         the hot path entirely free of stats work.
+
+        precompile: compile the whole bucket ladder (every launch shape x
+        readback dtype) at construction so no request ever rides a JIT
+        compile (see precompile()).
 
         max_queue / overload / fault_injector are forwarded to the
         micro-batcher (bounded queue + brownout shedding + the
@@ -211,32 +216,37 @@ class SlabDeviceEngine:
             self._h_launch = device_scope.histogram("launch_ms")
             self._h_readback = device_scope.histogram("readback_ms")
             batcher_scope = scope.scope("batcher")
+        # Every engine is block-native internally: the batcher's unit is a
+        # uint32[6, n] row block and the executors copy whole column spans
+        # into the padded device block — the in-process frontend rides the
+        # same zero-object machinery the sidecar server proved (8x at
+        # aggregated load). block_mode only selects the PUBLIC verb set
+        # (submit_block for the sidecar wire path vs submit/submit_rows for
+        # in-process callers) and whether the batcher gets a row ring:
+        # sidecar wire blocks are one-shot buffers handed over by the
+        # server loop, while in-process submits come from reusable
+        # thread-local scratch, which the ring copies out of under the
+        # enqueue lock (one slot per descriptor).
         self._block_batcher = bool(block_mode)
-        if self._block_batcher:
-            self._batcher = MicroBatcher(
-                self._execute_blocks,
-                window_seconds=batch_window_seconds,
-                max_batch=max_batch,
-                execute_launch=self._execute_blocks_launch,
-                execute_collect=self._execute_blocks_collect,
-                block_mode=True,
-                scope=batcher_scope,
-                max_queue=max_queue,
-                overload=overload,
-                fault_injector=fault_injector,
-            )
-        else:
-            self._batcher = MicroBatcher(
-                self._execute_batch,
-                window_seconds=batch_window_seconds,
-                max_batch=max_batch,
-                execute_launch=self._execute_launch,
-                execute_collect=self._execute_collect,
-                scope=batcher_scope,
-                max_queue=max_queue,
-                overload=overload,
-                fault_injector=fault_injector,
-            )
+        self._batcher = MicroBatcher(
+            self._execute_blocks,
+            window_seconds=batch_window_seconds,
+            max_batch=max_batch,
+            execute_launch=self._execute_blocks_launch,
+            execute_collect=self._execute_blocks_collect,
+            block_mode=True,
+            scope=batcher_scope,
+            max_queue=max_queue,
+            overload=overload,
+            fault_injector=fault_injector,
+            arena_rows=0 if block_mode else min(2 * int(max_batch), 1 << 17),
+        )
+        # (bucket, readback dtype name) -> True for every launch shape
+        # compiled ahead of traffic; the health/readiness test asserts the
+        # ladder is covered before the server reports healthy.
+        self.precompiled: dict = {}
+        if precompile:
+            self.precompile()
 
     def _drain_health_locked(self) -> None:
         pending, self._pending_health = self._pending_health, []
@@ -339,13 +349,66 @@ class SlabDeviceEngine:
                 f"{self._watermark_critical:g}"
             )
 
+    def precompile(self) -> dict:
+        """Dispatch-floor attack, part 1: compile every launch shape the
+        bucket ladder can produce — each bucket size x each saturating
+        readback dtype (u8/u16/u32) — BEFORE the first request, so a
+        first-touch XLA compile (hundreds of ms to seconds) never rides a
+        caller's deadline. Each shape is warmed with an all-padding
+        (hits == 0) launch through the REAL donated-state chain: padding
+        lanes write nothing (ops/slab.py, the hits > 0 gates), so the slab
+        is bit-identical afterwards, and warming through the actual jit
+        call populates the dispatch cache the hot path hits (an AOT
+        lower().compile() object would compile the same program but leave
+        jit's own call cache cold). Returns the covered-shape map, also
+        kept as `precompiled`. The mesh engine owns its own program cache
+        and is skipped."""
+        if self._engine is not None:
+            _log.info("precompile: mesh engine manages its own programs")
+            return self.precompiled
+        # warm launches must not pollute the per-stage histograms: a
+        # boot-time compile in launch_ms would own p99 forever
+        saved = self._h_pack, self._h_launch, self._h_readback
+        self._h_pack = self._h_launch = self._h_readback = None
+        try:
+            for bucket in self._buckets:
+                packed = np.zeros((7, bucket), dtype=np.uint32)
+                for cap, name in (
+                    (0xFF, "uint8"),
+                    (0xFFFF, "uint16"),
+                    (0xFFFFFFFF, "uint32"),
+                ):
+                    self._collect_array(self._dispatch_packed(packed, 0, cap))
+                    self.precompiled[(bucket, name)] = True
+        finally:
+            self._h_pack, self._h_launch, self._h_readback = saved
+        return self.precompiled
+
     def submit(self, items: list[_Item]) -> list[int]:
         """Batched fixed-window increment; returns each item's
-        post-increment counter."""
+        post-increment counter. Compatibility verb: the engine is
+        block-native internally, so the _Item list is converted to one row
+        block at the door (the conversion cost lands on this legacy path
+        only — the zero-object pipeline calls submit_rows directly)."""
         if self._block_batcher:
             raise RuntimeError("engine is in block_mode; use submit_block")
+        if not items:
+            return []
         self._check_saturated()
-        return self._batcher.submit(items)
+        return self._batcher.submit(_items_to_block(items)).tolist()
+
+    def submit_rows(self, block: np.ndarray) -> np.ndarray:
+        """Zero-object verb: one uint32[6, n] row block (columns fp_lo,
+        fp_hi, hits, limit, divider, jitter — the sidecar wire layout) ->
+        uint32[n] post-increment counters. The caller may pass a reusable
+        scratch block: when the batcher doesn't consume submits (no row
+        ring configured), an owned copy decouples it here."""
+        if block.shape[1] == 0:
+            return np.empty(0, dtype=np.uint32)
+        self._check_saturated()
+        if not self._batcher.consumes_submits:
+            block = np.array(block, dtype=np.uint32)
+        return self._batcher.submit(block)
 
     def flush(self) -> None:
         self._batcher.flush()
@@ -418,63 +481,10 @@ class SlabDeviceEngine:
                 return b
         return self._max_bucket
 
-    def _execute_batch(self, items: list[_Item]) -> list[int]:
-        try:
-            out: list[int] = []
-            for off in range(0, len(items), self._max_bucket):
-                out.extend(self._launch(items[off : off + self._max_bucket]))
-            return out
-        except Exception as e:  # surfaced as redis_error-equivalent
-            raise CacheError(f"tpu backend failure: {e}") from e
-
-    def _execute_launch(self, items: list[_Item]):
-        """Double-buffered launch phase: dispatch every bucket of `items`
-        asynchronously (JAX launches are async; nothing here blocks on the
-        device) and return the tokens the collect phase will drain."""
-        try:
-            return [
-                self._launch_async(items[off : off + self._max_bucket])
-                for off in range(0, len(items), self._max_bucket)
-            ]
-        except Exception as e:
-            raise CacheError(f"tpu backend failure: {e}") from e
-
-    def _execute_collect(self, tokens) -> list[int]:
-        """Double-buffered collect phase: block on each bucket's readback."""
-        try:
-            out: list[int] = []
-            for token in tokens:
-                out.extend(self._collect(token))
-            return out
-        except CacheError:
-            raise
-        except Exception as e:
-            raise CacheError(f"tpu backend failure: {e}") from e
-
-    def _pack_with_cap(self, items: list[_Item]):
-        """(packed block, n, readback cap). The cap is the narrowest exact
-        readback width: a saturated value can only mean "already far over
-        limit", which the oracle's all-over branch handles exactly as long
-        as cap > limit + hits for every item in the launch."""
-        packed = self._pack(items)
-        maxv = max(it.limit + it.hits for it in items)
-        cap = 0xFF if maxv < 255 else 0xFFFF if maxv < 65535 else 0xFFFFFFFF
-        return packed, len(items), cap
-
     def _launch(self, items: list[_Item]) -> list[int]:
-        """One synchronous device launch (direct mode); returns each item's
-        post-increment counter."""
-        return self._collect(self._launch_async(items))
-
-    def _launch_async(self, items: list[_Item]):
-        """Async launch: pack, dispatch, return a token without waiting for
-        execution."""
-        if self._h_pack is None:
-            return self._dispatch_packed(*self._pack_with_cap(items))
-        t0 = time.perf_counter()
-        packed = self._pack_with_cap(items)
-        self._h_pack.record((time.perf_counter() - t0) * 1e3)
-        return self._dispatch_packed(*packed)
+        """One synchronous device launch of an _Item list (tests/tools);
+        rides the block executors like everything else."""
+        return self._execute_blocks([_items_to_block(items)]).tolist()
 
     def _dispatch_packed(self, packed: np.ndarray, n: int, cap: int):
         """Dispatch one packed uint32[7, bucket] launch; returns the token
@@ -485,7 +495,8 @@ class SlabDeviceEngine:
         dispatch, never the device execution — readback_ms carries the
         blocking wait)."""
         t_launch = time.perf_counter() if self._h_launch is not None else 0.0
-        self.launch_sizes.append(n)
+        if n:  # precompile dispatches empty warmers; keep the ring honest
+            self.launch_sizes.append(n)
         if self._engine is not None:
             token = self._engine.launch_after_compact(packed, cap)
             # counted after the launch returns, like the single-device path:
@@ -539,9 +550,6 @@ class SlabDeviceEngine:
         if self._h_launch is not None:
             self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
         return after_dev, n
-
-    def _collect(self, token) -> list[int]:
-        return self._collect_array(token).tolist()
 
     def _collect_array(self, token) -> np.ndarray:
         """Blocking readback of one launch token. readback_ms covers the
@@ -652,20 +660,34 @@ class SlabDeviceEngine:
         except Exception as e:
             raise CacheError(f"tpu backend failure: {e}") from e
 
-    def _pack(self, items: list[_Item]) -> np.ndarray:
-        """uint32[7, bucket] input block (one H2D transfer per launch)."""
-        n = len(items)
-        size = self._bucket_for(n)
-        packed = np.zeros((7, size), dtype=np.uint32)
-        fp = np.fromiter((it.fp for it in items), dtype=np.uint64, count=n)
-        packed[0, :n], packed[1, :n] = split_fingerprints(fp)
-        packed[2, :n] = np.fromiter((it.hits for it in items), np.uint32, n)
-        packed[3, :n] = np.fromiter((it.limit for it in items), np.uint32, n)
-        packed[4, :n] = np.fromiter((it.divider for it in items), np.uint32, n)
-        packed[5, :n] = np.fromiter((it.jitter for it in items), np.uint32, n)
-        packed[6, 0] = np.uint32(self._time_source.unix_now())
-        packed[6, 1] = np.float32(self._near_limit_ratio).view(np.uint32)
-        return packed
+def _block_to_items(block: np.ndarray) -> list[_Item]:
+    """Inverse adapter for engines that only speak the _Item verb."""
+    cols = block.T.tolist()
+    return [
+        _Item(
+            fp=(hi << 32) | lo,
+            hits=hits,
+            limit=limit,
+            divider=divider,
+            jitter=jitter,
+        )
+        for lo, hi, hits, limit, divider, jitter in cols
+    ]
+
+
+def _items_to_block(items: list[_Item]) -> np.ndarray:
+    """uint32[6, n] row block from an _Item list — the legacy-verb adapter
+    into the block-native engine (wire layout: fp_lo, fp_hi, hits, limit,
+    divider, jitter)."""
+    n = len(items)
+    block = np.empty((6, n), dtype=np.uint32)
+    fp = np.fromiter((it.fp for it in items), dtype=np.uint64, count=n)
+    block[0], block[1] = split_fingerprints(fp)
+    block[2] = np.fromiter((it.hits for it in items), np.uint32, n)
+    block[3] = np.fromiter((it.limit for it in items), np.uint32, n)
+    block[4] = np.fromiter((it.divider for it in items), np.uint32, n)
+    block[5] = np.fromiter((it.jitter for it in items), np.uint32, n)
+    return block
 
 
 class SlabHealthStats:
@@ -742,10 +764,17 @@ class TpuRateLimitCache:
         watermark_critical: float = 0.0,
         overload=None,
         fault_injector=None,
+        precompile: bool = False,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
-        passes a socket client instead (backends/sidecar.py).
+        passes a socket client instead (backends/sidecar.py). Engines
+        additionally exposing submit_rows(uint32[6, n]) -> uint32[n] get
+        the zero-object row path (do_limit_resolved).
+
+        precompile: compile the in-process engine's whole bucket ladder at
+        construction (SlabDeviceEngine.precompile) so no request rides a
+        first-touch JIT compile.
 
         stats_scope: optional stats Scope (the runner's `ratelimit` root);
         forwarded to the in-process engine for device/batcher histograms.
@@ -777,12 +806,36 @@ class TpuRateLimitCache:
                 watermark_critical=watermark_critical,
                 overload=overload,
                 fault_injector=fault_injector,
+                precompile=precompile,
             )
         self._engine_core = engine
+        # zero-object row verb when the engine has one (the in-process
+        # engine and the sidecar client both do; exotic test engines fall
+        # back to the _Item conversion)
+        self._submit_rows = getattr(engine, "submit_rows", None)
+        # per-thread scratch row block: do_limit_resolved fills columns in
+        # place and the batcher's row ring copies them out under its lock,
+        # so the steady-state request path allocates no numpy buffers
+        self._scratch = threading.local()
+        # host-stage histograms (bench host_split + GET /metrics): the
+        # descriptor-admission/key-compose loop and the status-build loop,
+        # in sub-millisecond buckets (these stages run in microseconds)
+        self._h_key_compose = self._h_response = None
+        if stats_scope is not None:
+            from ..stats.store import HOST_STAGE_BUCKETS_MS
+
+            host_scope = stats_scope.scope("host")
+            self._h_key_compose = host_scope.histogram(
+                "key_compose_ms", boundaries=HOST_STAGE_BUCKETS_MS
+            )
+            self._h_response = host_scope.histogram(
+                "response_ms", boundaries=HOST_STAGE_BUCKETS_MS
+            )
         # (domain, entries, divider) -> fingerprint. Rate-limit traffic is
         # Zipfian (hot keys dominate), so memoizing descriptor hashes removes
         # the hashing cost for the hot set; clear-on-full bounds a hostile
-        # key flood the same way the near-threshold memo does.
+        # key flood the same way the near-threshold memo does. (The legacy
+        # do_limit path only — resolved records carry their fingerprint.)
         self._fp_cache: dict = {}
         self._fp_cache_max = 1 << 17
 
@@ -904,6 +957,131 @@ class TpuRateLimitCache:
                 )
             )
         assert_(len(response.descriptor_statuses) == n)
+        return response
+
+    def _scratch_block(self, n: int) -> np.ndarray:
+        """This thread's reusable uint32[6, >=n] staging block."""
+        block = getattr(self._scratch, "block", None)
+        if block is None or block.shape[1] < n:
+            block = self._scratch.block = np.empty(
+                (6, max(64, n)), dtype=np.uint32
+            )
+        return block
+
+    def do_limit_resolved(self, request, resolved) -> DoLimitResponse:
+        """Zero-object hot path: one precompiled ResolvedLimit record per
+        descriptor (config/compiled.py) instead of (limits, string keys,
+        _Item objects). Per descriptor the admission loop does counter
+        adds, the optional local-cache probe (key = precomputed prefix +
+        window — no joins), and six uint32 column writes into this
+        thread's scratch block; the whole request then submits as ONE row
+        block into the batcher's ring. Decision-identical to do_limit by
+        construction: the same BaseRateLimiter oracle builds every status
+        (differential-tested in tests/test_compiled_matcher.py)."""
+        base = self._base
+        hits_addend = max(1, request.hits_addend)
+        time_source = base.time_source
+        now = time_source.unix_now()
+        local_cache = base.local_cache
+        n = len(resolved)
+        span = tag_do_limit_start("tpu", n, n)
+
+        h_key = self._h_key_compose
+        t0 = time.perf_counter() if h_key is not None else 0.0
+        block = self._scratch_block(n)
+        pending_count = 0
+        keys = [None] * n if local_cache is not None else None
+        over_local: list[bool] | None = None
+        for i in range(n):
+            rec = resolved[i]
+            if rec is None:
+                continue
+            rec.stats.total_hits.add(hits_addend)
+            divider = rec.divider
+            if local_cache is not None:
+                key = rec.key_prefix + str((now // divider) * divider)
+                keys[i] = key
+                # shadow rules never consult the over-limit cache
+                # (base_limiter.is_over_limit_with_local_cache rationale)
+                if not rec.shadow_mode and local_cache.contains(key):
+                    if over_local is None:
+                        over_local = [False] * n
+                    over_local[i] = True
+                    continue
+            block[:, pending_count] = (
+                rec.fp_lo,
+                rec.fp_hi,
+                hits_addend,
+                rec.requests_per_unit,
+                divider,
+                base.expiration_seconds(divider) - divider,
+            )
+            pending_count += 1
+        if h_key is not None:
+            h_key.record((time.perf_counter() - t0) * 1e3)
+
+        if span is not None:
+            span.log_kv(event="lookup.start", batch_items=pending_count)
+        if pending_count:
+            if self._submit_rows is not None:
+                afters = self._submit_rows(block[:, :pending_count]).tolist()
+            else:
+                afters = self._engine_core.submit(
+                    _block_to_items(block[:, :pending_count])
+                )
+        else:
+            afters = ()
+        if span is not None:
+            span.log_kv(event="tpu.lookup.done", client="slab")
+
+        t0 = time.perf_counter() if self._h_response is not None else 0.0
+        response = DoLimitResponse()
+        statuses = response.descriptor_statuses
+        get_status = base.get_response_descriptor_status
+        pos = 0
+        for i in range(n):
+            rec = resolved[i]
+            if rec is None:
+                statuses.append(
+                    get_status("", None, False, hits_addend, response)
+                )
+                continue
+            limit = rec.limit
+            if over_local is not None and over_local[i]:
+                statuses.append(
+                    get_status(
+                        keys[i],
+                        LimitInfo(limit, -hits_addend, 0),
+                        True,
+                        hits_addend,
+                        response,
+                    )
+                )
+                continue
+            after = afters[pos]
+            pos += 1
+            info = LimitInfo(limit, after - hits_addend, after)
+            if local_cache is not None:
+                key = keys[i]
+                if not rec.shadow_mode and after > rec.requests_per_unit:
+                    # the batched decision may have landed in a LATER
+                    # window than the key was stamped with (do_limit's
+                    # re-stamp rationale)
+                    now2 = time_source.unix_now()
+                    key = rec.key_prefix + str(
+                        (now2 // rec.divider) * rec.divider
+                    )
+            else:
+                # no local cache: the key's only remaining job is the
+                # non-empty "checked" marker — the prefix serves without
+                # composing a window key
+                key = rec.key_prefix
+            statuses.append(
+                get_status(key, info, False, hits_addend, response)
+            )
+        if self._h_response is not None:
+            self._h_response.record((time.perf_counter() - t0) * 1e3)
+        assert_(len(statuses) == n)
         return response
 
     def flush(self) -> None:

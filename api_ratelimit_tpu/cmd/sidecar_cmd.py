@@ -116,6 +116,11 @@ def main() -> None:
         watermark_critical=watermark_critical,
         overload=overload,
         fault_injector=fault_injector,
+        # compile the bucket ladder before the first frontend connects —
+        # the device owner must never spend a frontend's RPC deadline on
+        # a first-touch XLA compile
+        precompile=settings.tpu_precompile,
+        **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
 

@@ -1,0 +1,198 @@
+"""Compiled config matcher: the hot-path twin of the YAML rule trie.
+
+The tree walker in config/loader.py resolves a descriptor by composing
+"key_value" strings and probing child dicts level by level — correct, but it
+re-does string composition and trie descent for every request even though
+rate-limit traffic is Zipfian (a small hot set of distinct descriptors
+dominates). At config load/hot-reload this module compiles the rule tree
+into flat lookup structures:
+
+  * an interned-vocab resolve memo: ONE dict probe per descriptor, keyed by
+    the (domain, entries) tuple the transport already built, mapping to a
+    frozen ResolvedLimit record;
+  * each record carries everything the zero-object request pipeline needs,
+    precomputed once: the rule and its stat handles, the window divider,
+    the fixed-window cache-key PREFIX (key = prefix + str(window_start),
+    byte-identical to limiter/cache_key.py), the 64-bit slab fingerprint
+    already split into uint32 halves, and the shadow/sleep/report flags —
+    so the per-request path never touches the trie, never joins strings,
+    and never re-hashes;
+  * a memo for request-level override rules, so repeated overrides stop
+    paying five stats-registry lock acquisitions per request
+    (models/config.py new_rate_limit_stats) — the store caches counters by
+    name, so the memoized rule keeps counting into the same counters.
+
+The memo is populated lazily (wildcard rules match request-supplied values,
+so records cannot be enumerated at compile time) and misses fall back to
+the UNCHANGED tree walker — exact-parity by construction, pinned by the
+differential fuzz suite (tests/test_compiled_matcher.py) including the
+reference's composed-key aliasing quirk (a bare config key "a_b" matches a
+request entry ("a", "b")).
+
+A matcher is immutable after construction and a hot reload swaps the whole
+RateLimitConfig (and with it the matcher + its memos) in one reference
+assignment — a request resolves every descriptor against ONE matcher
+generation, so a reload can never yield a torn read (old prefix with new
+limit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..models.config import RateLimit, RateLimitStats
+from ..models.descriptors import Descriptor, Entry
+from ..models.units import Unit, unit_to_divider
+from ..ops.hashing import fingerprint64
+
+# Bounds on the lazily-populated memos: descriptor values (and override
+# limits) are request-controlled, so the key space is attacker-sized;
+# clear-on-full keeps a hostile key flood from growing them without bound
+# (the same posture as the fingerprint/near-threshold memos elsewhere).
+_RESOLVE_CACHE_MAX = 1 << 16
+_OVERRIDE_CACHE_MAX = 1 << 12
+
+_MISS = object()  # memoized "no rule matches this descriptor"
+
+
+@dataclass(frozen=True, slots=True)
+class ResolvedLimit:
+    """One descriptor's fully-resolved hot-path record, frozen at first
+    resolution. `fp` is fingerprint64(domain, entries, divider) — the slab
+    identity the device probes on; `key_prefix` + str(window_start) is the
+    exact string limiter/cache_key.py would compose."""
+
+    limit: RateLimit
+    stats: RateLimitStats
+    requests_per_unit: int
+    divider: int
+    key_prefix: str
+    fp: int
+    fp_lo: int
+    fp_hi: int
+    shadow_mode: bool
+    sleep_on_throttle: bool
+    report_details: bool
+    per_second: bool
+
+
+def _key_prefix(domain: str, entries: tuple[Entry, ...]) -> str:
+    """The window-independent half of the fixed-window cache key
+    (limiter/cache_key.py layout): "<domain>_<k1>_<v1>_..._"."""
+    parts = [domain]
+    for entry in entries:
+        parts.append(entry.key)
+        parts.append(entry.value)
+    return "_".join(parts) + "_"
+
+
+def _make_record(
+    domain: str, entries: tuple[Entry, ...], limit: RateLimit
+) -> ResolvedLimit:
+    divider = unit_to_divider(limit.unit)
+    fp = fingerprint64(domain, entries, divider)
+    return ResolvedLimit(
+        limit=limit,
+        stats=limit.stats,
+        requests_per_unit=limit.requests_per_unit,
+        divider=divider,
+        key_prefix=_key_prefix(domain, entries),
+        fp=fp,
+        fp_lo=fp & 0xFFFFFFFF,
+        fp_hi=fp >> 32,
+        shadow_mode=limit.shadow_mode,
+        sleep_on_throttle=limit.sleep_on_throttle,
+        report_details=limit.report_details,
+        per_second=limit.unit == Unit.SECOND,
+    )
+
+
+class CompiledMatcher:
+    """Flat lookup over a loaded rule tree. `get_limit` keeps the walker's
+    signature so service code and tests don't churn; `resolve` is the
+    zero-object pipeline's entry and returns the full record."""
+
+    __slots__ = (
+        "_walk",
+        "_new_rate_limit",
+        "_domains",
+        "_resolve_cache",
+        "_override_cache",
+    )
+
+    def __init__(self, tree_walker, new_rate_limit, domains):
+        """tree_walker: the exact-semantics fallback,
+        (domain, descriptor) -> RateLimit | None (the loader's trie walk).
+        new_rate_limit: factory for request-level override rules
+        (RateLimitConfig._new_rate_limit). domains: the loaded domain
+        container — an override only applies when its domain is configured
+        (config_impl.go:273-278)."""
+        self._walk = tree_walker
+        self._new_rate_limit = new_rate_limit
+        self._domains = domains
+        self._resolve_cache: dict = {}
+        self._override_cache: dict = {}
+
+    # -- lookup --
+
+    def resolve(self, domain: str, descriptor: Descriptor) -> ResolvedLimit | None:
+        if descriptor.limit is not None:
+            if domain not in self._domains:
+                return None
+            return self._resolve_override(domain, descriptor)
+        cache = self._resolve_cache
+        key = (domain, descriptor.entries)
+        record = cache.get(key)
+        if record is not None:
+            return None if record is _MISS else record
+        limit = self._walk(domain, descriptor)
+        record = _MISS if limit is None else _make_record(
+            domain, descriptor.entries, limit
+        )
+        if len(cache) >= _RESOLVE_CACHE_MAX:
+            cache.clear()
+        cache[key] = record
+        return None if record is _MISS else record
+
+    def _resolve_override(
+        self, domain: str, descriptor: Descriptor
+    ) -> ResolvedLimit:
+        """Request-level override (config_impl.go:281-290): an ad-hoc rule
+        keyed by the descriptor's dotted path. Memoized so a repeated
+        override resolves its stat handles once, not per request."""
+        override = descriptor.limit
+        cache = self._override_cache
+        key = (
+            domain,
+            descriptor.entries,
+            override.requests_per_unit,
+            override.unit,
+        )
+        record = cache.get(key)
+        if record is None:
+            limit = self._new_rate_limit(
+                override.requests_per_unit,
+                Unit(override.unit),
+                f"{domain}.{_descriptor_dotted_key(descriptor)}",
+            )
+            record = _make_record(domain, descriptor.entries, limit)
+            if len(cache) >= _OVERRIDE_CACHE_MAX:
+                cache.clear()
+            cache[key] = record
+        return record
+
+    def get_limit(self, domain: str, descriptor: Descriptor) -> RateLimit | None:
+        record = self.resolve(domain, descriptor)
+        return None if record is None else record.limit
+
+
+def _descriptor_dotted_key(descriptor: Descriptor) -> str:
+    """RateLimitConfig._descriptor_to_key twin (kept here so the override
+    path doesn't bounce back into the loader)."""
+    parts = []
+    for entry in descriptor.entries:
+        part = entry.key
+        if entry.value != "":
+            part += f"_{entry.value}"
+        parts.append(part)
+    return ".".join(parts)

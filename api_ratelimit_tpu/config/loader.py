@@ -138,13 +138,24 @@ def _validate_keys(file: ConfigFile, node, allowed=_ROOT_KEYS, ctx="the file roo
 
 
 class RateLimitConfig:
-    """An immutable, loaded rule tree over one or more YAML files."""
+    """An immutable, loaded rule tree over one or more YAML files.
+
+    `compiled` is the flat hot-path matcher built over the finished tree
+    (config/compiled.py): get_limit delegates to it, and the service's
+    zero-object pipeline calls compiled.resolve directly for the full
+    precomputed record. The raw walker stays available as get_limit_tree —
+    it is the memo-miss fallback and the differential-fuzz oracle."""
 
     def __init__(self, files: Iterable[ConfigFile], stats_scope):
         self._domains: dict[str, _Node] = {}
         self._stats_scope = stats_scope
         for file in files:
             self._load_file(file)
+        from .compiled import CompiledMatcher
+
+        self.compiled = CompiledMatcher(
+            self.get_limit_tree, self._new_rate_limit, self._domains
+        )
 
     # -- loading --
 
@@ -268,7 +279,14 @@ class RateLimitConfig:
         return ".".join(parts)
 
     def get_limit(self, domain: str, descriptor: Descriptor) -> RateLimit | None:
-        """Resolve the applicable rule, or None when unchecked."""
+        """Resolve the applicable rule, or None when unchecked. One memoized
+        flat lookup for the hot set (config/compiled.py); the tree walk
+        below runs only on memo misses."""
+        return self.compiled.get_limit(domain, descriptor)
+
+    def get_limit_tree(self, domain: str, descriptor: Descriptor) -> RateLimit | None:
+        """The original trie walk (config_impl.go:293-319) — the compiled
+        matcher's fallback and the differential-fuzz oracle."""
         domain_node = self._domains.get(domain)
         if domain_node is None:
             return None

@@ -31,6 +31,15 @@ from .cache_key import CacheKey, EMPTY, generate_cache_key
 from .local_cache import LocalCache
 
 
+# Preallocated status template for unchecked descriptors (no matching
+# rule): every field is request-independent, so all backends share ONE
+# instance instead of constructing an identical dataclass per descriptor.
+# Treat as frozen — transports and tests only read statuses.
+UNCHECKED_STATUS = DescriptorStatus(
+    code=Code.OK, current_limit=None, limit_remaining=0
+)
+
+
 class LimitInfo:
     __slots__ = ("limit", "before", "after", "near_threshold", "over_threshold")
 
@@ -149,7 +158,7 @@ class BaseRateLimiter:
         response: DoLimitResponse | None,
     ) -> DescriptorStatus:
         if key == "":
-            return DescriptorStatus(code=Code.OK, current_limit=None, limit_remaining=0)
+            return UNCHECKED_STATUS
 
         limit = limit_info.limit
         now = self.time_source.unix_now()

@@ -111,6 +111,10 @@ def create_limiter(
             devices = jax.devices()[: settings.tpu_mesh_devices]
             mesh = Mesh(np.array(devices), ("shard",))
         watermark_high, watermark_critical = settings.slab_watermarks()
+        kwargs = {}
+        ladder = settings.buckets()
+        if ladder is not None:
+            kwargs["buckets"] = ladder
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
@@ -124,6 +128,10 @@ def create_limiter(
             watermark_critical=watermark_critical,
             overload=overload,
             fault_injector=fault_injector,
+            # the bucket ladder compiles BEFORE the server reports
+            # healthy: no request ever rides a first-touch XLA compile
+            precompile=settings.tpu_precompile,
+            **kwargs,
         )
     if backend == "tpu-sidecar":
         from .backends.sidecar import new_sidecar_cache_from_settings
@@ -347,6 +355,7 @@ class Runner:
             # drain-aware pacing: once health flips for shutdown, throttle
             # sleeps shed instead of pinning workers through the drain
             draining_probe=lambda: not self.server.health.ok(),
+            host_fast_path=settings.host_fast_path,
         )
 
         def dump_config() -> str:

@@ -72,6 +72,8 @@ class _ServiceStats:
     under the backend's scopes)."""
 
     def __init__(self, scope):
+        from ..stats.store import HOST_STAGE_BUCKETS_MS
+
         self.config_load_success = scope.counter("config_load_success")
         self.config_load_error = scope.counter("config_load_error")
         call_scope = scope.scope("call.should_rate_limit")
@@ -81,6 +83,19 @@ class _ServiceStats:
         # out, or out of sleeper slots — pacing must never pin workers
         self.sleep_shed = call_scope.counter("sleep_shed")
         self.latency = call_scope.histogram("latency_ms")
+        # compiled-matcher resolve time per request (bench host_split)
+        self.matcher = scope.scope("host").histogram(
+            "matcher_ms", boundaries=HOST_STAGE_BUCKETS_MS
+        )
+
+
+def _limits_of(limits, resolved) -> Sequence[RateLimit | None]:
+    """Materialize the per-descriptor RateLimit list on the cold paths
+    that still need one (shed / fallback answers); the fast path carries
+    ResolvedLimit records instead and skips the allocation."""
+    if limits is not None:
+        return limits
+    return [r.limit if r is not None else None for r in resolved]
 
 
 class RateLimitService:
@@ -97,6 +112,7 @@ class RateLimitService:
         fallback=None,
         overload=None,
         draining_probe: Callable[[], bool] | None = None,
+        host_fast_path: bool = True,
     ):
         """fallback: optional backends.fallback.FallbackLimiter — the
         FAILURE_MODE_DENY degradation ladder. When set, a backend
@@ -113,9 +129,18 @@ class RateLimitService:
 
         draining_probe: () -> True while the server is draining (health
         flipped for shutdown); used to skip throttle pacing sleeps so
-        shutdown can never be pinned by sleeping workers."""
+        shutdown can never be pinned by sleeping workers.
+
+        host_fast_path: use the zero-object pipeline (compiled-matcher
+        resolve -> cache.do_limit_resolved) when both the config and the
+        cache support it (HOST_FAST_PATH). False pins the legacy
+        get_limit/do_limit path — the rollback knob, and the bench's
+        host_path_overhead_pct A/B arm."""
         self._runtime = runtime
         self._cache = cache
+        self._do_limit_resolved = (
+            getattr(cache, "do_limit_resolved", None) if host_fast_path else None
+        )
         self._fallback = fallback
         self._overload = overload
         self._draining_probe = draining_probe
@@ -289,25 +314,59 @@ class RateLimitService:
 
         sleep_on_throttle = False
         report_details = False
-        limits: list[RateLimit | None] = []
-        for descriptor in request.descriptors:
-            limit = config.get_limit(request.domain, descriptor)
-            if logger.isEnabledFor(logging.DEBUG):
-                if limit is None:
+        debug = logger.isEnabledFor(logging.DEBUG)
+        compiled = (
+            getattr(config, "compiled", None)
+            if self._do_limit_resolved is not None
+            else None
+        )
+        resolved = None
+        if compiled is not None:
+            # zero-object pipeline: one memoized matcher lookup per
+            # descriptor yields the full precomputed record; `limits` is
+            # only materialized on the cold paths that need it (shed /
+            # fallback answers) — see _limits_of.
+            t0 = time.perf_counter()
+            resolve = compiled.resolve
+            domain = request.domain
+            resolved = [resolve(domain, d) for d in request.descriptors]
+            self._stats.matcher.record((time.perf_counter() - t0) * 1e3)
+            limits: list[RateLimit | None] | None = None
+            for record in resolved:
+                if record is not None:
+                    sleep_on_throttle = sleep_on_throttle or record.sleep_on_throttle
+                    report_details = report_details or record.report_details
+                    if debug:
+                        logger.debug(
+                            "applying limit: %d requests per %s",
+                            record.requests_per_unit,
+                            record.limit.unit.name,
+                        )
+                elif debug:
                     logger.debug("descriptor does not match any limit")
-                else:
-                    logger.debug(
-                        "applying limit: %d requests per %s",
-                        limit.requests_per_unit,
-                        limit.unit.name,
-                    )
-            limits.append(limit)
-            if limit is not None:
-                sleep_on_throttle = sleep_on_throttle or limit.sleep_on_throttle
-                report_details = report_details or limit.report_details
+        else:
+            limits = []
+            for descriptor in request.descriptors:
+                limit = config.get_limit(request.domain, descriptor)
+                if debug:
+                    if limit is None:
+                        logger.debug("descriptor does not match any limit")
+                    else:
+                        logger.debug(
+                            "applying limit: %d requests per %s",
+                            limit.requests_per_unit,
+                            limit.unit.name,
+                        )
+                limits.append(limit)
+                if limit is not None:
+                    sleep_on_throttle = sleep_on_throttle or limit.sleep_on_throttle
+                    report_details = report_details or limit.report_details
 
         try:
-            do_limit_response = self._cache.do_limit(request, limits)
+            if resolved is not None:
+                do_limit_response = self._do_limit_resolved(request, resolved)
+            else:
+                do_limit_response = self._cache.do_limit(request, limits)
         except DeadlineExceededError:
             # expired in the batcher queue: abort, never answer late, and
             # never consult the failure ladder (its answer would still be
@@ -321,7 +380,9 @@ class RateLimitService:
             # ladder, which would misread pressure as backend death.
             if self._overload is None:
                 raise
-            return self._shed_answer(request, limits, e)
+            return self._shed_answer(
+                request, _limits_of(limits, resolved), e
+            )
         except CacheError as e:
             # Degradation ladder (FAILURE_MODE_DENY): a dead backend — or
             # the sidecar breaker failing fast while open — degrades to a
@@ -336,13 +397,18 @@ class RateLimitService:
                 span.log_kv(
                     event="fallback", failure_mode=self._fallback.mode
                 )
-            do_limit_response = self._fallback.do_limit(request, limits, e)
+            do_limit_response = self._fallback.do_limit(
+                request, _limits_of(limits, resolved), e
+            )
         else:
             if self._fallback is not None:
                 self._fallback.note_success()
             if self._overload is not None:
                 self._overload.note_ok()
-        assert_(len(limits) == len(do_limit_response.descriptor_statuses))
+        assert_(
+            len(request.descriptors)
+            == len(do_limit_response.descriptor_statuses)
+        )
 
         if sleep_on_throttle and do_limit_response.throttle_millis > 0:
             self._maybe_sleep(do_limit_response)

@@ -100,6 +100,18 @@ class Settings:
     tpu_batch_limit: int = 65536
     tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
     tpu_use_pallas: bool = True
+    # compile the whole bucket ladder (every launch shape x readback dtype)
+    # at boot, before the server reports healthy, so no request ever rides
+    # a first-touch XLA compile (backends/tpu.py precompile())
+    tpu_precompile: bool = True
+    # override the launch-shape bucket ladder (comma-separated ints,
+    # ascending; empty = the built-in 128,1024,8192,65536). Fewer/smaller
+    # buckets trade padding waste for fewer compiled programs and a
+    # faster precompile boot.
+    tpu_buckets: str = ""
+    # zero-object host pipeline (compiled matcher -> row-block submit);
+    # false pins the legacy per-object path — the rollback knob
+    host_fast_path: bool = True
     # BACKEND_TYPE=tpu-sidecar: address of the device-owner process
     # (cmd/sidecar_cmd.py) — a unix socket path for same-host frontends, or
     # tcp://host:port / tls://host:port for frontends on other hosts (the
@@ -197,6 +209,23 @@ class Settings:
                 f"got {raw!r}"
             )
         return buckets
+
+    def buckets(self) -> tuple[int, ...] | None:
+        """Parsed TPU_BUCKETS ladder, or None for the engine default.
+        Junk (non-ints, non-positive, empty after parsing) fails the boot
+        like a typo'd bucket ladder must."""
+        raw = self.tpu_buckets.strip()
+        if not raw:
+            return None
+        try:
+            ladder = tuple(sorted(int(p) for p in raw.split(",") if p.strip()))
+        except ValueError as e:
+            raise ValueError(f"TPU_BUCKETS must be integers, got {raw!r}") from e
+        if not ladder or any(b <= 0 for b in ladder):
+            raise ValueError(
+                f"TPU_BUCKETS must be positive integers, got {raw!r}"
+            )
+        return ladder
 
     def failure_mode(self) -> str | None:
         """Parsed FAILURE_MODE_DENY: None (empty — legacy raise-through),
@@ -342,6 +371,9 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_batch_limit", "TPU_BATCH_LIMIT", int),
     ("tpu_mesh_devices", "TPU_MESH_DEVICES", int),
     ("tpu_use_pallas", "TPU_USE_PALLAS", _parse_bool),
+    ("tpu_precompile", "TPU_PRECOMPILE", _parse_bool),
+    ("tpu_buckets", "TPU_BUCKETS", str),
+    ("host_fast_path", "HOST_FAST_PATH", _parse_bool),
     ("sidecar_socket", "SIDECAR_SOCKET", str),
     ("sidecar_socket_mode", "SIDECAR_SOCKET_MODE", lambda raw: int(raw, 8)),
     ("sidecar_tls_cert", "SIDECAR_TLS_CERT", str),

@@ -40,6 +40,20 @@ DEFAULT_SIZE_BUCKETS: tuple[float, ...] = tuple(
     float(1 << i) for i in range(0, 17)
 )  # 1 .. 65536
 
+# Sub-millisecond ladder for the host-path stage histograms (matcher /
+# key-compose / response build): these stages run in single-digit
+# microseconds, far below the request-latency ladder's 50us floor.
+HOST_STAGE_BUCKETS_MS: tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+# Hot-path discipline note: every stat on the request path must be
+# resolved to a handle ONCE (service/backend __init__, or rule-compile
+# time for per-rule counters — config/compiled.py) — scope.counter()/
+# histogram() take the store registry lock and build dotted names, which
+# is flush-time work, never per-request work.
+
 
 class Counter:
     """Monotonic counter. add/inc are thread-safe."""

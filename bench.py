@@ -671,6 +671,34 @@ _STAGE_HISTOGRAMS = (
     ("readback_ms", "ratelimit.device.readback_ms"),
 )
 
+# The host half of the pipeline, per request, in NANOSECONDS (these stages
+# run in single-digit microseconds — ms resolution would read as zero):
+# matcher resolve (service), key-compose/admission + row writes (cache),
+# launch-block pack (device scope, per launch), status build (cache).
+# Sourced from the same runtime histograms GET /metrics renders.
+_HOST_STAGE_HISTOGRAMS = (
+    ("matcher_ns", "ratelimit.service.host.matcher_ms"),
+    ("key_compose_ns", "ratelimit.host.key_compose_ms"),
+    ("pack_ns", "ratelimit.device.pack_ms"),
+    ("response_ns", "ratelimit.host.response_ms"),
+)
+
+
+def _host_split(store) -> dict:
+    """Per-request host-stage count/p50/p99 (ns) from the runtime
+    histograms recorded during the timed drive."""
+    hists = store.metrics_snapshot()["histograms"]
+    out = {}
+    for short, name in _HOST_STAGE_HISTOGRAMS:
+        h = hists.get(name)
+        if h and h["count"]:
+            out[short] = {
+                "count": h["count"],
+                "p50": round(h["p50"] * 1e6),
+                "p99": round(h["p99"] * 1e6),
+            }
+    return out
+
 
 def _stage_timings(store) -> dict:
     """Per-stage count/p50/p99 from the runtime histograms recorded DURING
@@ -690,10 +718,17 @@ def _stage_timings(store) -> dict:
     return out
 
 
-def _build_service(config_key: str, yaml_text: str, telemetry: bool):
+def _build_service(
+    config_key: str,
+    yaml_text: str,
+    telemetry: bool,
+    on_tpu: bool = False,
+    host_fast_path: bool = True,
+):
     """One service stack for a scenario; telemetry=False builds the same
     stack with no stats scope on the backend (the A/B for recording
-    overhead). Returns (service, cache, store)."""
+    overhead); host_fast_path=False pins the legacy per-object host path
+    (the host_path_overhead_pct A/B arm). Returns (service, cache, store)."""
     import random
 
     from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
@@ -727,12 +762,23 @@ def _build_service(config_key: str, yaml_text: str, telemetry: bool):
         batch_window_seconds=0.0002,
         max_batch=8192,
         stats_scope=store.scope("ratelimit") if telemetry else None,
+        # CPU: pad tiny closed-loop batches into tiny programs — bucket 8
+        # costs ~0.036ms/launch vs 0.071ms at bucket 128 on the 1-core
+        # box. TPU keeps the stock ladder: Mosaic tiling wants the
+        # 128-lane shapes, and a rejected tiny-bucket Pallas launch would
+        # flip the whole engine onto the XLA twin.
+        buckets=(8, 32, 128, 1024, 8192) if not on_tpu else (128, 1024, 8192, 65536),
+        # compile the whole ladder before the timed drive (the production
+        # TPU_PRECOMPILE posture; first-touch compiles otherwise ride the
+        # warmup's tail and pollute the first timed samples)
+        precompile=True,
     )
     service = RateLimitService(
         runtime=_StaticRuntime(yaml_text),
         cache=cache,
         stats_scope=store.scope("ratelimit").scope("service"),
         time_source=RealTimeSource(),
+        host_fast_path=host_fast_path,
     )
     return service, cache, store
 
@@ -743,6 +789,7 @@ def bench_service(
     on_tpu: bool,
     measure_telemetry_overhead: bool = False,
     measure_snapshot_overhead: bool = False,
+    measure_host_path_overhead: bool = False,
 ) -> dict:
     """One service-level scenario: threads driving should_rate_limit through
     the micro-batched TPU backend. Per-stage timings come from the runtime
@@ -759,7 +806,12 @@ def bench_service(
     snapshot_overhead_pct / p99_snapshot_on_ms — the "no measurable p99
     regression" budget for the quiesce-and-copy design (the periodic
     device-side copy rides the stream; only the D2H drain and file write
-    run on the snapshot thread)."""
+    run on the snapshot thread).
+
+    measure_host_path_overhead: drive the same scenario once more with
+    HOST_FAST_PATH pinned off (legacy get_limit walk + per-object
+    do_limit) and record the legacy rate + host_path_overhead_pct — what
+    the pre-vectorization host path costs relative to the shipped one."""
     # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
     # parallel workers (test/redis/bench_test.go); oversubscribing a small
     # box measures queueing, not the service (8 threads on the 1-core bench
@@ -767,7 +819,9 @@ def bench_service(
     # coalescing in the batcher on any host.
     n_threads = max(4, os.cpu_count() or 1)
     per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
-    service, cache, store = _build_service(config_key, yaml_text, telemetry=True)
+    service, cache, store = _build_service(
+        config_key, yaml_text, telemetry=True, on_tpu=on_tpu
+    )
     reqs = _requests_for(config_key, 2048)
     decisions_per_request = len(reqs[0].descriptors)
 
@@ -791,6 +845,9 @@ def bench_service(
     }
     if stages:
         result["stages"] = stages
+    host_split = _host_split(store)
+    if host_split:
+        result["host_split"] = host_split
     readback = stages.get("readback_ms")
     if readback:
         # co-located estimate: the measured p99 minus the typical blocking
@@ -814,6 +871,24 @@ def bench_service(
         if rate_off > 0:
             result["telemetry_overhead_pct"] = round(
                 (1.0 - result["rate"] / rate_off) * 100.0, 2
+            )
+    if measure_host_path_overhead:
+        service_l, cache_l, _store_l = _build_service(
+            config_key, yaml_text, telemetry=True, on_tpu=on_tpu,
+            host_fast_path=False,
+        )
+        for r in reqs[:32]:
+            service_l.should_rate_limit(r)
+        total_l, elapsed_l, _lat_l = _drive_service(
+            service_l, reqs, n_threads, per_thread
+        )
+        cache_l.close()
+        rate_l = total_l * decisions_per_request / elapsed_l
+        result["rate_legacy_host_path"] = round(rate_l)
+        if result["rate"] > 0:
+            # how much of the shipped rate the legacy host path gives up
+            result["host_path_overhead_pct"] = round(
+                (1.0 - rate_l / result["rate"]) * 100.0, 2
             )
     if measure_snapshot_overhead:
         import tempfile
@@ -1512,6 +1587,11 @@ def main() -> None:
                 # the durability-cost A/B rides the same scenario: an
                 # aggressive 100ms snapshot cadence must not move p99
                 measure_snapshot_overhead=(
+                    key == "flat_per_second" and left() > 100
+                ),
+                # legacy-host-path A/B: records the vectorization win
+                # (host_path_overhead_pct) in every artifact
+                measure_host_path_overhead=(
                     key == "flat_per_second" and left() > 100
                 ),
             )

@@ -25,6 +25,12 @@ if not TPU_TESTS:
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 
+# Boot-time bucket-ladder precompile (TPU_PRECOMPILE, default on in
+# production) would add ~12 XLA compiles to EVERY Runner boot in the
+# suite; tests that pin the precompile behavior opt back in explicitly
+# (tests/test_hotpath.py).
+os.environ.setdefault("TPU_PRECOMPILE", "false")
+
 # The axon site package (PYTHONPATH=/root/.axon_site) force-sets
 # jax_platforms=axon,cpu at jax import, overriding the env var — tests must
 # run on the virtual 8-device CPU mesh, so override it back post-import.

@@ -1,0 +1,324 @@
+"""CompiledMatcher differential fuzz + hot-reload atomicity.
+
+The compiled matcher (config/compiled.py) is the hot path's view of the
+rule tree; the trie walker (RateLimitConfig.get_limit_tree) is the
+semantic oracle. The fuzz below drives both over randomized configs and
+descriptors — wildcards (bare keys), nesting, shadow mode, underscore
+aliasing (a bare config key "a_b" matches a request entry ("a", "b")),
+request-level overrides, repeated lookups (the memo-hit path), and a
+mid-stream hot-reload swap — and asserts identical resolution, plus the
+record invariants the zero-object pipeline leans on (prefix+window ==
+the string codec's key; fp == the slab fingerprint; divider == the unit
+divider).
+
+MATCHER_FUZZ_EXAMPLES scales the campaign (default 12000, the >=10k
+acceptance bar; idle-time campaigns crank it the way SLAB_FUZZ_EXAMPLES
+does for the slab suites).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+import pytest
+import yaml
+
+from api_ratelimit_tpu.config.loader import ConfigFile, load_config
+from api_ratelimit_tpu.limiter.cache_key import generate_cache_key
+from api_ratelimit_tpu.models.config import ConfigError
+from api_ratelimit_tpu.models.descriptors import Descriptor, Entry, LimitOverride
+from api_ratelimit_tpu.models.units import Unit, unit_to_divider
+from api_ratelimit_tpu.ops.hashing import fingerprint64
+from api_ratelimit_tpu.stats.sinks import NullSink
+from api_ratelimit_tpu.stats.store import Store
+
+N_EXAMPLES = int(os.environ.get("MATCHER_FUZZ_EXAMPLES", "12000"))
+
+# Small vocab with deliberate underscore hazards: composed-key aliasing
+# ("a" + "_" + "b" == bare key "a_b") is reference behavior the compiled
+# matcher must reproduce exactly.
+_KEYS = ["a", "b", "key1", "a_b", "k_", "x_y_z", "deep"]
+_VALUES = ["", "v", "1", "b", "a_b", "with_underscore", "y_z"]
+_UNITS = ["second", "minute", "hour", "day"]
+
+
+def _scope():
+    return Store(NullSink()).scope("rl")
+
+
+def _random_descriptor_config(rng: random.Random, depth: int) -> dict:
+    desc: dict = {"key": rng.choice(_KEYS)}
+    value = rng.choice(_VALUES)
+    if value:
+        desc["value"] = value
+    if rng.random() < 0.7:
+        rate_limit = {
+            "unit": rng.choice(_UNITS),
+            "requests_per_unit": rng.randrange(0, 50),
+        }
+        desc["rate_limit"] = rate_limit
+        if rng.random() < 0.2:
+            desc["shadow_mode"] = True
+        if rng.random() < 0.15:
+            desc["sleep_on_throttle"] = True
+        if rng.random() < 0.15:
+            desc["report_details"] = True
+    if depth > 0 and rng.random() < 0.6:
+        desc["descriptors"] = [
+            _random_descriptor_config(rng, depth - 1)
+            for _ in range(rng.randrange(1, 3))
+        ]
+    return desc
+
+
+def _random_config(rng: random.Random):
+    """One random loaded config, or None when the random tree tripped a
+    loader rule (duplicate composite keys are likely with a small vocab)."""
+    tree = {
+        "domain": rng.choice(["d1", "d2", "dom_x"]),
+        "descriptors": [
+            _random_descriptor_config(rng, 2)
+            for _ in range(rng.randrange(1, 4))
+        ],
+    }
+    try:
+        return load_config(
+            [ConfigFile(name="config.fuzz", contents=yaml.safe_dump(tree))],
+            _scope(),
+        )
+    except ConfigError:
+        return None
+
+
+def _random_request_descriptor(rng: random.Random) -> Descriptor:
+    entries = tuple(
+        Entry(rng.choice(_KEYS), rng.choice(_VALUES))
+        for _ in range(rng.randrange(1, 4))
+    )
+    limit = None
+    if rng.random() < 0.1:
+        limit = LimitOverride(
+            requests_per_unit=rng.randrange(0, 50),
+            unit=rng.choice(list(Unit)[1:]),  # skip UNKNOWN
+        )
+    return Descriptor(entries=entries, limit=limit)
+
+
+class TestDifferentialFuzz:
+    def test_compiled_matches_tree_walker(self):
+        rng = random.Random(1234)
+        configs = []
+        while len(configs) < 40:
+            cfg = _random_config(rng)
+            if cfg is not None:
+                configs.append(cfg)
+
+        checked = 0
+        while checked < N_EXAMPLES:
+            cfg = rng.choice(configs)
+            domain = rng.choice(["d1", "d2", "dom_x", "missing"])
+            descriptor = _random_request_descriptor(rng)
+            # twice: the first resolves through the walker, the second
+            # must hit the memo — both must agree with the oracle
+            for _ in range(2):
+                want = cfg.get_limit_tree(domain, descriptor)
+                record = cfg.compiled.resolve(domain, descriptor)
+                got = cfg.compiled.get_limit(domain, descriptor)
+                if descriptor.limit is None:
+                    # non-override resolution must return the tree's very
+                    # RateLimit object (stats identity across paths)
+                    assert got is want, (domain, descriptor)
+                else:
+                    if want is None:
+                        assert got is None, (domain, descriptor)
+                    else:
+                        assert got is not None
+                        assert got.full_key == want.full_key
+                        assert got.requests_per_unit == want.requests_per_unit
+                        assert got.unit == want.unit
+                if record is None:
+                    assert got is None
+                else:
+                    assert record.limit is got
+                    self._check_record_invariants(domain, descriptor, record)
+                checked += 1
+        assert checked >= N_EXAMPLES
+
+    @staticmethod
+    def _check_record_invariants(domain, descriptor, record):
+        limit = record.limit
+        assert record.divider == unit_to_divider(limit.unit)
+        assert record.requests_per_unit == limit.requests_per_unit
+        assert record.shadow_mode == limit.shadow_mode
+        assert record.sleep_on_throttle == limit.sleep_on_throttle
+        assert record.report_details == limit.report_details
+        assert record.fp == fingerprint64(
+            domain, descriptor.entries, record.divider
+        )
+        assert record.fp == (record.fp_hi << 32) | record.fp_lo
+        # prefix + window start == the string codec byte for byte
+        now = 987_654_321
+        window = (now // record.divider) * record.divider
+        assert record.key_prefix + str(window) == generate_cache_key(
+            domain, descriptor, limit, now
+        ).key
+
+    def test_agreement_across_hot_reload_swap(self):
+        """Mid-stream config swap: lookups against each generation must
+        agree with THAT generation's walker — the memo never leaks rules
+        across configs (a fresh matcher rides every reload)."""
+        rng = random.Random(99)
+        stream = [_random_request_descriptor(rng) for _ in range(200)]
+        for _ in range(20):
+            cfg_a, cfg_b = None, None
+            while cfg_a is None:
+                cfg_a = _random_config(rng)
+            while cfg_b is None:
+                cfg_b = _random_config(rng)
+            for descriptor in stream[: rng.randrange(20, 100)]:
+                assert cfg_a.compiled.get_limit("d1", descriptor) is cfg_a.get_limit_tree("d1", descriptor) or descriptor.limit is not None
+            # the swap: same descriptor stream, new generation
+            for descriptor in stream:
+                want = cfg_b.get_limit_tree("d1", descriptor)
+                got = cfg_b.compiled.get_limit("d1", descriptor)
+                if descriptor.limit is None:
+                    assert got is want
+
+
+@pytest.fixture
+def flip_service():
+    """A RateLimitService over the TPU cache whose runtime can flip
+    between two configs with the same rule path but different limits —
+    the hot-reload torn-read harness."""
+    from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+    from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+    from api_ratelimit_tpu.service.ratelimit import RateLimitService
+    from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+    config_a = """\
+domain: flip
+descriptors:
+  - key: k
+    rate_limit: {unit: minute, requests_per_unit: 1000}
+"""
+    config_b = """\
+domain: flip
+descriptors:
+  - key: k
+    rate_limit: {unit: hour, requests_per_unit: 2000}
+"""
+
+    class FlipRuntime:
+        def __init__(self):
+            self.which = config_a
+
+        def snapshot(self):
+            contents = self.which
+
+            class Snap:
+                def keys(self):
+                    return ["config.flip"]
+
+                def get(self, key):
+                    return contents
+
+            return Snap()
+
+        def add_update_callback(self, cb):
+            pass
+
+    runtime = FlipRuntime()
+    base = BaseRateLimiter(RealTimeSource())
+    cache = TpuRateLimitCache(
+        base,
+        n_slots=1 << 10,
+        batch_window_seconds=0.002,
+        buckets=(8, 128),
+        max_batch=128,
+        use_pallas=False,
+    )
+    store = Store(NullSink())
+    service = RateLimitService(
+        runtime=runtime,
+        cache=cache,
+        stats_scope=store.scope("ratelimit").scope("service"),
+        time_source=RealTimeSource(),
+    )
+    yield service, runtime, (config_a, config_b)
+    cache.close()
+
+
+class TestHotReloadAtomicity:
+    def test_no_torn_reads_no_dropped_requests_under_reload(self, flip_service):
+        """Sustained traffic while the config flips every few ms: every
+        response must be internally consistent with exactly ONE config
+        generation — (1000, MINUTE, reset<=60) or (2000, HOUR,
+        reset<=3600), never a hybrid — and every request must get an
+        answer (reloads never drop an in-flight batch)."""
+        from api_ratelimit_tpu.models.descriptors import RateLimitRequest
+        from api_ratelimit_tpu.models.response import Code
+
+        service, runtime, (config_a, config_b) = flip_service
+        request = RateLimitRequest(
+            domain="flip", descriptors=(Descriptor.of(("k", "v")),)
+        )
+        errors: list = []
+        answered = [0] * 4
+        torn: list = []
+        stop = threading.Event()
+
+        def worker(tid):
+            while not stop.is_set():
+                try:
+                    code, statuses, _headers = service.should_rate_limit(request)
+                except Exception as e:  # noqa: BLE001 - recorded, failed below
+                    errors.append(e)
+                    return
+                status = statuses[0]
+                assert code == Code.OK
+                cl = status.current_limit
+                pair = (cl.requests_per_unit, cl.unit)
+                if pair == (1000, Unit.MINUTE):
+                    if status.duration_until_reset > 60:
+                        torn.append((pair, status.duration_until_reset))
+                elif pair == (2000, Unit.HOUR):
+                    if status.duration_until_reset > 3600:
+                        torn.append((pair, status.duration_until_reset))
+                else:
+                    torn.append((pair, status.duration_until_reset))
+                answered[tid] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(tid,)) for tid in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for i in range(60):
+            runtime.which = config_b if i % 2 == 0 else config_a
+            service.reload_config()
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        assert not errors, errors[:3]
+        assert not torn, torn[:5]
+        assert all(count > 0 for count in answered), answered
+
+    def test_reload_swaps_matcher_generation(self, flip_service):
+        """After a reload, the served limit is the new generation's —
+        and the old generation's memoized records are unreachable."""
+        from api_ratelimit_tpu.models.descriptors import RateLimitRequest
+
+        service, runtime, (config_a, config_b) = flip_service
+        request = RateLimitRequest(
+            domain="flip", descriptors=(Descriptor.of(("k", "v")),)
+        )
+        _code, statuses, _ = service.should_rate_limit(request)
+        assert statuses[0].current_limit.requests_per_unit == 1000
+        old = service.get_current_config()
+        runtime.which = config_b
+        service.reload_config()
+        assert service.get_current_config() is not old
+        _code, statuses, _ = service.should_rate_limit(request)
+        assert statuses[0].current_limit.requests_per_unit == 2000

@@ -84,6 +84,33 @@ class TestSettings:
         assert s.tpu_mesh_devices == 4
         assert s.tpu_use_pallas is False
 
+    def test_hotpath_knobs(self):
+        s = new_settings(
+            {
+                "TPU_PRECOMPILE": "false",
+                "TPU_BUCKETS": "16,256,4096",
+                "HOST_FAST_PATH": "false",
+            }
+        )
+        assert s.tpu_precompile is False
+        assert s.tpu_buckets == "16,256,4096"
+        assert s.buckets() == (16, 256, 4096)
+        assert s.host_fast_path is False
+
+    def test_hotpath_defaults(self):
+        s = Settings()
+        assert s.tpu_precompile is True
+        assert s.host_fast_path is True
+        assert s.buckets() is None  # engine default ladder
+
+    def test_buckets_junk_fails_boot(self):
+        for junk in ("abc", "128,xyz", "0", "-8,128", ","):
+            with pytest.raises(ValueError, match="TPU_BUCKETS"):
+                new_settings({"TPU_BUCKETS": junk}).buckets()
+
+    def test_buckets_sorted(self):
+        assert new_settings({"TPU_BUCKETS": "4096,16"}).buckets() == (16, 4096)
+
     def test_dataclass_is_plain(self):
         assert Settings().port == 8080
 

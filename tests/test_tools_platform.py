@@ -45,3 +45,32 @@ def test_tool_runs_on_cpu_when_pinned(mod, extra):
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
     assert lines, proc.stdout[-300:]
     assert json.loads(lines[-1])["platform"] == "cpu"
+
+
+class TestHotpathProfile:
+    """tools/hotpath_profile.py smoke (tier-1, not slow): it must run the
+    flat_per_second loop under cProfile and emit a parseable table."""
+
+    def test_runs_and_parses(self):
+        proc = _run_tool(
+            "tools.hotpath_profile", ("-n", "120", "--top", "6")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        lines = proc.stdout.splitlines()
+        summary = [ln for ln in lines if ln.startswith("[hotpath] rate=")]
+        assert summary, proc.stdout[-300:]
+        # summary parses: rate=<int>/s requests=<int>
+        rate_field = summary[0].split()[1]
+        assert rate_field.startswith("rate=") and rate_field.endswith("/s")
+        assert int(rate_field[len("rate="):-len("/s")]) > 0
+        header = [ln for ln in lines if "ncalls" in ln and "tottime" in ln]
+        assert header, "pstats table header missing"
+        # at least one profiled row mentions the service hot path
+        assert any("should_rate_limit" in ln for ln in lines)
+
+    def test_legacy_arm_runs(self):
+        proc = _run_tool(
+            "tools.hotpath_profile", ("-n", "60", "--top", "4", "--legacy")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "path=legacy" in proc.stdout

@@ -1,0 +1,117 @@
+"""Host-path profiler: cProfile over the flat_per_second request loop.
+
+Answers "where does the host half of a should_rate_limit go?" with the
+exact service stack bench.py's flat_per_second tier builds (same config,
+same TPU-slab backend, same batch window), driven from ONE thread under
+cProfile and printed as a top-N cumulative table:
+
+    python -m tools.hotpath_profile                 # 2000 requests, top 25
+    python -m tools.hotpath_profile -n 500 --top 10 --sort tottime
+    python -m tools.hotpath_profile --legacy        # pin the pre-vectorization path
+    make profile
+
+Single-thread on purpose: cProfile instruments only the calling thread,
+so the dispatcher/device threads show up as one honest
+`lock.acquire` line (the time THIS thread spends waiting on the launch
+round trip) instead of half-attributed noise. Use `--pyinstrument` for a
+wall-clock sampling view when that package is installed.
+
+Output contract (pinned by tests/test_tools_platform.py): a
+`[hotpath] rate=<N>/s requests=<N>` summary line, then the standard
+pstats table whose header row contains `ncalls  tottime`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("-n", type=int, default=2000, help="requests to drive")
+    parser.add_argument("--top", type=int, default=25, help="rows to print")
+    parser.add_argument(
+        "--sort",
+        default="cumulative",
+        choices=["cumulative", "tottime", "ncalls"],
+    )
+    parser.add_argument(
+        "--legacy",
+        action="store_true",
+        help="pin the legacy per-object host path (the A/B arm)",
+    )
+    parser.add_argument(
+        "--pyinstrument",
+        action="store_true",
+        help="wall-clock sampling profile instead of cProfile",
+    )
+    args = parser.parse_args(argv)
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    service, cache, _store = bench._build_service(
+        "flat_per_second",
+        bench._FLAT,
+        telemetry=True,
+        host_fast_path=not args.legacy,
+    )
+    reqs = bench._requests_for("flat_per_second", 2048)
+    # warmup: compile/prime outside the profiled region
+    for request in reqs[:64]:
+        service.should_rate_limit(request)
+
+    try:
+        if args.pyinstrument:
+            return _run_pyinstrument(service, reqs, args)
+        prof = cProfile.Profile()
+        t0 = time.perf_counter()
+        prof.enable()
+        for i in range(args.n):
+            service.should_rate_limit(reqs[i % len(reqs)])
+        prof.disable()
+        elapsed = time.perf_counter() - t0
+        print(
+            f"[hotpath] rate={round(args.n / elapsed)}/s requests={args.n} "
+            f"path={'legacy' if args.legacy else 'fast'}"
+        )
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats(args.sort).print_stats(args.top)
+        print(out.getvalue())
+        return 0
+    finally:
+        cache.close()
+
+
+def _run_pyinstrument(service, reqs, args) -> int:
+    try:
+        from pyinstrument import Profiler
+    except ImportError:
+        print(
+            "[hotpath] pyinstrument is not installed in this environment; "
+            "re-run without --pyinstrument",
+            file=sys.stderr,
+        )
+        return 2
+    profiler = Profiler()
+    t0 = time.perf_counter()
+    with profiler:
+        for i in range(args.n):
+            service.should_rate_limit(reqs[i % len(reqs)])
+    elapsed = time.perf_counter() - t0
+    print(f"[hotpath] rate={round(args.n / elapsed)}/s requests={args.n}")
+    print(profiler.output_text(unicode=True, color=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
