@@ -28,8 +28,11 @@ proto:
 
 # Unit + hermetic integration tests on a virtual 8-device CPU mesh
 # (tests/conftest.py forces JAX_PLATFORMS=cpu; the reference's equivalent
-# is `go test -race ./...`, Makefile:83-85).
-tests_unit:
+# is `go test -race ./...`, Makefile:83-85). The native codec builds
+# FIRST so the suite exercises the real pack/scatter/fingerprint path —
+# tests/test_native.py then asserts availability, so a broken build fails
+# the tier instead of silently riding the pure-Python fallback.
+tests_unit: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # The multi-second bench-subprocess tests (artifact discipline): isolated

@@ -112,6 +112,7 @@ class SlabDeviceEngine:
         overload=None,
         fault_injector=None,
         precompile: bool = False,
+        dispatch_loop: bool = True,
     ):
         """scope: optional stats Scope rooted at the service prefix (e.g.
         the runner's `ratelimit` scope). When set, the engine records the
@@ -127,6 +128,14 @@ class SlabDeviceEngine:
         max_queue / overload / fault_injector are forwarded to the
         micro-batcher (bounded queue + brownout shedding + the
         batcher.submit chaos site; backends/batcher.py).
+
+        dispatch_loop: windowed mode only — run the persistent device-owner
+        dispatch loop (backends/dispatch.py): one thread owns every launch
+        AND readback, fed by per-frontend-thread submit rings, with two
+        batches double-buffered in flight. False (DISPATCH_LOOP=false)
+        falls back to the leader-collects micro-batcher — the rollback
+        arm, same contract HOST_FAST_PATH set. Direct mode (window 0)
+        ignores this knob.
 
         watermark_high / watermark_critical: slab-occupancy watermarks in
         (0, 1]; 0 disables. Evaluated on the health_snapshot (stats-flush)
@@ -228,9 +237,36 @@ class SlabDeviceEngine:
         # thread-local scratch, which the ring copies out of under the
         # enqueue lock (one slot per descriptor).
         self._block_batcher = bool(block_mode)
+        # Padded-operand reuse (single device only): per-bucket ping-pong
+        # pairs the launch path packs into instead of allocating fresh
+        # zeros every launch. Safe because every launch arm bounds
+        # un-redeemed launches to 2 (the dispatch loop's double buffer,
+        # the batcher's max_inflight semaphore, direct mode's full
+        # serialization), so a buffer is only rewritten after the launch
+        # 2-back has finished executing — its input can no longer be read
+        # even if XLA aliased the host memory. Padding correctness: only
+        # the hits row gates device writes (ops/slab.py), so the fill path
+        # zeroes packed[2, n:] and leaves the other rows' stale lanes
+        # alone.
+        self._reuse_operands = self._engine is None
+        self._operand_pool: dict = {}
+        self._operand_lock = threading.Lock()
+        # native row-block gather (rl_pack_rows) for the pack stage; None
+        # keeps the numpy per-block copy loop (pure-Python fallback)
+        try:
+            from ..ops import native as _native
+
+            self._pack_rows = _native.pack_rows if _native.available() else None
+        except Exception:  # noqa: BLE001 - codec is strictly optional
+            self._pack_rows = None
+        self._dispatch = None
+        use_loop = bool(dispatch_loop) and batch_window_seconds > 0
         self._batcher = MicroBatcher(
             self._execute_blocks,
-            window_seconds=batch_window_seconds,
+            # with the dispatch loop active the batcher serves only as the
+            # direct-mode fallback for legacy single-shot launches
+            # (_launch, tools); its dispatcher thread would sit idle
+            window_seconds=0.0 if use_loop else batch_window_seconds,
             max_batch=max_batch,
             execute_launch=self._execute_blocks_launch,
             execute_collect=self._execute_blocks_collect,
@@ -241,6 +277,20 @@ class SlabDeviceEngine:
             fault_injector=fault_injector,
             arena_rows=0 if block_mode else min(2 * int(max_batch), 1 << 17),
         )
+        if use_loop:
+            from .dispatch import DispatchLoop
+
+            self._dispatch = DispatchLoop(
+                self._execute_blocks_launch,
+                self._execute_blocks_collect,
+                ready=self._launch_ready,
+                window_seconds=batch_window_seconds,
+                max_batch=max_batch,
+                scope=scope,
+                overload=overload,
+                fault_injector=fault_injector,
+                max_queue=max_queue,
+            )
         # (bucket, readback dtype name) -> True for every launch shape
         # compiled ahead of traffic; the health/readiness test asserts the
         # ladder is covered before the server reports healthy.
@@ -395,6 +445,10 @@ class SlabDeviceEngine:
         if not items:
             return []
         self._check_saturated()
+        if self._dispatch is not None:
+            return self._dispatch.submit(
+                _items_to_block(items), owned=True, reuse_out=True
+            ).tolist()
         return self._batcher.submit(_items_to_block(items)).tolist()
 
     def submit_rows(self, block: np.ndarray) -> np.ndarray:
@@ -406,21 +460,34 @@ class SlabDeviceEngine:
         if block.shape[1] == 0:
             return np.empty(0, dtype=np.uint32)
         self._check_saturated()
+        if self._dispatch is not None:
+            # ring path: the frame is copied into this thread's submit
+            # ring, and the verdicts come back in this thread's reusable
+            # ticket buffer (valid until its next submit — the row path
+            # consumes them immediately)
+            return self._dispatch.submit(block, reuse_out=True)
         if not self._batcher.consumes_submits:
             block = np.array(block, dtype=np.uint32)
         return self._batcher.submit(block)
 
     def flush(self) -> None:
+        if self._dispatch is not None:
+            self._dispatch.flush()
         self._batcher.flush()
 
     def drain(self) -> None:
         """Graceful-drain quiesce: refuse new submits, finish everything
-        already queued (batcher drain). The warm-restart snapshotter calls
-        this before its final snapshot so a planned restart hands over
-        every admitted decision (persist/snapshotter.py)."""
+        already queued (dispatch rings and/or batcher). The warm-restart
+        snapshotter calls this before its final snapshot so a planned
+        restart hands over every admitted decision
+        (persist/snapshotter.py)."""
+        if self._dispatch is not None:
+            self._dispatch.drain()
         self._batcher.drain()
 
     def close(self) -> None:
+        if self._dispatch is not None:
+            self._dispatch.close()
         self._batcher.close()
 
     # -- warm restart (persist/): per-shard slab export/import --
@@ -551,6 +618,18 @@ class SlabDeviceEngine:
             self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
         return after_dev, n
 
+    def _launch_ready(self, tokens) -> bool:
+        """Non-blocking readiness probe for a launch token (the dispatch
+        loop's overlap decision): True once every chunk's device result
+        has materialized. Payloads without is_ready (mesh tokens, numpy
+        results from the XLA twin) count as ready — the probe must only
+        ever err toward redeeming."""
+        for payload, _n in tokens:
+            probe = getattr(payload, "is_ready", None)
+            if probe is not None and not probe():
+                return False
+        return True
+
     def _collect_array(self, token) -> np.ndarray:
         """Blocking readback of one launch token. readback_ms covers the
         wait for device completion plus the D2H drain — the stage a slow
@@ -591,24 +670,56 @@ class SlabDeviceEngine:
         if not self._block_batcher:
             raise RuntimeError("engine not in block_mode")
         self._check_saturated()
+        if self._dispatch is not None:
+            # wire blocks are one-shot buffers: hand ownership to the ring
+            # (no arena copy); results are owned arrays (the server may
+            # serialize them after this thread's next frame)
+            return self._dispatch.submit(block, owned=True)
         return self._batcher.submit(block)
+
+    def _packed_operand(self, size: int) -> np.ndarray:
+        """A (7, size) uint32 launch operand. Single-device engines reuse a
+        per-bucket ping-pong pair (every launch arm bounds un-redeemed
+        launches to 2, so the buffer handed out is never still readable by
+        an in-flight execute); callers must zero the hits-row padding
+        after filling. Mesh engines get fresh zeros (their host-side
+        owner routing may hold the operand past launch return)."""
+        if not self._reuse_operands:
+            return np.zeros((7, size), dtype=np.uint32)
+        with self._operand_lock:
+            pair = self._operand_pool.get(size)
+            if pair is None:
+                pair = self._operand_pool[size] = [
+                    np.zeros((7, size), dtype=np.uint32),
+                    np.zeros((7, size), dtype=np.uint32),
+                    0,
+                ]
+            buf = pair[pair[2]]
+            pair[2] ^= 1
+        return buf
 
     def _iter_block_chunks(self, blocks: list[np.ndarray]):
         """Yield (packed[7, bucket], n, cap) per max_bucket chunk of the
-        submitted blocks. The common case (total fits one launch) copies
-        each block's columns straight into the padded device block — one
-        copy per byte; only an oversized aggregate pays a concatenate
+        submitted blocks. The common case (total fits one launch) gathers
+        each block's columns straight into the padded device block — the
+        native codec's rl_pack_rows when built, one numpy row copy per
+        block otherwise; only an oversized aggregate pays a concatenate
         first. The cap bound uses max(limit)+max(hits) over the chunk — at
         least as wide as the per-item max the item path computes, so the
         saturating readback stays exact."""
         total = sum(b.shape[1] for b in blocks)
         if total <= self._max_bucket:
             size = self._bucket_for(total)
-            packed = np.zeros((7, size), dtype=np.uint32)
-            off = 0
-            for b in blocks:
-                packed[:6, off : off + b.shape[1]] = b
-                off += b.shape[1]
+            packed = self._packed_operand(size)
+            if self._pack_rows is not None and len(blocks) > 1:
+                self._pack_rows(blocks, packed, total)
+            else:
+                off = 0
+                for b in blocks:
+                    packed[:6, off : off + b.shape[1]] = b
+                    off += b.shape[1]
+            # padding lanes: hits == 0 is the only gate the device reads
+            packed[2, total:] = 0
             chunks = [(packed, total)]
         else:
             cat = np.concatenate(blocks, axis=1)
@@ -765,6 +876,7 @@ class TpuRateLimitCache:
         overload=None,
         fault_injector=None,
         precompile: bool = False,
+        dispatch_loop: bool = True,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -807,6 +919,7 @@ class TpuRateLimitCache:
                 overload=overload,
                 fault_injector=fault_injector,
                 precompile=precompile,
+                dispatch_loop=dispatch_loop,
             )
         self._engine_core = engine
         # zero-object row verb when the engine has one (the in-process

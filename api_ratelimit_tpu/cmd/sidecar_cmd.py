@@ -56,6 +56,25 @@ def main() -> None:
 
     respect_jax_platforms_env()
 
+    # Surface the native codec state before the engine builds (runner.py
+    # rationale): the device owner's pack/scatter hot path must not ride
+    # the pure-Python fallback silently.
+    from ..ops import native
+
+    native_info = native.build_info()
+    scope.scope("native").gauge("available").set(
+        1 if native_info["available"] else 0
+    )
+    if native_info["available"]:
+        logger.info("native host codec loaded: %s", native_info["so_path"])
+    else:
+        logger.warning(
+            "native host codec UNAVAILABLE (so=%s, source_present=%s): "
+            "pack/scatter run on the pure-Python fallback",
+            native_info["so_path"],
+            native_info["source_present"],
+        )
+
     mesh = None
     if settings.tpu_mesh_devices > 1:
         import jax
@@ -120,6 +139,11 @@ def main() -> None:
         # the device owner must never spend a frontend's RPC deadline on
         # a first-touch XLA compile
         precompile=settings.tpu_precompile,
+        # the device-owner dispatch loop (backends/dispatch.py): the
+        # sidecar IS the deployment shape it was built for — frontends'
+        # wire frames coalesce in the rings while one thread owns every
+        # launch; DISPATCH_LOOP=false falls back to leader-collects
+        dispatch_loop=settings.dispatch_loop,
         **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))

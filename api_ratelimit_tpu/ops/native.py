@@ -57,6 +57,15 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rl_compose_keys.argtypes = [
         u8p, u64p, u64p, i64p, ctypes.c_uint64, u8p, ctypes.c_uint64, u64p,
     ]
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    lib.rl_pack_rows.restype = None
+    lib.rl_pack_rows.argtypes = [
+        vpp, u64p, u64p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.rl_scatter_rows.restype = None
+    lib.rl_scatter_rows.argtypes = [
+        ctypes.c_void_p, u64p, ctypes.c_uint64, vpp,
+    ]
     return lib
 
 
@@ -111,6 +120,18 @@ def lib() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return lib() is not None
+
+
+def build_info() -> dict:
+    """Boot-time surfacing of the codec state (runner/sidecar log this and
+    export the `native.available` gauge so the pure-Python fallback can
+    never silently eat the dispatch-path win): whether the library loaded,
+    where it was expected, and whether the source is present to build."""
+    return {
+        "available": available(),
+        "so_path": _SO_PATH,
+        "source_present": os.path.exists(_SRC),
+    }
 
 
 def _as_u8p(arr: np.ndarray):
@@ -190,6 +211,43 @@ def fingerprint_batch(records, seeds) -> np.ndarray:
         _as_u64p(out),
     )
     return out
+
+
+def pack_rows(blocks, dst: np.ndarray, total: int) -> None:
+    """Row-block gather (dispatch hot path): copy the uint32[6, n_i]
+    `blocks` side by side into the first 6 rows of the padded launch
+    operand `dst` (uint32[7, dst_cols] C-order). Blocks may be column
+    slices of a wider arena — each block's row stride travels with it.
+    `total` is sum(n_i) (bounds-checked here; the C side trusts it).
+    Callers fall back to the numpy per-block copy loop when `available()`
+    is False."""
+    native = lib()
+    n = len(blocks)
+    if total > dst.shape[1]:
+        raise ValueError(f"{total} rows exceed operand width {dst.shape[1]}")
+    srcs = (ctypes.c_void_p * n)(*[b.ctypes.data for b in blocks])
+    counts = np.fromiter((b.shape[1] for b in blocks), dtype=np.uint64, count=n)
+    strides = np.fromiter(
+        (b.strides[0] // 4 for b in blocks), dtype=np.uint64, count=n
+    )
+    native.rl_pack_rows(
+        srcs, _as_u64p(counts), _as_u64p(strides), n,
+        dst.ctypes.data, dst.shape[1],
+    )
+
+
+def scatter_rows(src: np.ndarray, dsts, counts) -> None:
+    """Verdict scatter (dispatch redeem path): split the uint32[n] counter
+    array `src` into the per-ticket uint32 buffers `dsts` (dsts[i] takes
+    counts[i] leading values). Inverse of pack_rows; numpy slice-copy is
+    the fallback."""
+    native = lib()
+    n = len(dsts)
+    counts_arr = np.asarray(counts, dtype=np.uint64)
+    if int(counts_arr.sum()) > src.shape[0]:
+        raise ValueError("scatter counts exceed source length")
+    ptrs = (ctypes.c_void_p * n)(*[d.ctypes.data for d in dsts])
+    native.rl_scatter_rows(src.ctypes.data, _as_u64p(counts_arr), n, ptrs)
 
 
 def compose_keys_batch(records, window_starts) -> list[str]:

@@ -131,6 +131,7 @@ def create_limiter(
             # the bucket ladder compiles BEFORE the server reports
             # healthy: no request ever rides a first-touch XLA compile
             precompile=settings.tpu_precompile,
+            dispatch_loop=settings.dispatch_loop,
             **kwargs,
         )
     if backend == "tpu-sidecar":
@@ -215,10 +216,24 @@ class Runner:
         # compile, up to ~2min) and the redis/memcache/memory backends would
         # otherwise pay it inside the first large request, blowing upstream
         # gRPC deadlines. The TPU backend prewarms in its own constructor too;
-        # available() memoizes so the second call is free.
+        # available() memoizes so the second call is free. The build result
+        # is surfaced loudly (log + ratelimit.native.available gauge) so the
+        # pure-Python fallback can never silently eat the dispatch-path win.
         from .ops import native
 
-        native.available()
+        info = native.build_info()
+        self.scope.scope("native").gauge("available").set(
+            1 if info["available"] else 0
+        )
+        if info["available"]:
+            logger.info("native host codec loaded: %s", info["so_path"])
+        else:
+            logger.warning(
+                "native host codec UNAVAILABLE (so=%s, source_present=%s): "
+                "fingerprint/pack/scatter run on the pure-Python fallback",
+                info["so_path"],
+                info["source_present"],
+            )
 
         local_cache = None
         if settings.local_cache_size_in_bytes > 0:

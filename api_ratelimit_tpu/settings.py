@@ -112,6 +112,13 @@ class Settings:
     # zero-object host pipeline (compiled matcher -> row-block submit);
     # false pins the legacy per-object path — the rollback knob
     host_fast_path: bool = True
+    # persistent device-owner dispatch loop (backends/dispatch.py): one
+    # thread owns every launch AND readback, fed by per-frontend-thread
+    # submit rings, two batches double-buffered in flight. false falls
+    # back to the leader-collects micro-batcher — the rollback arm, same
+    # contract HOST_FAST_PATH set. Windowed mode only (TPU_BATCH_WINDOW
+    # > 0); direct mode ignores it.
+    dispatch_loop: bool = True
     # BACKEND_TYPE=tpu-sidecar: address of the device-owner process
     # (cmd/sidecar_cmd.py) — a unix socket path for same-host frontends, or
     # tcp://host:port / tls://host:port for frontends on other hosts (the
@@ -374,6 +381,7 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_precompile", "TPU_PRECOMPILE", _parse_bool),
     ("tpu_buckets", "TPU_BUCKETS", str),
     ("host_fast_path", "HOST_FAST_PATH", _parse_bool),
+    ("dispatch_loop", "DISPATCH_LOOP", _parse_bool),
     ("sidecar_socket", "SIDECAR_SOCKET", str),
     ("sidecar_socket_mode", "SIDECAR_SOCKET_MODE", lambda raw: int(raw, 8)),
     ("sidecar_tls_cert", "SIDECAR_TLS_CERT", str),

@@ -30,10 +30,19 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
     sidecar.dial            client: each dial of the sidecar address
     sidecar.submit          client: each SUBMIT attempt (before the send)
     sidecar.server.submit   server: each SUBMIT frame (before the engine)
-    batcher.submit          micro-batcher: each submit before enqueue —
-                            delay_ms stalls the caller (a wedged queue),
-                            queue_full raises QueueFullError so chaos tests
-                            rehearse overload shedding deterministically
+    batcher.submit          micro-batcher AND dispatch-loop: each submit
+                            before enqueue (the site is shared so one spec
+                            rehearses both DISPATCH_LOOP arms) — delay_ms
+                            stalls the caller (a wedged queue), queue_full
+                            raises QueueFullError so chaos tests rehearse
+                            overload shedding deterministically
+    dispatch.launch         dispatch loop (backends/dispatch.py): fires on
+                            the device-OWNER thread before each launch —
+                            delay_ms models a stalled device owner (queue
+                            wait grows, the brownout machinery reacts),
+                            error fails the whole batch's tickets with
+                            CacheError so the breaker/fallback ladder
+                            answers
     snapshot.write          warm-restart snapshotter: each shard-file write
                             (persist/snapshot.py) — error fails the write,
                             torn_write truncates the payload mid-row,

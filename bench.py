@@ -683,6 +683,33 @@ _HOST_STAGE_HISTOGRAMS = (
     ("response_ns", "ratelimit.host.response_ms"),
 )
 
+# The device-owner dispatch loop's per-cycle stages (DISPATCH_LOOP on),
+# in NANOSECONDS: publish -> take ring wait, frame gather into the padded
+# operand, async launch dispatch, blocking readback + verdict scatter.
+# Same runtime histograms GET /metrics renders (backends/dispatch.py).
+_DISPATCH_STAGE_HISTOGRAMS = (
+    ("ring_wait_ns", "ratelimit.dispatch.ring_wait_ms"),
+    ("pack_ns", "ratelimit.device.pack_ms"),
+    ("launch_ns", "ratelimit.dispatch.launch_ms"),
+    ("redeem_ns", "ratelimit.dispatch.redeem_ms"),
+)
+
+
+def _dispatch_split(store) -> dict:
+    """Per-stage count/p50/p99 (ns) for the dispatch loop's owner cycle,
+    from the runtime histograms recorded during the timed drive."""
+    hists = store.metrics_snapshot()["histograms"]
+    out = {}
+    for short, name in _DISPATCH_STAGE_HISTOGRAMS:
+        h = hists.get(name)
+        if h and h["count"]:
+            out[short] = {
+                "count": h["count"],
+                "p50": round(h["p50"] * 1e6),
+                "p99": round(h["p99"] * 1e6),
+            }
+    return out
+
 
 def _host_split(store) -> dict:
     """Per-request host-stage count/p50/p99 (ns) from the runtime
@@ -724,11 +751,14 @@ def _build_service(
     telemetry: bool,
     on_tpu: bool = False,
     host_fast_path: bool = True,
+    dispatch_loop: bool = True,
 ):
     """One service stack for a scenario; telemetry=False builds the same
     stack with no stats scope on the backend (the A/B for recording
     overhead); host_fast_path=False pins the legacy per-object host path
-    (the host_path_overhead_pct A/B arm). Returns (service, cache, store)."""
+    (the host_path_overhead_pct A/B arm); dispatch_loop=False pins the
+    leader-collects batcher (the dispatch_loop_overhead_pct A/B arm).
+    Returns (service, cache, store)."""
     import random
 
     from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
@@ -772,6 +802,7 @@ def _build_service(
         # TPU_PRECOMPILE posture; first-touch compiles otherwise ride the
         # warmup's tail and pollute the first timed samples)
         precompile=True,
+        dispatch_loop=dispatch_loop,
     )
     service = RateLimitService(
         runtime=_StaticRuntime(yaml_text),
@@ -790,6 +821,7 @@ def bench_service(
     measure_telemetry_overhead: bool = False,
     measure_snapshot_overhead: bool = False,
     measure_host_path_overhead: bool = False,
+    measure_dispatch_overhead: bool = False,
 ) -> dict:
     """One service-level scenario: threads driving should_rate_limit through
     the micro-batched TPU backend. Per-stage timings come from the runtime
@@ -811,7 +843,12 @@ def bench_service(
     measure_host_path_overhead: drive the same scenario once more with
     HOST_FAST_PATH pinned off (legacy get_limit walk + per-object
     do_limit) and record the legacy rate + host_path_overhead_pct — what
-    the pre-vectorization host path costs relative to the shipped one."""
+    the pre-vectorization host path costs relative to the shipped one.
+
+    measure_dispatch_overhead: drive the same scenario once more with
+    DISPATCH_LOOP pinned off (leader-collects batcher, the rollback arm)
+    and record rate_leader_collects + dispatch_loop_overhead_pct — what
+    the pre-loop dispatch path gives up relative to the shipped one."""
     # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
     # parallel workers (test/redis/bench_test.go); oversubscribing a small
     # box measures queueing, not the service (8 threads on the 1-core bench
@@ -848,6 +885,9 @@ def bench_service(
     host_split = _host_split(store)
     if host_split:
         result["host_split"] = host_split
+    dispatch_split = _dispatch_split(store)
+    if dispatch_split:
+        result["dispatch_split"] = dispatch_split
     readback = stages.get("readback_ms")
     if readback:
         # co-located estimate: the measured p99 minus the typical blocking
@@ -889,6 +929,27 @@ def bench_service(
             # how much of the shipped rate the legacy host path gives up
             result["host_path_overhead_pct"] = round(
                 (1.0 - rate_l / result["rate"]) * 100.0, 2
+            )
+    if measure_dispatch_overhead:
+        service_d, cache_d, _store_d = _build_service(
+            config_key, yaml_text, telemetry=True, on_tpu=on_tpu,
+            dispatch_loop=False,
+        )
+        for r in reqs[:32]:
+            service_d.should_rate_limit(r)
+        total_d, elapsed_d, lat_d = _drive_service(
+            service_d, reqs, n_threads, per_thread
+        )
+        cache_d.close()
+        rate_d = total_d * decisions_per_request / elapsed_d
+        result["rate_leader_collects"] = round(rate_d)
+        result["p99_leader_collects_ms"] = round(
+            float(np.percentile(lat_d, 99)), 3
+        )
+        if result["rate"] > 0:
+            # how much of the shipped rate the pre-loop dispatch gives up
+            result["dispatch_loop_overhead_pct"] = round(
+                (1.0 - rate_d / result["rate"]) * 100.0, 2
             )
     if measure_snapshot_overhead:
         import tempfile
@@ -1592,6 +1653,11 @@ def main() -> None:
                 # legacy-host-path A/B: records the vectorization win
                 # (host_path_overhead_pct) in every artifact
                 measure_host_path_overhead=(
+                    key == "flat_per_second" and left() > 100
+                ),
+                # leader-collects A/B: records the dispatch-loop win
+                # (dispatch_loop_overhead_pct) in every artifact
+                measure_dispatch_overhead=(
                     key == "flat_per_second" and left() > 100
                 ),
             )

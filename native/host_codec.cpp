@@ -151,6 +151,43 @@ void rl_fingerprint_batch(const uint8_t* blob, const uint64_t* str_off,
   }
 }
 
+// Row-block gather: copy n_blocks uint32[6, counts[i]] column blocks side
+// by side into the padded launch operand `dst` (the first 6 rows of the
+// uint32[7, dst_cols] C-order device block; row 7 and the padding lanes
+// are the caller's). Block i's row r starts at srcs[i] + r * strides[i]
+// (in elements) — blocks may be column slices of a wider ring arena, so
+// the row stride is per block, not counts[i]. One call replaces the
+// per-block Python copy loop in front of every launch — the dispatch
+// loop's pack stage.
+void rl_pack_rows(const uint32_t* const* srcs, const uint64_t* counts,
+                  const uint64_t* strides, uint64_t n_blocks, uint32_t* dst,
+                  uint64_t dst_cols) {
+  uint64_t off = 0;
+  for (uint64_t i = 0; i < n_blocks; ++i) {
+    const uint32_t* src = srcs[i];
+    const uint64_t n = counts[i];
+    const uint64_t stride = strides[i];
+    for (uint64_t r = 0; r < 6; ++r)
+      std::memcpy(dst + r * dst_cols + off, src + r * stride,
+                  n * sizeof(uint32_t));
+    off += n;
+  }
+}
+
+// Verdict scatter: split one uint32[n] post-increment counter array back
+// into per-ticket output buffers (dsts[i] receives counts[i] values).
+// The inverse of rl_pack_rows on the readback path: one call per redeem
+// instead of one numpy slice-copy per parked ticket.
+void rl_scatter_rows(const uint32_t* src, const uint64_t* counts,
+                     uint64_t n_out, uint32_t* const* dsts) {
+  uint64_t off = 0;
+  for (uint64_t i = 0; i < n_out; ++i) {
+    const uint64_t n = counts[i];
+    std::memcpy(dsts[i], src + off, n * sizeof(uint32_t));
+    off += n;
+  }
+}
+
 // Batched fixed-window cache-key composition (cache_key.go:43-73 layout):
 //   "<domain>_<k1>_<v1>_..._<window_start>"
 // Same record framing as rl_fingerprint_batch; window_starts[i] is the

@@ -1,0 +1,565 @@
+"""Persistent device-owner dispatch loop (backends/dispatch.py): submit-ring
+mechanics, double-buffered launch overlap, drain/close with tickets parked
+in both in-flight buffers, deadline drops at ring take time, overload
+parity with the leader-collects arm, and the dispatch.launch chaos site.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.dispatch import (
+    FAULT_SITE_LAUNCH,
+    DispatchLoop,
+    SubmitRing,
+    _Ticket,
+)
+from api_ratelimit_tpu.backends.overload import (
+    AdmissionController,
+    BrownoutError,
+    QueueFullError,
+)
+from api_ratelimit_tpu.limiter.cache import CacheError, DeadlineExceededError
+from api_ratelimit_tpu.utils import FakeTimeSource
+from api_ratelimit_tpu.utils.deadline import deadline_scope
+
+
+def test_native_codec_must_load_when_toolchain_present():
+    """Build hygiene gate: on a host WITH a g++ toolchain (every CI/dev
+    image — `make tests_unit` builds it first) the native codec MUST be
+    available. A silently broken build would put the dispatch loop's
+    pack/scatter on the pure-Python fallback with no signal; this test is
+    the signal. Hosts without the toolchain legitimately fall back."""
+    import shutil
+
+    from api_ratelimit_tpu.ops import native
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain: the pure-Python fallback is expected")
+    info = native.build_info()
+    assert info["source_present"], "native/host_codec.cpp missing"
+    assert info["available"], (
+        f"g++ present but native codec failed to build/load "
+        f"(so={info['so_path']})"
+    )
+
+
+def _block(values, rows=6):
+    """uint32[6, n] block whose hits row carries `values` (easy to assert
+    through fake executors)."""
+    n = len(values)
+    block = np.zeros((rows, n), dtype=np.uint32)
+    block[2] = values
+    return block
+
+
+def _echo_loop(**kwargs):
+    """A loop whose fake device echoes each block's hits row back."""
+
+    def launch(blocks):
+        return [np.array(b[2]) for b in blocks]
+
+    def collect(token):
+        return np.concatenate(token)
+
+    return DispatchLoop(launch, collect, **kwargs)
+
+
+class TestSubmitRing:
+    def test_publish_take_roundtrip_and_wraparound(self):
+        """Far more frames than slots and far more rows than the arena:
+        every frame read back intact — wraparound can reorder storage but
+        never corrupt it."""
+        ring = SubmitRing(slots=8, arena_rows=32)
+        ticket = _Ticket()
+        for i in range(100):
+            n = 1 + (i % 5)
+            ring.publish(
+                _block([i] * n), n, None, time.monotonic(), ticket, False
+            )
+            # consume like the owner: read slot, free arena after "pack"
+            slot = ring.slots[ring.head & ring.mask]
+            ring.slots[ring.head & ring.mask] = None
+            rows, count, _dl, _enq, _t, arena_used = slot
+            assert rows[2].tolist() == [i] * n
+            assert count == n
+            ring.head += 1
+            ring.items_out += count
+            ring.rows_out += arena_used
+        assert ring.depth == 0
+
+    def test_overflow_raises_queue_full_not_corruption(self):
+        """With no consumer, slot exhaustion must raise QueueFullError and
+        leave every already-published frame intact."""
+        ring = SubmitRing(slots=8, arena_rows=1 << 12)
+        ticket = _Ticket()
+        for i in range(8):
+            ring.publish(_block([i]), 1, None, 0.0, ticket, False)
+        with pytest.raises(QueueFullError):
+            ring.publish(_block([99]), 1, None, 0.0, ticket, False)
+        got = [ring.slots[i & ring.mask][0][2][0] for i in range(8)]
+        assert got == list(range(8))
+
+    def test_arena_exhaustion_falls_back_to_owned_copy(self):
+        """Rows beyond the arena capacity still publish correctly (the
+        overflow path copies instead of failing or aliasing)."""
+        ring = SubmitRing(slots=64, arena_rows=4)
+        ticket = _Ticket()
+        src = _block([7, 8, 9])
+        ring.publish(src, 3, None, 0.0, ticket, False)  # arena
+        ring.publish(src, 3, None, 0.0, ticket, False)  # would wrap: copy
+        src[:] = 0xFFFF  # caller reuses scratch
+        first = ring.slots[0][0]
+        second = ring.slots[1][0]
+        # first frame sits in the arena (copied), second is an owned copy
+        assert second.base is None or second.base is not ring.arena
+        assert first[2].tolist() == [7, 8, 9]
+        assert second[2].tolist() == [7, 8, 9]
+
+
+class TestDispatchLoop:
+    def test_results_and_order(self):
+        loop = _echo_loop()
+        try:
+            outs = {}
+            lock = threading.Lock()
+
+            def worker(tid):
+                got = loop.submit(_block([tid * 10, tid * 10 + 1]))
+                with lock:
+                    outs[tid] = got.tolist()
+
+            threads = [
+                threading.Thread(target=worker, args=(t,)) for t in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(5.0)
+            assert outs == {
+                t: [t * 10, t * 10 + 1] for t in range(8)
+            }
+        finally:
+            loop.close()
+
+    def test_launch_overlaps_redeem(self):
+        """While batch 1's readback is gated mid-execute, a second known
+        producer's frame must LAUNCH — the double-buffer overlap is the
+        whole point of the loop (successor to
+        test_launch_overlaps_collect). Both producers submit once with the
+        gate open first: the loop's producer census only waits for
+        arrivals from rings it has seen traffic on."""
+        launches = []
+        gate = threading.Event()
+        gate.set()
+
+        def launch(blocks):
+            launches.append([np.array(b[2]) for b in blocks])
+            return [np.array(b[2]) for b in blocks]
+
+        def collect(token):
+            gate.wait(5.0)
+            return np.concatenate(token)
+
+        loop = DispatchLoop(launch, collect, ready=lambda t: gate.is_set())
+        try:
+            # producer threads that live across both submits so each keeps
+            # ONE ring: an ungated census warm-up round, then the gated
+            # overlap round on the same threads via queues
+            import queue as _q
+
+            jobs1, jobs2 = _q.Queue(), _q.Queue()
+            out1, out2 = [], []
+
+            def producer(jobs, out):
+                while True:
+                    v = jobs.get()
+                    if v is None:
+                        return
+                    out.append(loop.submit(_block([v])).tolist())
+
+            p1 = threading.Thread(target=producer, args=(jobs1, out1))
+            p2 = threading.Thread(target=producer, args=(jobs2, out2))
+            p1.start()
+            p2.start()
+            jobs1.put(101)
+            jobs2.put(102)
+            deadline = time.monotonic() + 2.0
+            while (not out1 or not out2) and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert out1 and out2  # both rings known to the census
+
+            gate.clear()
+            n_before = len(launches)
+            jobs1.put(1)  # batch 1: launched, readback gated
+            deadline = time.monotonic() + 2.0
+            while len(launches) < n_before + 1 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            jobs2.put(2)  # must launch WHILE batch 1 is still gated
+            deadline = time.monotonic() + 2.0
+            while len(launches) < n_before + 2 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert len(launches) >= n_before + 2, (
+                "launch 2 did not overlap redeem 1"
+            )
+            gate.set()
+            jobs1.put(None)
+            jobs2.put(None)
+            p1.join(5.0)
+            p2.join(5.0)
+            assert out1 == [[101], [1]] and out2 == [[102], [2]]
+        finally:
+            gate.set()
+            loop.close()
+
+    def test_drain_resolves_tickets_parked_in_both_inflight_buffers(self):
+        """drain() with one batch mid-readback AND a second batch launched
+        behind it: both buffers' tickets must resolve, then the owner
+        thread exits."""
+        import queue as _q
+
+        gate = threading.Event()
+        gate.set()
+        launched = []
+
+        def launch(blocks):
+            launched.append(len(blocks))
+            return [np.array(b[2]) for b in blocks]
+
+        def collect(token):
+            gate.wait(5.0)
+            return np.concatenate(token)
+
+        loop = DispatchLoop(launch, collect, ready=lambda t: gate.is_set())
+        jobs1, jobs2 = _q.Queue(), _q.Queue()
+        out1, out2 = [], []
+
+        def producer(jobs, out):
+            while True:
+                v = jobs.get()
+                if v is None:
+                    return
+                out.append(int(loop.submit(_block([v]))[0]))
+
+        p1 = threading.Thread(target=producer, args=(jobs1, out1))
+        p2 = threading.Thread(target=producer, args=(jobs2, out2))
+        p1.start()
+        p2.start()
+        # census warm-up round, ungated
+        jobs1.put(101)
+        jobs2.put(102)
+        deadline = time.monotonic() + 2.0
+        while (not out1 or not out2) and time.monotonic() < deadline:
+            time.sleep(0.002)
+        gate.clear()
+        n_before = len(launched)
+        jobs1.put(1)
+        deadline = time.monotonic() + 2.0
+        while len(launched) < n_before + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        jobs2.put(2)
+        deadline = time.monotonic() + 2.0
+        while len(launched) < n_before + 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # both in-flight buffers occupied, neither redeemed
+        assert len(launched) == n_before + 2
+        drainer = threading.Thread(target=loop.drain)
+        drainer.start()
+        gate.set()
+        drainer.join(5.0)
+        assert not drainer.is_alive(), "drain() hung"
+        jobs1.put(None)
+        jobs2.put(None)
+        p1.join(5.0)
+        p2.join(5.0)
+        assert out1 == [101, 1] and out2 == [102, 2]
+        # post-drain submits are refused
+        with pytest.raises(CacheError):
+            loop.submit(_block([3]))
+        loop.close()
+
+    def test_close_with_inflight(self):
+        gate = threading.Event()
+        loop = DispatchLoop(
+            lambda blocks: [np.array(b[2]) for b in blocks],
+            lambda token: (gate.wait(5.0), np.concatenate(token))[1],
+        )
+        out = []
+        t = threading.Thread(target=lambda: out.append(loop.submit(_block([5]))))
+        t.start()
+        time.sleep(0.05)
+        closer = threading.Thread(target=loop.close)
+        closer.start()
+        gate.set()
+        closer.join(5.0)
+        assert not closer.is_alive(), "close() deadlocked"
+        t.join(5.0)
+        assert out and out[0].tolist() == [5]
+
+    def test_launch_error_fails_only_that_batch(self):
+        calls = []
+
+        def launch(blocks):
+            calls.append(len(blocks))
+            if len(calls) == 1:
+                raise CacheError("device on fire")
+            return [np.array(b[2]) for b in blocks]
+
+        loop = DispatchLoop(
+            launch, lambda token: np.concatenate(token)
+        )
+        try:
+            with pytest.raises(CacheError, match="device on fire"):
+                loop.submit(_block([1]))
+            assert loop.submit(_block([2])).tolist() == [2]
+        finally:
+            loop.close()
+
+    def test_redeem_error_propagates(self):
+        def collect(token):
+            raise RuntimeError("readback failed")
+
+        loop = DispatchLoop(
+            lambda blocks: [np.array(b[2]) for b in blocks], collect
+        )
+        try:
+            with pytest.raises(RuntimeError, match="readback failed"):
+                loop.submit(_block([1]))
+        finally:
+            loop.close()
+
+    def test_expired_ticket_dropped_at_take_before_packing(self):
+        """A frame whose propagated deadline expired while queued resolves
+        as DeadlineExceededError at ring take time and never reaches the
+        launch callable (overload parity with the batcher's take-time
+        drop)."""
+        gate = threading.Event()
+        launched_rows = []
+
+        def launch(blocks):
+            launched_rows.extend(int(b[2][0]) for b in blocks)
+            return [np.array(b[2]) for b in blocks]
+
+        def collect(token):
+            gate.wait(5.0)
+            return np.concatenate(token)
+
+        loop = DispatchLoop(launch, collect)
+        errors = []
+        # occupy the owner with a gated readback so the expiring frame
+        # sits queued past its deadline
+        t1 = threading.Thread(target=lambda: loop.submit(_block([1])))
+        t1.start()
+        deadline = time.monotonic() + 2.0
+        while not launched_rows and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        def expiring():
+            with deadline_scope(0.05):
+                try:
+                    loop.submit(_block([99]))
+                except DeadlineExceededError as e:
+                    errors.append(e)
+
+        t2 = threading.Thread(target=expiring)
+        t2.start()
+        time.sleep(0.15)  # let the deadline lapse while parked in the ring
+        gate.set()
+        t1.join(5.0)
+        t2.join(5.0)
+        loop.close()
+        assert len(errors) == 1
+        assert 99 not in launched_rows
+        assert loop.deadline_drops == 1
+
+    def test_max_queue_sheds_with_queue_full(self):
+        gate = threading.Event()
+        loop = DispatchLoop(
+            lambda blocks: [np.array(b[2]) for b in blocks],
+            lambda token: (gate.wait(5.0), np.concatenate(token))[1],
+            max_queue=2,
+        )
+        t1 = threading.Thread(target=lambda: loop.submit(_block([1])))
+        t1.start()
+        time.sleep(0.05)  # batch 1 launched, readback gated
+
+        stalled = []
+        t2 = threading.Thread(
+            target=lambda: stalled.append(loop.submit(_block([2, 3])))
+        )
+        t2.start()
+        deadline = time.monotonic() + 2.0
+        while loop.queue_depth < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(QueueFullError):
+            loop.submit(_block([4]))
+        gate.set()
+        t1.join(5.0)
+        t2.join(5.0)
+        loop.close()
+        assert stalled and stalled[0].tolist() == [2, 3]
+
+    def test_brownout_sheds_on_submit(self):
+        controller = AdmissionController(
+            brownout_target_ms=1.0, ewma_alpha=1.0
+        )
+        loop = _echo_loop(overload=controller)
+        try:
+            assert loop.submit(_block([1])).tolist() == [1]
+            controller.observe_queue_wait(50.0)  # force the brownout
+            assert controller.should_shed()
+            with pytest.raises(BrownoutError):
+                loop.submit(_block([2]))
+        finally:
+            loop.close()
+
+    def test_dispatch_launch_fault_site(self):
+        from api_ratelimit_tpu.testing.faults import FaultInjector
+
+        injector = FaultInjector.from_spec(f"{FAULT_SITE_LAUNCH}:error:1")
+        loop = _echo_loop(fault_injector=injector)
+        try:
+            with pytest.raises(CacheError, match="dispatch.launch"):
+                loop.submit(_block([1]))
+            assert injector.fired()[f"{FAULT_SITE_LAUNCH}:error"] >= 1
+            injector.clear()
+            assert loop.submit(_block([2])).tolist() == [2]
+        finally:
+            loop.close()
+
+    def test_stalled_owner_grows_queue_wait_signal(self):
+        """dispatch.launch:delay_ms models a stalled device owner: the
+        ring wait observed by the admission controller grows past the
+        brownout target and the loop starts shedding — the chaos-ladder
+        behavior the site exists for."""
+        from api_ratelimit_tpu.testing.faults import FaultInjector
+
+        controller = AdmissionController(
+            brownout_target_ms=5.0, ewma_alpha=1.0
+        )
+        injector = FaultInjector.from_spec(f"{FAULT_SITE_LAUNCH}:delay_ms:40")
+        loop = _echo_loop(overload=controller, fault_injector=injector)
+
+        def submit_quietly():
+            try:
+                loop.submit(_block([1]))
+            except BrownoutError:
+                pass
+
+        try:
+            # concurrent rounds: frames published while the owner is
+            # stalled inside the injected launch delay wait >= that delay
+            # in the ring, which is what drives the EWMA past target
+            deadline = time.monotonic() + 10.0
+            while not controller.brownout and time.monotonic() < deadline:
+                threads = [
+                    threading.Thread(target=submit_quietly) for _ in range(3)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(5.0)
+            assert controller.brownout
+        finally:
+            loop.close()
+
+
+class TestEngineParity:
+    """Row-block results must be byte-identical between the dispatch-loop
+    and leader-collects arms (acceptance criterion), and both arms must
+    answer saturation/shed identically."""
+
+    @staticmethod
+    def _engine(dispatch_loop, **kwargs):
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+
+        ts = FakeTimeSource(700_000)
+        return SlabDeviceEngine(
+            time_source=ts,
+            n_slots=1 << 12,
+            use_pallas=False,
+            batch_window_seconds=0.002,
+            buckets=(8, 128),
+            max_batch=128,
+            dispatch_loop=dispatch_loop,
+            **kwargs,
+        )
+
+    def test_row_block_results_byte_identical_across_arms(self):
+        import random
+
+        rng = random.Random(3)
+        eng_loop = self._engine(True)
+        eng_lead = self._engine(False)
+        assert eng_loop._dispatch is not None
+        assert eng_lead._dispatch is None
+        try:
+            for step in range(40):
+                n = rng.randrange(1, 9)
+                block = np.zeros((6, n), dtype=np.uint32)
+                block[0] = [rng.randrange(1, 64) for _ in range(n)]
+                block[2] = 1
+                block[3] = rng.randrange(2, 30)
+                block[4] = 60
+                a = eng_loop.submit_rows(np.array(block))
+                b = eng_lead.submit_rows(np.array(block))
+                assert a.dtype == b.dtype == np.uint32
+                assert a.tobytes() == b.tobytes(), step
+        finally:
+            eng_loop.close()
+            eng_lead.close()
+
+    def test_windowed_engine_rides_loop_and_coalesces(self):
+        eng = self._engine(True)
+        try:
+            outs = []
+            lock = threading.Lock()
+
+            def worker(tid):
+                block = np.zeros((6, 1), dtype=np.uint32)
+                block[0] = 4242
+                block[2] = 1
+                block[3] = 1_000_000
+                block[4] = 60
+                r = eng.submit_rows(block)
+                with lock:
+                    outs.append(int(r[0]))
+
+            threads = [
+                threading.Thread(target=worker, args=(t,)) for t in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(5.0)
+            assert sorted(outs) == [1, 2, 3, 4, 5, 6]
+            assert eng.health_snapshot()["decisions"] == 6
+        finally:
+            eng.close()
+
+    def test_engine_drain_with_loop(self):
+        eng = self._engine(True)
+        block = np.zeros((6, 1), dtype=np.uint32)
+        block[0] = 9
+        block[2] = 1
+        block[3] = 100
+        block[4] = 60
+        assert eng.submit_rows(block).tolist() == [1]
+        eng.drain()
+        with pytest.raises(CacheError):
+            eng.submit_rows(np.array(block))
+        eng.close()
+
+    def test_saturation_parity(self):
+        for arm in (True, False):
+            eng = self._engine(arm)
+            eng._saturated = True
+            block = np.zeros((6, 1), dtype=np.uint32)
+            block[2] = 1
+            from api_ratelimit_tpu.backends.overload import SlabSaturatedError
+
+            with pytest.raises(SlabSaturatedError):
+                eng.submit_rows(block)
+            eng.close()

@@ -846,3 +846,133 @@ class TestFullStackOverload:
             )
         finally:
             runner.stop()
+
+
+# -- DISPATCH_LOOP both-arms parity -------------------------------------------
+
+
+class TestDispatchLoopOverloadParity:
+    """The dispatch loop (backends/dispatch.py) and the leader-collects
+    batcher are interchangeable arms of the same admission contract:
+    expired work is dropped at (ring) take time before packing, the shared
+    batcher.submit chaos site sheds identically, and every shed posture
+    answers the same wire response under DISPATCH_LOOP on/off."""
+
+    @staticmethod
+    def _real_cache(store, dispatch_loop, **kw):
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+
+        base = BaseRateLimiter(FakeTimeSource(1_000_000), near_limit_ratio=0.8)
+        return TpuRateLimitCache(
+            base,
+            n_slots=1 << 12,
+            batch_window_seconds=0.002,
+            buckets=(8, 128),
+            max_batch=128,
+            use_pallas=False,
+            stats_scope=store.scope("ratelimit"),
+            dispatch_loop=dispatch_loop,
+            **kw,
+        )
+
+    @pytest.mark.parametrize("arm", [True, False])
+    def test_expired_dropped_at_take_before_packing(self, arm, test_store):
+        store, _ = test_store
+        cache = self._real_cache(store, arm)
+        engine = cache.engine
+        assert (engine._dispatch is not None) == arm
+        import numpy as np
+
+        block = np.zeros((6, 1), dtype=np.uint32)
+        block[0] = 42
+        block[2] = 1
+        block[3] = 10
+        block[4] = 60
+        try:
+            with deadline_scope(-0.001):
+                with pytest.raises(DeadlineExceededError):
+                    engine.submit_rows(np.array(block))
+            # dropped BEFORE packing: the device never saw a decision
+            assert engine.health_snapshot()["decisions"] == 0
+            drops = (
+                engine._dispatch.deadline_drops
+                if arm
+                else engine._batcher.deadline_drops
+            )
+            assert drops == 1
+            # a fresh submit on the same arm still works
+            assert engine.submit_rows(np.array(block)).tolist() == [1]
+        finally:
+            cache.close()
+
+    @pytest.mark.parametrize("arm", [True, False])
+    @pytest.mark.parametrize(
+        "mode", [SHED_MODE_ALLOW, SHED_MODE_DENY, SHED_MODE_UNAVAILABLE]
+    )
+    def test_shed_postures_answer_identically(self, arm, mode, test_store):
+        """queue_full injected at the SHARED batcher.submit site: the
+        service's posture answer must be byte-for-byte the same whichever
+        arm is live."""
+        store, sink = test_store
+        controller = AdmissionController(
+            shed_mode=mode, scope=store.scope("ratelimit")
+        )
+        injector = FaultInjector.from_spec("batcher.submit:queue_full:1")
+        cache = self._real_cache(
+            store, arm, overload=controller, fault_injector=injector
+        )
+        svc = RateLimitService(
+            runtime=_FakeRuntime({"config.ov": OVERLOAD_YAML}),
+            cache=cache,
+            stats_scope=store.scope("ratelimit").scope("service"),
+            time_source=FakeTimeSource(1_000_000),
+            overload=controller,
+        )
+        try:
+            if mode == SHED_MODE_UNAVAILABLE:
+                with pytest.raises(QueueFullError):
+                    svc.should_rate_limit(_req())
+            else:
+                overall, statuses, headers = svc.should_rate_limit(_req())
+                if mode == SHED_MODE_ALLOW:
+                    assert overall == Code.OK
+                    assert statuses[0].code == Code.OK
+                    assert any(
+                        h.key == "x-ratelimit-shed" and h.value == "queue_full"
+                        for h in headers
+                    )
+                else:
+                    assert overall == Code.OVER_LIMIT
+                    assert statuses[0].code == Code.OVER_LIMIT
+            store.flush()
+            assert sink.counters["ratelimit.overload.shed"] == 1
+            assert sink.counters["ratelimit.overload.queue_full"] == 1
+        finally:
+            cache.close()
+
+    @pytest.mark.parametrize("arm", [True, False])
+    def test_brownout_sheds_identically(self, arm, test_store):
+        store, _ = test_store
+        controller = AdmissionController(
+            shed_mode=SHED_MODE_UNAVAILABLE,
+            brownout_target_ms=1.0,
+            ewma_alpha=1.0,
+            scope=store.scope("ratelimit"),
+        )
+        cache = self._real_cache(store, arm, overload=controller)
+        engine = cache.engine
+        import numpy as np
+
+        block = np.zeros((6, 1), dtype=np.uint32)
+        block[0] = 7
+        block[2] = 1
+        block[3] = 10
+        block[4] = 60
+        try:
+            assert engine.submit_rows(np.array(block)).tolist() == [1]
+            _brownout(controller)
+            with pytest.raises(BrownoutError):
+                engine.submit_rows(np.array(block))
+        finally:
+            cache.close()

@@ -101,7 +101,15 @@ class TestSettings:
         s = Settings()
         assert s.tpu_precompile is True
         assert s.host_fast_path is True
+        assert s.dispatch_loop is True  # device-owner loop is the default
         assert s.buckets() is None  # engine default ladder
+
+    def test_dispatch_loop_knob(self):
+        # the rollback arm (leader-collects batcher), HOST_FAST_PATH style
+        assert new_settings({"DISPATCH_LOOP": "false"}).dispatch_loop is False
+        assert new_settings({"DISPATCH_LOOP": "on"}).dispatch_loop is True
+        with pytest.raises(ValueError, match="DISPATCH_LOOP"):
+            new_settings({"DISPATCH_LOOP": "sideways"})
 
     def test_buckets_junk_fails_boot(self):
         for junk in ("abc", "128,xyz", "0", "-8,128", ","):

@@ -74,3 +74,15 @@ class TestHotpathProfile:
         )
         assert proc.returncode == 0, proc.stderr[-500:]
         assert "path=legacy" in proc.stdout
+
+    def test_dispatch_arm_profiles_owner_thread(self):
+        proc = _run_tool(
+            "tools.hotpath_profile", ("-n", "120", "--top", "8", "--dispatch")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "path=dispatch-owner" in proc.stdout
+        lines = proc.stdout.splitlines()
+        header = [ln for ln in lines if "ncalls" in ln and "tottime" in ln]
+        assert header, "pstats table header missing"
+        # the profiled thread is the OWNER loop, not the request thread
+        assert any("dispatch.py" in ln and "_run" in ln for ln in lines)

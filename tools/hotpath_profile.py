@@ -8,7 +8,15 @@ cProfile and printed as a top-N cumulative table:
     python -m tools.hotpath_profile                 # 2000 requests, top 25
     python -m tools.hotpath_profile -n 500 --top 10 --sort tottime
     python -m tools.hotpath_profile --legacy        # pin the pre-vectorization path
+    python -m tools.hotpath_profile --dispatch      # profile the device-OWNER thread
     make profile
+
+--dispatch profiles the dispatch loop's owner thread instead of the
+request thread: the loop runs its take/pack/launch/redeem cycle under its
+own cProfile (DISPATCH_PROFILE=1, backends/dispatch.py) while this thread
+drives traffic, and the owner's table is printed after close(). The
+`lock.acquire` line is the owner parked waiting for work/readbacks — the
+idle headroom; everything else is real per-cycle dispatch cost.
 
 Single-thread on purpose: cProfile instruments only the calling thread,
 so the dispatcher/device threads show up as one honest
@@ -49,6 +57,12 @@ def main(argv=None) -> int:
         help="pin the legacy per-object host path (the A/B arm)",
     )
     parser.add_argument(
+        "--dispatch",
+        action="store_true",
+        help="profile the dispatch loop's device-owner thread instead of "
+        "the request thread (DISPATCH_PROFILE=1)",
+    )
+    parser.add_argument(
         "--pyinstrument",
         action="store_true",
         help="wall-clock sampling profile instead of cProfile",
@@ -56,6 +70,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     sys.path.insert(0, REPO)
+    if args.dispatch:
+        # must be set BEFORE the service (and its DispatchLoop thread)
+        # is built: the owner thread reads it once at startup
+        os.environ["DISPATCH_PROFILE"] = "1"
     import bench
 
     service, cache, _store = bench._build_service(
@@ -69,6 +87,8 @@ def main(argv=None) -> int:
     for request in reqs[:64]:
         service.should_rate_limit(request)
 
+    if args.dispatch:
+        return _run_dispatch_profile(service, cache, reqs, args)
     try:
         if args.pyinstrument:
             return _run_pyinstrument(service, reqs, args)
@@ -90,6 +110,45 @@ def main(argv=None) -> int:
         return 0
     finally:
         cache.close()
+
+
+def _run_dispatch_profile(service, cache, reqs, args) -> int:
+    """Drive traffic from a small thread pool (the owner loop only earns
+    its keep under concurrency) and print the OWNER thread's cProfile."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    loop = getattr(cache.engine, "_dispatch", None)
+    if loop is None:
+        print(
+            "[hotpath] dispatch loop is not active (DISPATCH_LOOP off or "
+            "direct mode); nothing to profile",
+            file=sys.stderr,
+        )
+        cache.close()
+        return 2
+
+    def worker(tid: int) -> None:
+        my = reqs[tid::4]
+        for i in range(args.n // 4):
+            service.should_rate_limit(my[i % len(my)])
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(4) as ex:
+        list(ex.map(worker, range(4)))
+    elapsed = time.perf_counter() - t0
+    cache.close()  # stops the owner thread; its profile is final now
+    print(
+        f"[hotpath] rate={round(args.n / elapsed)}/s requests={args.n} "
+        f"path=dispatch-owner"
+    )
+    if loop._profile is None:
+        print("[hotpath] owner thread recorded no profile", file=sys.stderr)
+        return 2
+    out = io.StringIO()
+    stats = pstats.Stats(loop._profile, stream=out)
+    stats.sort_stats(args.sort).print_stats(args.top)
+    print(out.getvalue())
+    return 0
 
 
 def _run_pyinstrument(service, reqs, args) -> int:
