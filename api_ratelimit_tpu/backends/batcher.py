@@ -41,6 +41,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ..limiter.cache import CacheError, DeadlineExceededError
+from ..tracing import journeys
 from ..utils.deadline import current_deadline
 from .overload import BrownoutError, QueueFullError
 
@@ -56,15 +57,22 @@ class _CollectTicket:
     error). The ticket owns the inflight bookkeeping — _finish_one runs
     exactly once, whoever redeems first."""
 
-    __slots__ = ("_batcher", "_token", "_lock", "_results", "_error", "_done")
+    __slots__ = (
+        "_batcher", "_token", "_lock", "_results", "_error", "_done",
+        "stage_ns",
+    )
 
-    def __init__(self, batcher: "MicroBatcher", token):
+    def __init__(self, batcher: "MicroBatcher", token, stage_partial=None):
         self._batcher = batcher
         self._token = token
         self._lock = threading.Lock()
         self._results = None
         self._error: BaseException | None = None
         self._done = False
+        # (take, pack, launch) monotonic-ns from the dispatcher thread;
+        # redeem/scatter appended by whoever redeems — the journey stage
+        # tuple (tracing/journeys.py), same shape as the dispatch loop's
+        self.stage_ns: tuple | None = stage_partial
 
     def redeem(self):
         with self._lock:
@@ -73,6 +81,9 @@ class _CollectTicket:
                     self._results = self._batcher._execute_collect(self._token)
                 except BaseException as e:  # noqa: BLE001 - memo + reraise
                     self._error = e
+                if self.stage_ns is not None and len(self.stage_ns) == 3:
+                    done_ns = time.monotonic_ns()
+                    self.stage_ns = (*self.stage_ns, done_ns, done_ns)
                 self._done = True
                 self._token = None
                 self._batcher._finish_one()
@@ -289,10 +300,30 @@ class MicroBatcher:
                     self._h_batch.record(count)
                 if self._overload is not None:
                     self._overload.observe_queue_wait(wait_ms)
+                # journey stages in direct mode: the caller IS the owner,
+                # launch and readback are fused in one execute — stamp the
+                # full stage set (pinned by the dispatch-arm parity test)
+                # with the execute call as the launch..scatter interval
+                if journeys.recording():
+                    ns0 = time.monotonic_ns()
+                    for stage in ("publish", "take", "pack"):
+                        journeys.mark(stage, ns0)
+                    try:
+                        out = (
+                            self._execute([items])
+                            if self._block_mode
+                            else self._execute(list(items))
+                        )
+                    finally:
+                        ns1 = time.monotonic_ns()
+                        for stage in ("launch", "redeem", "scatter"):
+                            journeys.mark(stage, ns1)
+                    return out
                 if self._block_mode:
                     return self._execute([items])
                 return self._execute(list(items))
 
+        journeys.mark("publish")
         future: Future = Future()
         with self._lock:
             if self._closed:
@@ -333,6 +364,8 @@ class MicroBatcher:
             # first) runs the blocking readback right here
             _, ticket, start, count = out
             results = ticket.redeem()
+            if ticket.stage_ns is not None:
+                journeys.merge_owner_stages(ticket.stage_ns)
             return results[start : start + count]
         return out
 
@@ -518,6 +551,13 @@ class MicroBatcher:
                 # semaphore (held launch -> redemption) is the
                 # backpressure that caps un-collected launches.
                 self._inflight_sem.acquire()
+                stage_partial = None
+                if journeys.recording():
+                    # take/pack/launch for the journey stage tuple; the
+                    # redeeming caller appends redeem/scatter — the same
+                    # stage set the dispatch loop records, pinned by test
+                    take_ns = int(t_take * 1e9)
+                    stage_partial = (take_ns, time.monotonic_ns())
                 try:
                     token = self._execute_launch(items)
                 except BaseException as e:  # noqa: BLE001 - propagate
@@ -526,7 +566,11 @@ class MicroBatcher:
                             future.set_exception(e)
                     self._finish_one()
                 else:
-                    ticket = _CollectTicket(self, token)
+                    if stage_partial is not None:
+                        stage_partial = (
+                            *stage_partial, time.monotonic_ns()
+                        )
+                    ticket = _CollectTicket(self, token, stage_partial)
                     for future, start, count in futures:
                         future.set_result((_TICKET, ticket, start, count))
                 continue

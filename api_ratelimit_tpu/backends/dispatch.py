@@ -56,10 +56,18 @@ from collections import deque
 import numpy as np
 
 from ..limiter.cache import CacheError, DeadlineExceededError
+from ..tracing import SpanContext, active_span, global_tracer
+from ..tracing import journeys
 from ..utils.deadline import current_deadline
 from .overload import BrownoutError, QueueFullError
 
 logger = logging.getLogger("ratelimit.dispatch")
+
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+# ring ctx sidecar flags (uint64 word 3): bit0 = context present,
+# bit1 = B3 sampled
+_CTX_PRESENT = 1
+_CTX_SAMPLED = 2
 
 # shared with MicroBatcher so one FAULT_INJECT spec rehearses both arms
 FAULT_SITE_SUBMIT = "batcher.submit"
@@ -75,7 +83,7 @@ class _Ticket:
     the steady state allocates nothing per request. The returned view is
     valid until the owning thread's next submit."""
 
-    __slots__ = ("event", "buf", "n", "error", "fresh")
+    __slots__ = ("event", "buf", "n", "error", "fresh", "stage_ns")
 
     def __init__(self):
         self.event = threading.Event()
@@ -87,6 +95,11 @@ class _Ticket:
         # thread's next submit); False reuses this ticket's buffer — the
         # zero-alloc path for callers that consume the view immediately
         self.fresh = True
+        # owner-thread stage timestamps (take, pack, launch, redeem,
+        # scatter) in monotonic ns — set before resolve() when journeys or
+        # tracing are on, so the frontend can close its request span with
+        # real child stages and merge the journey across the thread hop
+        self.stage_ns: tuple | None = None
 
     def reserve(self, n: int) -> np.ndarray:
         if self.fresh:
@@ -125,7 +138,7 @@ class SubmitRing:
     never taken by the consumer."""
 
     __slots__ = (
-        "slots", "mask", "arena", "cursor", "tail", "head",
+        "slots", "mask", "arena", "ctx", "cursor", "tail", "head",
         "rows_in", "rows_out", "items_in", "items_out", "lock",
         "closed", "ticket",
     )
@@ -136,6 +149,12 @@ class SubmitRing:
         self.slots: list = [None] * slots
         self.mask = slots - 1
         self.arena = np.empty((6, arena_rows), dtype=np.uint32)
+        # trace-context sidecar, one fixed-width row per slot (trace_id
+        # hi/lo, span_id, flags) — published with the frame under the same
+        # seqno discipline, so span identity rides the ring next to the
+        # row block instead of dying at the thread hop. flags==0 (the
+        # untraced case) is a single scalar store.
+        self.ctx = np.zeros((slots, 4), dtype=np.uint64)
         self.cursor = 0  # producer arena write position
         self.tail = 0  # producer-only: frames published
         self.head = 0  # consumer-only: frames consumed
@@ -153,10 +172,12 @@ class SubmitRing:
         return self.items_in - self.items_out
 
     def publish(self, block: np.ndarray, count: int, deadline, enq: float,
-                ticket: _Ticket, owned: bool) -> None:
+                ticket: _Ticket, owned: bool, ctx=None) -> None:
         """Copy `count` columns of `block` in and publish one frame.
         owned=True hands the block over without a copy (one-shot sidecar
-        wire buffers). Raises QueueFullError when the slot ring is full —
+        wire buffers). ctx: optional (trace_hi, trace_lo, span_id, flags)
+        span identity written to the ctx sidecar row before the frame
+        publishes. Raises QueueFullError when the slot ring is full —
         overflow must shed, never corrupt."""
         tail = self.tail
         if tail - self.head > self.mask:
@@ -184,10 +205,15 @@ class SubmitRing:
                 # arena exhausted under sustained backlog: decouple from
                 # the caller's scratch with an owned copy
                 rows = np.array(block[:, :count], dtype=np.uint32)
+        idx = tail & self.mask
+        if ctx is not None:
+            self.ctx[idx] = ctx
+        else:
+            self.ctx[idx, 3] = 0
         with self.lock:
             if self.closed:
                 raise CacheError("dispatch loop is closed")
-            self.slots[tail & self.mask] = (
+            self.slots[idx] = (
                 rows, count, deadline, enq, ticket, arena_used
             )
             self.items_in += count
@@ -355,14 +381,63 @@ class DispatchLoop:
         ring = self._ring()
         ticket = ring.ticket
         ticket.error = None
+        ticket.stage_ns = None
         ticket.fresh = not reuse_out
         ticket.event.clear()
+        # trace context rides the ring (ctx sidecar row): the owner thread
+        # links the batch span to this request span and returns per-stage
+        # timestamps on the ticket. Disabled tracing + no recorder costs
+        # one contextvar read and one scalar store.
+        span = active_span()
+        ctx = None
+        publish_ns = 0
+        if span is not None:
+            c = span.context
+            ctx = (
+                c.trace_id >> 64,
+                c.trace_id & _MASK64,
+                c.span_id,
+                _CTX_PRESENT | (_CTX_SAMPLED if c.sampled else 0),
+            )
+        if span is not None or journeys.recording():
+            publish_ns = time.monotonic_ns()
+            journeys.mark("publish", publish_ns)
         ring.publish(
-            block, count, deadline, time.monotonic(), ticket, owned
+            block, count, deadline, time.monotonic(), ticket, owned, ctx
         )
         self._idle.clear()
         self._work.set()
-        return ticket.redeem()
+        out = ticket.redeem()
+        stages = ticket.stage_ns
+        if stages is not None:
+            journeys.merge_owner_stages(stages)
+            if span is not None and publish_ns:
+                self._record_stage_spans(span, publish_ns, stages)
+        return out
+
+    @staticmethod
+    def _record_stage_spans(span, publish_ns: int, stages: tuple) -> None:
+        """Close the request span's blind gap with real child spans
+        reconstructed from the owner thread's stage timestamps."""
+        tracer = span.tracer
+        if tracer is None or not tracer.enabled:
+            return
+        take, pack, launch, redeem, scatter = stages
+        now_ns = time.monotonic_ns()
+        wall = time.time()
+
+        def record(name: str, begin_ns: int, end_ns: int) -> None:
+            tracer.record_span(
+                f"dispatch.{name}",
+                span,
+                wall - (now_ns - begin_ns) / 1e9,
+                (end_ns - begin_ns) / 1e9,
+            )
+
+        record("ring_wait", publish_ns, take)
+        record("pack", take, pack)
+        record("launch", pack, launch)
+        record("redeem", launch, scatter)
 
     def flush(self) -> None:
         """Block until everything published so far has been redeemed."""
@@ -431,7 +506,7 @@ class DispatchLoop:
         self._idle.set()
 
     def _run(self) -> None:
-        inflight: deque = deque()  # (token, frames, n_items, freed)
+        inflight: deque = deque()  # (token, frames, n_items, stages, span)
         while True:
             if not inflight and not self._closed:
                 # cold pipeline: wait out the straggler train before the
@@ -440,7 +515,7 @@ class DispatchLoop:
                 # With a batch in flight, its execute time IS the
                 # coalescing window — take immediately.
                 self._linger()
-            frames, pending_free, expired = self._take()
+            frames, pending_free, expired, t_take = self._take()
             if expired:
                 self.deadline_drops += len(expired)
                 if self._overload is not None:
@@ -454,12 +529,12 @@ class DispatchLoop:
                     ticket.fail(exc)
                 self._taken_items -= n_exp
             if frames:
-                n_items = sum(count for _, count, _ in frames)
+                n_items = sum(count for _, count, _, _ in frames)
                 if self._h_batch is not None:
                     self._h_batch.record(n_items)
-                launched = self._launch_frames(frames, pending_free)
+                launched = self._launch_frames(frames, pending_free, t_take)
                 if launched is not None:
-                    inflight.append((launched, frames, n_items))
+                    inflight.append(launched)
             elif pending_free:
                 self._free_arena(pending_free)
             if inflight and (
@@ -482,8 +557,7 @@ class DispatchLoop:
                     # launch it FIRST (the double-buffer overlap), redeem
                     # after
                     continue
-                token, fr, n_items = inflight.popleft()
-                self._redeem(token, fr, n_items)
+                self._redeem(*inflight.popleft())
                 self._inflight_count = len(inflight)
                 continue
             if frames:
@@ -570,11 +644,13 @@ class DispatchLoop:
             self._work.wait(timeout=min(deadline - now, lull))
 
     def _take(self):
-        """Drain every ring. Returns (frames, pending_free, expired):
-        frames = [(rows, count, ticket)] in ring order, pending_free =
-        [(ring, arena_rows)] to release once the rows are packed, expired
-        = [(ticket, count)] dropped at take time (their arena rows are
-        freed through pending_free too — arena release is FIFO)."""
+        """Drain every ring. Returns (frames, pending_free, expired,
+        t_take): frames = [(rows, count, ticket, span_ctx)] in ring order
+        (span_ctx is the frame's SpanContext from the ring's ctx sidecar,
+        or None), pending_free = [(ring, arena_rows)] to release once the
+        rows are packed, expired = [(ticket, count)] dropped at take time
+        (their arena rows are freed through pending_free too — arena
+        release is FIFO)."""
         frames = []
         expired = []
         pending_free = []
@@ -608,6 +684,15 @@ class DispatchLoop:
                 idx = head & ring.mask
                 rows, count, deadline, enq, ticket, arena_used = ring.slots[idx]
                 ring.slots[idx] = None
+                sctx = None
+                flags = int(ring.ctx[idx, 3])
+                if flags & _CTX_PRESENT:
+                    sctx = SpanContext(
+                        trace_id=(int(ring.ctx[idx, 0]) << 64)
+                        | int(ring.ctx[idx, 1]),
+                        span_id=int(ring.ctx[idx, 2]),
+                        sampled=bool(flags & _CTX_SAMPLED),
+                    )
                 freed += arena_used
                 # visible to flush() before the ring's head moves on
                 self._taken_items += count
@@ -618,76 +703,150 @@ class DispatchLoop:
                     continue
                 wait_ms = (t_take - enq) * 1e3
                 if self._h_wait is not None:
-                    self._h_wait.record(wait_ms)
+                    # trace-id exemplar: a frame that waited into the
+                    # overflow bucket links straight to its span
+                    if sctx is not None and self._h_wait.is_slow(wait_ms):
+                        self._h_wait.record(
+                            wait_ms, exemplar=f"{sctx.trace_id:032x}"
+                        )
+                    else:
+                        self._h_wait.record(wait_ms)
                 if wait_ms > head_wait_ms:
                     head_wait_ms = wait_ms
-                frames.append((rows, count, ticket))
+                frames.append((rows, count, ticket, sctx))
             ring.head = head
             if freed:
                 pending_free.append((ring, freed))
         if frames and self._overload is not None:
             self._overload.observe_queue_wait(head_wait_ms)
-        return frames, pending_free, expired
+        return frames, pending_free, expired, t_take
 
     @staticmethod
     def _free_arena(pending_free) -> None:
         for ring, freed in pending_free:
             ring.rows_out += freed
 
-    def _launch_frames(self, frames, pending_free):
+    def _batch_span(self, frames, n_items: int):
+        """Open the per-launch `dispatch.batch` span, linked (followsFrom)
+        to every request span this launch coalesced. None when no frame
+        carried a sampled context — the untraced hot path builds nothing."""
+        links = [sctx for _, _, _, sctx in frames if sctx is not None]
+        if not links:
+            return None, None
+        tracer = global_tracer()
+        if not tracer.enabled:
+            return None, links
+        span = tracer.start_span(
+            "dispatch.batch",
+            links=links,
+            tags={
+                "span.kind": "internal",
+                "component": "dispatch",
+                "batch_items": n_items,
+                "batch_frames": len(frames),
+            },
+        )
+        return span, links
+
+    def _launch_frames(self, frames, pending_free, t_take: float):
         """Launch one batch (chaos site first); on failure every ticket of
         the batch fails and None is returned. Arena rows are released as
         soon as the launch callable returns — the pack copied them into
-        the padded operand."""
+        the padded operand. Returns the in-flight entry
+        (token, frames, n_items, stages, batch_span)."""
+        n_items = sum(count for _, count, _, _ in frames)
+        span, links = self._batch_span(frames, n_items)
+        want_stages = journeys.recording() or links is not None
+        take_ns = int(t_take * 1e9) if want_stages else 0
+        exemplar = f"{links[0].trace_id:032x}" if links else None
         if self._faults is not None:
             action = self._faults.fire(FAULT_SITE_LAUNCH)
             if action == "error":
                 exc = CacheError("injected dispatch.launch fault")
-                for _, count, ticket in frames:
+                if span is not None:
+                    span.log_kv(
+                        event="fault", site=FAULT_SITE_LAUNCH, kind=action
+                    )
+                    span.set_error(exc)
+                    span.finish()
+                for _, count, ticket, _ in frames:
                     self._taken_items -= count
                     ticket.fail(exc)
                 self._free_arena(pending_free)
                 return None
+        pack_ns = time.monotonic_ns() if want_stages else 0
         t0 = time.perf_counter() if self._h_launch is not None else 0.0
         try:
-            token = self._launch([rows for rows, _, _ in frames])
+            token = self._launch([rows for rows, _, _, _ in frames])
         except BaseException as e:  # noqa: BLE001 - propagate to callers
-            for _, count, ticket in frames:
+            if span is not None:
+                span.set_error(e)
+                span.finish()
+            for _, count, ticket, _ in frames:
                 self._taken_items -= count
                 ticket.fail(e)
             self._free_arena(pending_free)
             return None
+        launch_ns = time.monotonic_ns() if want_stages else 0
         if self._h_launch is not None:
-            self._h_launch.record((time.perf_counter() - t0) * 1e3)
+            launch_ms = (time.perf_counter() - t0) * 1e3
+            if exemplar is not None and self._h_launch.is_slow(launch_ms):
+                self._h_launch.record(launch_ms, exemplar=exemplar)
+            else:
+                self._h_launch.record(launch_ms)
+        if span is not None:
+            span.log_kv(event="launch.dispatched", batch_items=n_items)
         self._free_arena(pending_free)
         self._inflight_count += 1
-        return token
+        stages = (take_ns, pack_ns, launch_ns) if want_stages else None
+        return token, frames, n_items, stages, span
 
-    def _redeem(self, token, frames, n_items: int) -> None:
+    def _redeem(self, token, frames, n_items: int, stages, span) -> None:
         """Blocking readback of one launch, then verdict scatter: each
         parked ticket gets its slice copied into its own buffer (native
-        rl_scatter_rows when built) and wakes."""
+        rl_scatter_rows when built) and wakes with the owner's per-stage
+        timestamps on its ticket."""
         t0 = time.perf_counter() if self._h_redeem is not None else 0.0
         try:
             out = self._collect(token)
+            redeem_ns = time.monotonic_ns() if stages is not None else 0
             out = np.ascontiguousarray(out, dtype=np.uint32)
-            bufs = [t.reserve(count) for _, count, t in frames]
+            bufs = [t.reserve(count) for _, count, t, _ in frames]
             if self._scatter is not None and len(frames) > 1:
-                self._scatter(out, bufs, [count for _, count, _ in frames])
+                self._scatter(out, bufs, [count for _, count, _, _ in frames])
             else:
                 off = 0
-                for buf, (_, count, _) in zip(bufs, frames):
+                for buf, (_, count, _, _) in zip(bufs, frames):
                     buf[:count] = out[off : off + count]
                     off += count
         except BaseException as e:  # noqa: BLE001 - propagate to callers
             # collect OR scatter failure: every parked ticket must learn
             # about it — a stranded ticket blocks its caller forever
-            for _, count, ticket in frames:
+            if span is not None:
+                span.set_error(e)
+                span.finish()
+            for _, count, ticket, _ in frames:
                 ticket.fail(e)
             self._taken_items -= n_items
             return
-        for _, _, ticket in frames:
+        if stages is not None:
+            stage_ns = (*stages, redeem_ns, time.monotonic_ns())
+            for _, _, ticket, _ in frames:
+                ticket.stage_ns = stage_ns
+        for _, _, ticket, _ in frames:
             ticket.resolve()
         self._taken_items -= n_items
         if self._h_redeem is not None:
-            self._h_redeem.record((time.perf_counter() - t0) * 1e3)
+            redeem_ms = (time.perf_counter() - t0) * 1e3
+            sctx = next(
+                (s for _, _, _, s in frames if s is not None), None
+            )
+            if sctx is not None and self._h_redeem.is_slow(redeem_ms):
+                self._h_redeem.record(
+                    redeem_ms, exemplar=f"{sctx.trace_id:032x}"
+                )
+            else:
+                self._h_redeem.record(redeem_ms)
+        if span is not None:
+            span.log_kv(event="redeem.done", batch_items=n_items)
+            span.finish()

@@ -74,7 +74,14 @@ class RedisRateLimitCache:
         ):
             if not cmds:
                 continue
-            replies = client.pipe_do(cmds)
+            try:
+                replies = client.pipe_do(cmds)
+            except Exception as e:
+                # error-tag the span on the failure path, not just log
+                # events on success (the do_limit span audit)
+                if span is not None:
+                    span.set_error(e)
+                raise
             for j, i in enumerate(idx):
                 results[i] = int(replies[2 * j])  # INCRBY reply; EXPIRE ignored
             if span is not None:

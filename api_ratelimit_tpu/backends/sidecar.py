@@ -26,9 +26,16 @@ This is the "JAX/TPU sidecar" of the north star (BASELINE.json).
 Wire protocol (length-framed, little-endian, one in-flight request per
 connection; frontends pool connections for concurrency):
 
-  request:  u32 magic 'RLSC' | u8 version=1 | u8 op | u16 reserved
+  request:  u32 magic 'RLSC' | u8 version=1 | u8 op | u16 flags
             op 1 SUBMIT: u32 n | uint32[6, n] C-order
                          rows: fp_lo, fp_hi, hits, limit, divider, jitter
+                         flags bit 0 (FLAG_TRACE): a B3 trace trailer
+                         follows the block — u32 len | the TextMap carrier
+                         (tracing/propagation.py inject, newline-joined
+                         `header:value` lines), so the frontend-process
+                         span parents the device-owner-process spans
+                         across the RPC. Untraced frames carry flags=0
+                         and zero extra bytes.
             op 2 PING:   empty
   response: u8 status (0 ok / 1 error)
             SUBMIT ok:   u32 n | uint32[n] post-increment counters
@@ -69,6 +76,7 @@ rehearse each of these paths deterministically.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import random
@@ -81,6 +89,9 @@ import time
 import numpy as np
 
 from ..limiter.cache import CacheError
+from ..tracing import activate, active_span, global_tracer
+from ..tracing import journeys
+from ..tracing.propagation import decode_textmap, encode_textmap
 from .fallback import CircuitBreaker
 
 logger = logging.getLogger("ratelimit.sidecar")
@@ -89,6 +100,10 @@ MAGIC = 0x524C5343  # 'RLSC'
 VERSION = 1
 OP_SUBMIT = 1
 OP_PING = 2
+# header flags (the u16 after op): bit 0 = B3 trace trailer appended
+FLAG_TRACE = 1
+# sanity cap on the trace trailer — B3 TextMap is ~90 bytes
+MAX_TRACE_TRAILER = 1024
 
 _HDR = struct.Struct("<IBBH")  # magic, version, op, reserved
 _U32 = struct.Struct("<I")
@@ -288,7 +303,7 @@ class SlabSidecarServer:
                     hdr = _recv_exact(conn, _HDR.size)
                     if net:
                         conn.settimeout(30.0)
-                    magic, version, op, _ = _HDR.unpack(hdr)
+                    magic, version, op, hdr_flags = _HDR.unpack(hdr)
                     if magic != MAGIC or version != VERSION:
                         conn.sendall(self._error(f"bad header {hdr!r}"))
                         return
@@ -309,6 +324,26 @@ class SlabSidecarServer:
                         )
                         return
                     payload = n_raw + _recv_exact(conn, ITEM_ROWS * n * 4)
+                    wire_ctx = None
+                    if hdr_flags & FLAG_TRACE:
+                        # B3 trace trailer: read it BEFORE any fault
+                        # handling so the frame stays wire-coherent; a
+                        # malformed trailer decodes to None and the
+                        # request proceeds untraced, never fails
+                        (blob_len,) = _U32.unpack(
+                            _recv_exact(conn, _U32.size)
+                        )
+                        if blob_len > MAX_TRACE_TRAILER:
+                            conn.sendall(
+                                self._error(
+                                    f"trace trailer {blob_len} exceeds "
+                                    f"cap {MAX_TRACE_TRAILER}"
+                                )
+                            )
+                            return
+                        wire_ctx = decode_textmap(
+                            _recv_exact(conn, blob_len)
+                        )
                     if self._faults is not None:
                         # chaos hook: the frame is fully read (so the
                         # client's framing stays coherent), the response is
@@ -324,22 +359,76 @@ class SlabSidecarServer:
                             # the client sees a mid-frame connection loss
                             conn.sendall(b"\x00")
                             return
+                    # server span parented by the frontend's wire context
+                    # (B3 over the sidecar wire), activated so the
+                    # dispatch loop's ring ctx and batch-span links see
+                    # it; plus the device-owner-side journey
+                    tracer = global_tracer()
+                    server_span = None
+                    if wire_ctx is not None and tracer.enabled:
+                        server_span = tracer.start_span(
+                            "sidecar.submit_rows",
+                            child_of=wire_ctx,
+                            tags={
+                                "span.kind": "server",
+                                "component": "sidecar",
+                                "batch_items": n,
+                            },
+                        )
+                    recorder = journeys.global_recorder()
+                    journey = None
+                    if recorder is not None:
+                        journey = recorder.begin(
+                            "sidecar.submit",
+                            trace_id=(
+                                wire_ctx.trace_id if wire_ctx else 0
+                            ),
+                            span_id=wire_ctx.span_id if wire_ctx else 0,
+                        )
+                    t_req_ns = time.monotonic_ns()
                     try:
-                        if getattr(self._engine, "block_mode", False):
-                            # block-native engine: the wire block IS the
-                            # device input (minus bucket pad + scalar row) —
-                            # no per-item Python objects anywhere on the
-                            # aggregation path
-                            afters = self._engine.submit_block(
-                                decode_block(payload)
-                            )
-                        else:
-                            afters = self._engine.submit(decode_items(payload))
+                        scope_cm = (
+                            activate(server_span)
+                            if server_span is not None
+                            else contextlib.nullcontext()
+                        )
+                        with scope_cm:
+                            if getattr(self._engine, "block_mode", False):
+                                # block-native engine: the wire block IS
+                                # the device input (minus bucket pad +
+                                # scalar row) — no per-item Python objects
+                                # anywhere on the aggregation path
+                                afters = self._engine.submit_block(
+                                    decode_block(payload)
+                                )
+                            else:
+                                afters = self._engine.submit(
+                                    decode_items(payload)
+                                )
                         out = np.asarray(afters, dtype=np.uint32)
+                        # close the span/journey BEFORE the reply hits the
+                        # wire: once the client sees the response, this
+                        # request's server-side trace must already exist
+                        if server_span is not None:
+                            server_span.finish()
+                        if journey is not None:
+                            recorder.finish(
+                                journey,
+                                (time.monotonic_ns() - t_req_ns) / 1e6,
+                            )
                         conn.sendall(
                             b"\x00" + _U32.pack(len(out)) + out.tobytes()
                         )
                     except Exception as e:  # noqa: BLE001 - surface to client
+                        if server_span is not None:
+                            server_span.set_error(e)
+                            server_span.finish()
+                        if journey is not None:
+                            recorder.finish(
+                                journey,
+                                (time.monotonic_ns() - t_req_ns) / 1e6,
+                                flags=(journeys.FLAG_FAULT,),
+                            )
                         if self._stop.is_set():
                             # shutting down: let the connection die instead
                             # of answering with an error reply. A transport
@@ -624,7 +713,41 @@ class SidecarEngineClient:
             raise CacheError(
                 f"sidecar circuit open on {self._path}: failing fast"
             )
-        request = _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + payload
+        # B3 over the sidecar wire: a client child span whose injected
+        # context rides the frame as a TextMap trailer, so the device-owner
+        # process's spans parent into this request's trace. Retries and
+        # redials log onto this same span — one trace per request, however
+        # many transport attempts it took. Untraced requests build nothing
+        # and ship zero extra bytes.
+        parent = active_span()
+        rpc_span = None
+        hdr_flags = 0
+        trailer = b""
+        if parent is not None and parent.tracer is not None:
+            rpc_span = parent.tracer.start_span(
+                "sidecar.submit",
+                child_of=parent,
+                tags={"span.kind": "client", "component": "sidecar"},
+            )
+            raw = encode_textmap(rpc_span.context)
+            trailer = _U32.pack(len(raw)) + raw
+            hdr_flags = FLAG_TRACE
+        request = (
+            _HDR.pack(MAGIC, VERSION, OP_SUBMIT, hdr_flags)
+            + payload
+            + trailer
+        )
+        try:
+            return self._submit_attempts(request, rpc_span, t0)
+        except BaseException as e:
+            if rpc_span is not None:
+                rpc_span.set_error(e)
+            raise
+        finally:
+            if rpc_span is not None:
+                rpc_span.finish()
+
+    def _submit_attempts(self, request: bytes, rpc_span, t0: float) -> np.ndarray:
         attempt = 0
         redialed = False
         while True:
@@ -638,12 +761,25 @@ class SidecarEngineClient:
                     raise
                 if self._c_retry is not None:
                     self._c_retry.inc()
+                if rpc_span is not None:
+                    rpc_span.log_kv(
+                        event="sidecar.retry",
+                        attempt=attempt,
+                        cause="dial",
+                        error=str(e),
+                    )
                 self._sleep(self._backoff(attempt))
                 continue
             try:
                 if self._faults is not None:
                     action = self._faults.fire("sidecar.submit")
                     if action is not None:
+                        if rpc_span is not None:
+                            rpc_span.log_kv(
+                                event="fault",
+                                site="sidecar.submit",
+                                kind=action,
+                            )
                         raise ConnectionError(f"injected fault: {action}")
                 conn.sendall(request)
                 status = _recv_exact(conn, 1)
@@ -671,6 +807,10 @@ class SidecarEngineClient:
                     self._evict_pool()
                     if self._c_redial is not None:
                         self._c_redial.inc()
+                    if rpc_span is not None:
+                        rpc_span.log_kv(
+                            event="sidecar.redial", error=str(e)
+                        )
                     continue
                 attempt += 1
                 if attempt > self._retries:
@@ -678,6 +818,13 @@ class SidecarEngineClient:
                     raise CacheError(f"sidecar transport failure: {e}") from e
                 if self._c_retry is not None:
                     self._c_retry.inc()
+                if rpc_span is not None:
+                    rpc_span.log_kv(
+                        event="sidecar.retry",
+                        attempt=attempt,
+                        cause="transport",
+                        error=str(e),
+                    )
                 self._sleep(self._backoff(attempt))
                 continue
             self._release(conn)

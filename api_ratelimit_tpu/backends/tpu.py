@@ -1032,7 +1032,18 @@ class TpuRateLimitCache:
 
         if span is not None:
             span.log_kv(event="lookup.start", batch_items=len(items))
-        for after, i in zip(self._engine_core.submit(items), item_slots):
+        try:
+            afters = self._engine_core.submit(items)
+        except Exception as e:
+            # error-tag the span here, where the failure happened: the
+            # service boundary marks its own copy, but a do_limit driven
+            # directly (tests, tools) must not leave a clean-looking span
+            # for a failed lookup (QueueFullError, DeadlineExceededError,
+            # CacheError all land here)
+            if span is not None:
+                span.set_error(e)
+            raise
+        for after, i in zip(afters, item_slots):
             results[i] = after
         if span is not None:
             span.log_kv(event="tpu.lookup.done", client="slab")
@@ -1135,15 +1146,23 @@ class TpuRateLimitCache:
 
         if span is not None:
             span.log_kv(event="lookup.start", batch_items=pending_count)
-        if pending_count:
-            if self._submit_rows is not None:
-                afters = self._submit_rows(block[:, :pending_count]).tolist()
+        try:
+            if pending_count:
+                if self._submit_rows is not None:
+                    afters = self._submit_rows(
+                        block[:, :pending_count]
+                    ).tolist()
+                else:
+                    afters = self._engine_core.submit(
+                        _block_to_items(block[:, :pending_count])
+                    )
             else:
-                afters = self._engine_core.submit(
-                    _block_to_items(block[:, :pending_count])
-                )
-        else:
-            afters = ()
+                afters = ()
+        except Exception as e:
+            # see do_limit: the exception path must error-tag the span
+            if span is not None:
+                span.set_error(e)
+            raise
         if span is not None:
             span.log_kv(event="tpu.lookup.done", client="slab")
 

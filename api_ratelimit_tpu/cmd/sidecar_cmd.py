@@ -35,6 +35,8 @@ from ..server.http_server import new_debug_server
 from ..settings import new_settings
 from ..stats.sinks import NullSink, StatsdSink
 from ..stats.store import Store
+from ..tracing import journeys as journeys_mod
+from ..tracing import set_global_tracer, tracer_from_env
 from ..utils.timeutil import RealTimeSource
 
 logger = logging.getLogger("ratelimit.sidecar.main")
@@ -51,6 +53,23 @@ def main() -> None:
     )
     store = Store(sink, latency_buckets=settings.latency_buckets())
     scope = store.scope("ratelimit")
+
+    # Tracer + journey recorder, same posture as the frontend runner: the
+    # dispatch loop's batch spans parent into frontend traces arriving
+    # over the wire (B3 trailer, backends/sidecar.py), and the device
+    # owner keeps its own tail-sampled journey buffer on /debug/journeys.
+    tracer = tracer_from_env()
+    set_global_tracer(tracer)
+    jr_enabled, jr_slow_ms, jr_retain, jr_ring = settings.journey_config()
+    if jr_enabled:
+        journeys_mod.set_global_recorder(
+            journeys_mod.JourneyRecorder(
+                slow_ms=jr_slow_ms,
+                retain=jr_retain,
+                ring=jr_ring,
+                scope=scope.scope("journeys"),
+            )
+        )
 
     from ..utils.jaxsetup import respect_jax_platforms_env
 
@@ -174,6 +193,7 @@ def main() -> None:
         settings.debug_port,
         store,
         enable_metrics=settings.debug_metrics_enabled,
+        profile_dir=settings.tpu_profile_dir,
     )
     debug.serve_background()
     store.start_flushing()
@@ -203,6 +223,7 @@ def main() -> None:
         snapshotter.drain()
     store.stop_flushing()
     debug.shutdown()
+    tracer.close()
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ from .service.ratelimit import RateLimitService
 from .settings import Settings, new_settings
 from .stats.sinks import NullSink, StatsdSink
 from .stats.store import Store
+from .tracing import journeys as journeys_mod
 from .tracing import set_global_tracer, tracer_from_env
 from .utils.timeutil import RealTimeSource
 
@@ -170,6 +171,7 @@ class Runner:
         self.service: RateLimitService | None = None
         self.runtime: DirectoryRuntimeLoader | None = None
         self.tracer = None
+        self.journeys = None
         self.fallback = None
         self.overload = None
         self.fault_injector = None
@@ -184,18 +186,26 @@ class Runner:
         setup_logging(settings)
 
         # Post-mortem muscle: faulthandler dumps every thread's stack on a
-        # hard fault, and SIGUSR2 dumps them on demand — the tool for "the
-        # service stopped answering, what is every worker doing?". The
-        # signal registration is main-thread-only (background/test boots
-        # skip it); enable() is safe anywhere.
+        # hard fault, and SIGUSR2 dumps them on demand — plus the journey
+        # flight recorder's retained tail (tracing/journeys.py), so "the
+        # service stopped answering" yields both where every worker IS and
+        # where the slow requests WENT. The signal registration is
+        # main-thread-only (background/test boots skip it); enable() is
+        # safe anywhere.
         import faulthandler
 
         faulthandler.enable()
+
+        def on_sigusr2(signum, frame):
+            faulthandler.dump_traceback(all_threads=True)
+            recorder = journeys_mod.global_recorder()
+            if recorder is not None:
+                sys.stderr.write(recorder.dump_json())
+                sys.stderr.flush()
+
         try:
             if hasattr(signal_module, "SIGUSR2"):
-                faulthandler.register(
-                    signal_module.SIGUSR2, all_threads=True
-                )
+                signal_module.signal(signal_module.SIGUSR2, on_sigusr2)
         except (ValueError, OSError):
             pass  # not the main thread (run_background from a test)
 
@@ -204,6 +214,21 @@ class Runner:
         # closed with a bounded flush in _teardown (runner.go:91).
         self.tracer = tracer_from_env()
         set_global_tracer(self.tracer)
+
+        # Journey flight recorder (tracing/journeys.py): every request's
+        # stage itinerary, tail-sampled by outcome into /debug/journeys
+        # and the SIGUSR2 dump. Registered globally like the tracer so
+        # the service boundary and both dispatch arms find it.
+        jr_enabled, jr_slow_ms, jr_retain, jr_ring = settings.journey_config()
+        self.journeys = None
+        if jr_enabled:
+            self.journeys = journeys_mod.JourneyRecorder(
+                slow_ms=jr_slow_ms,
+                retain=jr_retain,
+                ring=jr_ring,
+                scope=self.scope.scope("journeys"),
+            )
+        journeys_mod.set_global_recorder(self.journeys)
 
         # An explicitly pinned JAX_PLATFORMS (e.g. cpu for a host-only
         # deployment) must beat any site-wide accelerator plugin override.
@@ -417,3 +442,9 @@ class Runner:
         self.stats_store.stop_flushing()
         if self.tracer is not None:
             self.tracer.close()
+        if self.journeys is not None:
+            # unregister only OUR recorder (in-process test boots share
+            # the module global; a later Runner may already own it)
+            if journeys_mod.global_recorder() is self.journeys:
+                journeys_mod.set_global_recorder(None)
+            self.journeys = None

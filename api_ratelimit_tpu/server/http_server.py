@@ -241,7 +241,11 @@ def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
 
 
 def new_debug_server(
-    host: str, port: int, stats_store, enable_metrics: bool = True
+    host: str,
+    port: int,
+    stats_store,
+    enable_metrics: bool = True,
+    profile_dir: str = "",
 ) -> HttpServer:
     """The debug-port suite (server_impl.go:217-250); /rlconfig is added by
     the runner via Server.add_debug_endpoint (runner.go:108-113).
@@ -249,7 +253,12 @@ def new_debug_server(
     enable_metrics mounts GET /metrics — Prometheus text exposition
     rendered straight from the stats store (stats/prometheus.py), making
     the statsd -> prom-statsd-exporter hop optional. DEBUG_METRICS_ENABLED
-    turns it off for deployments that must not expose a scrape surface."""
+    turns it off for deployments that must not expose a scrape surface.
+
+    profile_dir (TPU_PROFILE_DIR): when set, GET /debug/profile?ms=N
+    captures a jax.profiler device trace for N milliseconds into that
+    directory — the on-demand view of what the dispatch owner loop keeps
+    the device doing. Empty leaves the endpoint mounted but disabled."""
     server = HttpServer(host, port, "debug")
 
     def handle_stats(h: _Handler) -> None:
@@ -288,6 +297,66 @@ def new_debug_server(
             tracing.global_tracer().dump_json().encode(),
             content_type="application/json",
         )
+
+    def handle_journeys(h: _Handler) -> None:
+        """Tail-sampled flight recorder export (tracing/journeys.py):
+        retained slow/shed/deadline/fault/over-limit journeys with
+        per-stage ns timestamps, plus the per-thread recent rings.
+        Renders offline via tools/journey_report.py."""
+        from ..tracing import journeys
+
+        recorder = journeys.global_recorder()
+        if recorder is None:
+            body = (
+                '{"enabled": false, "retained": [], "recent": {}}\n'
+            )
+        else:
+            body = recorder.dump_json()
+        h._write(200, body.encode(), content_type="application/json")
+
+    # one device profile at a time (same rationale as the CPU sampler)
+    jax_profile_running = threading.Lock()
+
+    def handle_jax_profile(h: _Handler) -> None:
+        """GET /debug/profile?ms=N — capture a jax.profiler trace of the
+        owner loop for N milliseconds into TPU_PROFILE_DIR (viewable in
+        TensorBoard/Perfetto). Disabled (404) until the knob is set: the
+        profiler costs real device throughput and writes to disk, so it
+        must be an explicit operator opt-in."""
+        if not profile_dir:
+            h._write(
+                404,
+                b"device profiling disabled: set TPU_PROFILE_DIR\n",
+            )
+            return
+        if not jax_profile_running.acquire(blocking=False):
+            h._write(429, b"a device profile is already running; retry later\n")
+            return
+        try:
+            query = urllib.parse.parse_qs(urllib.parse.urlparse(h.path).query)
+            try:
+                ms = min(float(query.get("ms", ["100"])[0]), 30_000.0)
+            except ValueError as e:
+                h._write(400, f"bad query parameter: {e}\n".encode())
+                return
+            import jax
+
+            try:
+                jax.profiler.start_trace(profile_dir)
+                time.sleep(max(0.0, ms) / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+            h._write(
+                200,
+                json.dumps(
+                    {"profile_dir": profile_dir, "ms": ms}
+                ).encode(),
+                content_type="application/json",
+            )
+        except Exception as e:  # noqa: BLE001 - profiling must not crash serving
+            h._write(500, f"device profile failed: {e}\n".encode())
+        finally:
+            jax_profile_running.release()
 
     # One profile at a time (pprof semantics): N concurrent sampling loops
     # would each poll sys._current_frames() under the GIL, multiplying the
@@ -423,5 +492,7 @@ def new_debug_server(
     server.add_get("/debug/pprof/profile", handle_profile)
     server.add_get("/debug/pprof/heap", handle_heap)
     server.add_get("/debug/traces", handle_traces)
+    server.add_get("/debug/journeys", handle_journeys)
+    server.add_get("/debug/profile", handle_jax_profile)
     server.add_get("/", handle_index)
     return server

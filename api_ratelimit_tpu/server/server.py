@@ -45,6 +45,7 @@ class Server:
         grpc_max_workers: int = 32,
         enable_metrics: bool = True,
         deadline_propagation: bool = True,
+        profile_dir: str = "",
     ):
         self.health = HealthChecker()
         self.stats_store = stats_store
@@ -71,7 +72,11 @@ class Server:
         add_healthcheck(self.http, self.health)
 
         self.debug = new_debug_server(
-            host, debug_port, stats_store, enable_metrics=enable_metrics
+            host,
+            debug_port,
+            stats_store,
+            enable_metrics=enable_metrics,
+            profile_dir=profile_dir,
         )
 
         self._stopped = threading.Event()
@@ -203,4 +208,5 @@ def new_server(settings, stats_store) -> Server:
         stats_store=stats_store,
         enable_metrics=settings.debug_metrics_enabled,
         deadline_propagation=settings.overload_deadline_propagation,
+        profile_dir=settings.tpu_profile_dir,
     )

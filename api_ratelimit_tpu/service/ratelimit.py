@@ -36,6 +36,7 @@ from ..models.config import ConfigError, RateLimit
 from ..models.descriptors import RateLimitRequest
 from ..models.response import Code, DescriptorStatus, DoLimitResponse, HeaderValue
 from ..tracing import active_span
+from ..tracing import journeys
 from ..utils import deadline as request_deadline
 from ..utils.sampler import BurstSampler, RandomSampler, Sampler
 from ..utils.timeutil import TimeSource
@@ -210,14 +211,32 @@ class RateLimitService:
         A request that falls in the top (overflow) bucket attaches its
         trace id as an exemplar and force-samples the active span, so the
         p99 tail in /metrics links straight to a per-stage span breakdown
-        in /debug/traces."""
+        in /debug/traces. When a journey recorder is registered
+        (tracing/journeys.py) the request's stage itinerary is recorded
+        here too, and tail-sampled by outcome into /debug/journeys."""
         t_start = time.perf_counter()
+        journey = None
+        recorder = journeys.global_recorder()
+        if recorder is not None:
+            span0 = active_span()
+            if span0 is not None:
+                ctx = span0.context
+                journey = recorder.begin(
+                    "request", trace_id=ctx.trace_id, span_id=ctx.span_id
+                )
+            else:
+                journey = recorder.begin("request")
+        journey_flag = None
+        overall_code = None
         try:
-            return self._worker(request)
+            result = self._worker(request)
+            overall_code = result[0]
+            return result
         except DeadlineExceededError as e:
             # Shed, not a backend failure: no redis_error — the drop is
             # counted in overload.deadline_expired where it happened. The
             # transport maps this to DEADLINE_EXCEEDED / 504.
+            journey_flag = journeys.FLAG_DEADLINE
             span = active_span()
             if span is not None:
                 span.set_error(e)
@@ -226,18 +245,21 @@ class RateLimitService:
             # unavailable-posture shed (or no controller wired): surfaces
             # as UNAVAILABLE / 503; counted in overload.shed at the shed
             # decision, never as redis_error
+            journey_flag = journeys.FLAG_SHED
             span = active_span()
             if span is not None:
                 span.set_error(e)
             raise
         except CacheError as e:
             self._stats.redis_error.add(1)
+            journey_flag = journeys.FLAG_FAULT
             span = active_span()
             if span is not None:
                 span.set_error(e)
             raise
         except ServiceError as e:
             self._stats.service_error.add(1)
+            journey_flag = journeys.FLAG_FAULT
             span = active_span()
             if span is not None:
                 span.set_error(e)
@@ -249,6 +271,7 @@ class RateLimitService:
             # unexpected bug-class exception bypasses the error counters
             # the dashboards alert on.
             self._stats.service_error.add(1)
+            journey_flag = journeys.FLAG_FAULT
             span = active_span()
             if span is not None:
                 span.set_error(e)
@@ -265,6 +288,11 @@ class RateLimitService:
                     exemplar = f"{span.context.trace_id:032x}"
                     span.force_sample()
             self._stats.latency.record(ms, exemplar=exemplar)
+            if journey is not None:
+                flags = [journey_flag] if journey_flag else []
+                if overall_code == Code.OVER_LIMIT:
+                    flags.append(journeys.FLAG_OVER_LIMIT)
+                recorder.finish(journey, ms, flags)
 
     def _worker(
         self, request: RateLimitRequest
@@ -439,6 +467,10 @@ class RateLimitService:
         share response semantics, they just trigger on different causes."""
         overload = self._overload
         overload.note_shed(error)
+        # allow/deny postures answer without raising, so the journey's
+        # shed flag must be noted here (the unavailable posture re-raises
+        # and gets flagged at the should_rate_limit boundary)
+        journeys.note_flag(journeys.FLAG_SHED)
         span = active_span()
         if span is not None:
             span.log_kv(

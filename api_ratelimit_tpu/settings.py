@@ -119,6 +119,25 @@ class Settings:
     # contract HOST_FAST_PATH set. Windowed mode only (TPU_BATCH_WINDOW
     # > 0); direct mode ignores it.
     dispatch_loop: bool = True
+    # on-demand jax.profiler capture directory: GET /debug/profile?ms=N on
+    # the debug port traces the device/owner loop into this directory
+    # (TensorBoard/Perfetto-viewable). Empty (the default) leaves the
+    # endpoint disabled — profiling costs throughput and writes to disk.
+    tpu_profile_dir: str = ""
+    # --- journey flight recorder (tracing/journeys.py) ---
+    # record every request's stage itinerary (publish/take/pack/launch/
+    # redeem/scatter) into per-thread rings and tail-sample the outliers
+    # (slow / shed / deadline / fault / over-limit) into a retained buffer
+    # exported at GET /debug/journeys and dumped on SIGUSR2. false removes
+    # the recorder entirely (the zero-cost rollback).
+    journey_recorder_enabled: bool = True
+    # promote journeys slower than this many ms; 0 (default) tracks the
+    # live p99 estimate instead
+    journey_slow_ms: float = 0.0
+    # bound of the retained (tail-sampled) journey buffer
+    journey_retain: int = 256
+    # per-thread recent-journey ring size
+    journey_ring: int = 64
     # BACKEND_TYPE=tpu-sidecar: address of the device-owner process
     # (cmd/sidecar_cmd.py) — a unix socket path for same-host frontends, or
     # tcp://host:port / tls://host:port for frontends on other hosts (the
@@ -310,6 +329,25 @@ class Settings:
             )
         return directory, interval, stale if stale > 0 else 3.0 * interval
 
+    def journey_config(self) -> tuple[bool, float, int, int]:
+        """Validated (enabled, slow_ms, retain, ring) for the journey
+        flight recorder. Junk fails the boot like every other knob — a
+        typo'd buffer size must not silently become 'no tail capture'."""
+        slow_ms = float(self.journey_slow_ms)
+        retain = int(self.journey_retain)
+        ring = int(self.journey_ring)
+        if slow_ms < 0:
+            raise ValueError(
+                f"JOURNEY_SLOW_MS must be >= 0, got {slow_ms}"
+            )
+        if retain <= 0:
+            raise ValueError(
+                f"JOURNEY_RETAIN must be > 0, got {retain}"
+            )
+        if ring <= 0:
+            raise ValueError(f"JOURNEY_RING must be > 0, got {ring}")
+        return bool(self.journey_recorder_enabled), slow_ms, retain, ring
+
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
         ValueError on junk — a typo'd chaos spec must fail the boot, not
@@ -382,6 +420,11 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("tpu_buckets", "TPU_BUCKETS", str),
     ("host_fast_path", "HOST_FAST_PATH", _parse_bool),
     ("dispatch_loop", "DISPATCH_LOOP", _parse_bool),
+    ("tpu_profile_dir", "TPU_PROFILE_DIR", str),
+    ("journey_recorder_enabled", "JOURNEY_RECORDER_ENABLED", _parse_bool),
+    ("journey_slow_ms", "JOURNEY_SLOW_MS", float),
+    ("journey_retain", "JOURNEY_RETAIN", int),
+    ("journey_ring", "JOURNEY_RING", int),
     ("sidecar_socket", "SIDECAR_SOCKET", str),
     ("sidecar_socket_mode", "SIDECAR_SOCKET_MODE", lambda raw: int(raw, 8)),
     ("sidecar_tls_cert", "SIDECAR_TLS_CERT", str),

@@ -105,6 +105,10 @@ class Span:
     duration: float = 0.0  # monotonic-clock delta, immune to NTP steps
     tags: dict = field(default_factory=dict)
     logs: list = field(default_factory=list)  # [(timestamp, {k: v})]
+    # followsFrom references (OpenTracing) / span links (OTel): contexts
+    # this span is CAUSALLY related to without being their child — the
+    # dispatch.batch span links every request span it coalesced
+    links: list = field(default_factory=list)  # [SpanContext]
     # force_sample() sets this: a span the SERVICE decided must be kept
     # (slow-request tail capture) even when B3 said sampled=0
     forced_sample: bool = False
@@ -113,6 +117,11 @@ class Span:
 
     def set_tag(self, key: str, value) -> "Span":
         self.tags[key] = value
+        return self
+
+    def add_link(self, context: SpanContext) -> "Span":
+        """Attach a followsFrom reference to another span's context."""
+        self.links.append(context)
         return self
 
     def set_error(self, err=None) -> "Span":
@@ -154,7 +163,7 @@ class Span:
         self.finish()
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "operation_name": self.operation_name,
             "trace_id": f"{self.context.trace_id:032x}",
             "span_id": f"{self.context.span_id:016x}",
@@ -167,6 +176,15 @@ class Span:
                 for ts, fields in self.logs
             ],
         }
+        if self.links:
+            out["links"] = [
+                {
+                    "trace_id": f"{c.trace_id:032x}",
+                    "span_id": f"{c.span_id:016x}",
+                }
+                for c in self.links
+            ]
+        return out
 
 
 _active_span: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
@@ -214,6 +232,7 @@ class Tracer:
         operation_name: str,
         child_of: "Span | SpanContext | None" = None,
         tags: dict | None = None,
+        links=None,
     ) -> Span:
         parent_ctx = (
             child_of.context if isinstance(child_of, Span) else child_of
@@ -236,8 +255,31 @@ class Tracer:
             parent_id=parent_id,
             start_time=time.time(),
             tags=dict(tags) if tags else {},
+            links=list(links) if links else [],
             _mono_start=time.monotonic(),
         )
+
+    def record_span(
+        self,
+        operation_name: str,
+        child_of: "Span | SpanContext | None",
+        start_time: float,
+        duration: float,
+        tags: dict | None = None,
+    ) -> Span:
+        """Record an already-elapsed interval as a finished span — how the
+        dispatch frontend closes its request span with real per-stage child
+        spans (ring_wait/pack/launch/redeem) reconstructed from the owner
+        thread's timestamps after the ticket is redeemed."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        span = self.start_span(operation_name, child_of=child_of, tags=tags)
+        span.start_time = start_time
+        span.finish_time = start_time + duration
+        span.duration = max(0.0, duration)
+        span._finished = True
+        self._on_finish(span)
+        return span
 
     @property
     def enabled(self) -> bool:
@@ -274,6 +316,9 @@ class _NoopSpan(Span):
 
     def log_kv(self, **fields):
         return self
+
+    def add_link(self, context):
+        return self  # never mutate the shared singleton
 
     def force_sample(self):
         return self  # never mutate the shared singleton

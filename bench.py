@@ -54,6 +54,12 @@ Artifact field guide (round 5 additions):
                                   snapshots that landed mid-drive — the
                                   "no measurable p99 regression" budget
                                   for the quiesce-and-copy design
+  service.tracing_overhead_pct    flat_per_second only: rate loss with the
+                                  tracer (every request spanned) AND the
+                                  journey flight recorder on vs the
+                                  shipped disabled path — the enabled
+                                  cost of end-to-end journey tracing,
+                                  measured not asserted
   engine.sharded.{rate,rate_pipelined,rate_replicated,rate_single_device}
                                   cold-block sharded rows; host_cpus says
                                   whether the mesh could physically
@@ -632,12 +638,17 @@ def _requests_for(config_key: str, n: int):
     return reqs
 
 
-def _drive_service(service, reqs, n_threads: int, per_thread: int):
+def _drive_service(service, reqs, n_threads: int, per_thread: int, tracer=None):
     """Shared request driver: N threads each issuing per_thread requests
     round-robin over their slice of reqs, capturing per-request latency.
+    tracer (the tracing_overhead_pct arm) wraps each request in an active
+    server-style span, so the drive pays the full instrumented path —
+    span allocation, ring ctx, batch spans, stage child spans.
     Returns (total requests, elapsed seconds, latency list in ms)."""
     lat: list[float] = []
     lat_lock = threading.Lock()
+    if tracer is not None:
+        from api_ratelimit_tpu.tracing import activate
 
     def worker(tid: int) -> int:
         my = reqs[tid::n_threads]
@@ -645,7 +656,13 @@ def _drive_service(service, reqs, n_threads: int, per_thread: int):
         for i in range(per_thread):
             r = my[i % len(my)]
             s = time.perf_counter()
-            service.should_rate_limit(r)
+            if tracer is None:
+                service.should_rate_limit(r)
+            else:
+                with tracer.start_span("bench.request") as span, activate(
+                    span
+                ):
+                    service.should_rate_limit(r)
             local.append((time.perf_counter() - s) * 1e3)
         with lat_lock:
             lat.extend(local)
@@ -822,6 +839,7 @@ def bench_service(
     measure_snapshot_overhead: bool = False,
     measure_host_path_overhead: bool = False,
     measure_dispatch_overhead: bool = False,
+    measure_tracing_overhead: bool = False,
 ) -> dict:
     """One service-level scenario: threads driving should_rate_limit through
     the micro-batched TPU backend. Per-stage timings come from the runtime
@@ -848,7 +866,15 @@ def bench_service(
     measure_dispatch_overhead: drive the same scenario once more with
     DISPATCH_LOOP pinned off (leader-collects batcher, the rollback arm)
     and record rate_leader_collects + dispatch_loop_overhead_pct — what
-    the pre-loop dispatch path gives up relative to the shipped one."""
+    the pre-loop dispatch path gives up relative to the shipped one.
+
+    measure_tracing_overhead: drive the same scenario once more with the
+    tracer (RecordingTracer, every request spanned) AND the journey
+    flight recorder on, and record rate_tracing_on +
+    tracing_overhead_pct. The primary rate measures the disabled path
+    (NoopTracer, no recorder — the allocation-free default), so the
+    artifact carries both the zero-cost-when-disabled claim and the
+    enabled cost as measurements, not assertions."""
     # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
     # parallel workers (test/redis/bench_test.go); oversubscribing a small
     # box measures queueing, not the service (8 threads on the 1-core bench
@@ -950,6 +976,44 @@ def bench_service(
             # how much of the shipped rate the pre-loop dispatch gives up
             result["dispatch_loop_overhead_pct"] = round(
                 (1.0 - rate_d / result["rate"]) * 100.0, 2
+            )
+    if measure_tracing_overhead:
+        from api_ratelimit_tpu.tracing import (
+            RecordingTracer,
+            reset_global_tracer,
+            set_global_tracer,
+        )
+        from api_ratelimit_tpu.tracing.journeys import (
+            JourneyRecorder,
+            set_global_recorder,
+        )
+
+        service_t, cache_t, _store_t = _build_service(
+            config_key, yaml_text, telemetry=True, on_tpu=on_tpu
+        )
+        tracer = RecordingTracer(max_spans=512)
+        set_global_tracer(tracer)
+        set_global_recorder(JourneyRecorder())
+        try:
+            for r in reqs[:32]:
+                service_t.should_rate_limit(r)
+            total_t, elapsed_t, lat_t = _drive_service(
+                service_t, reqs, n_threads, per_thread, tracer=tracer
+            )
+        finally:
+            set_global_recorder(None)
+            reset_global_tracer()
+        cache_t.close()
+        rate_t = total_t * decisions_per_request / elapsed_t
+        result["rate_tracing_on"] = round(rate_t)
+        result["p99_tracing_on_ms"] = round(
+            float(np.percentile(lat_t, 99)), 3
+        )
+        if result["rate"] > 0:
+            # the ENABLED cost: what full journey tracing (spans + flight
+            # recorder) gives up relative to the shipped disabled path
+            result["tracing_overhead_pct"] = round(
+                (1.0 - rate_t / result["rate"]) * 100.0, 2
             )
     if measure_snapshot_overhead:
         import tempfile
@@ -1658,6 +1722,12 @@ def main() -> None:
                 # leader-collects A/B: records the dispatch-loop win
                 # (dispatch_loop_overhead_pct) in every artifact
                 measure_dispatch_overhead=(
+                    key == "flat_per_second" and left() > 100
+                ),
+                # journey tracing A/B: tracer + flight recorder on vs the
+                # shipped disabled path (tracing_overhead_pct) — the
+                # zero-cost-when-disabled claim stays a measurement
+                measure_tracing_overhead=(
                     key == "flat_per_second" and left() > 100
                 ),
             )

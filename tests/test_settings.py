@@ -111,6 +111,44 @@ class TestSettings:
         with pytest.raises(ValueError, match="DISPATCH_LOOP"):
             new_settings({"DISPATCH_LOOP": "sideways"})
 
+    def test_journey_knobs(self):
+        s = new_settings(
+            {
+                "JOURNEY_RECORDER_ENABLED": "false",
+                "JOURNEY_SLOW_MS": "25.5",
+                "JOURNEY_RETAIN": "512",
+                "JOURNEY_RING": "32",
+            }
+        )
+        assert s.journey_recorder_enabled is False
+        assert s.journey_slow_ms == pytest.approx(25.5)
+        assert s.journey_retain == 512
+        assert s.journey_ring == 32
+        assert s.journey_config() == (False, 25.5, 512, 32)
+
+    def test_journey_defaults(self):
+        s = new_settings({})
+        # recorder on, live-p99 slow threshold, bounded buffers
+        assert s.journey_config() == (True, 0.0, 256, 64)
+        assert s.tpu_profile_dir == ""  # /debug/profile disabled
+
+    def test_journey_junk_fails_boot(self):
+        with pytest.raises(ValueError, match="JOURNEY_SLOW_MS"):
+            new_settings({"JOURNEY_SLOW_MS": "-1"}).journey_config()
+        with pytest.raises(ValueError, match="JOURNEY_RETAIN"):
+            new_settings({"JOURNEY_RETAIN": "0"}).journey_config()
+        with pytest.raises(ValueError, match="JOURNEY_RING"):
+            new_settings({"JOURNEY_RING": "-4"}).journey_config()
+        # non-numeric junk fails at parse time, like every other knob
+        with pytest.raises(ValueError, match="JOURNEY_RETAIN"):
+            new_settings({"JOURNEY_RETAIN": "many"})
+        with pytest.raises(ValueError, match="JOURNEY_RECORDER_ENABLED"):
+            new_settings({"JOURNEY_RECORDER_ENABLED": "maybe"})
+
+    def test_tpu_profile_dir_knob(self):
+        s = new_settings({"TPU_PROFILE_DIR": "/var/tmp/tpu-traces"})
+        assert s.tpu_profile_dir == "/var/tmp/tpu-traces"
+
     def test_buckets_junk_fails_boot(self):
         for junk in ("abc", "128,xyz", "0", "-8,128", ","):
             with pytest.raises(ValueError, match="TPU_BUCKETS"):

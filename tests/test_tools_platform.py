@@ -47,6 +47,77 @@ def test_tool_runs_on_cpu_when_pinned(mod, extra):
     assert json.loads(lines[-1])["platform"] == "cpu"
 
 
+class TestJourneyReport:
+    """tools/journey_report.py smoke (tier-1, jax-free): it must render a
+    /debug/journeys capture into the per-stage table and --json form."""
+
+    def _sample_doc(self):
+        base = 1_000_000_000
+        journeys = []
+        for i, (dur, flags) in enumerate(
+            [(12.0, ["slow"]), (3.0, ["over_limit"]), (40.0, ["fault", "slow"])]
+        ):
+            journeys.append(
+                {
+                    "kind": "request",
+                    "trace_id": f"{i + 1:032x}",
+                    "flags": flags,
+                    "duration_ms": dur,
+                    "start_ns": base,
+                    "stages": {
+                        "publish": base + 100_000,
+                        "take": base + 400_000,
+                        "pack": base + 450_000,
+                        "launch": base + 900_000,
+                        "redeem": base + int(dur * 1e6),
+                        "scatter": base + int(dur * 1e6) + 50_000,
+                    },
+                    "thread": f"worker-{i}",
+                }
+            )
+        return {"enabled": True, "live_p99_ms": 38.5, "retained": journeys}
+
+    def _write_doc(self, tmp_path):
+        import json
+
+        path = tmp_path / "journeys.json"
+        path.write_text(json.dumps(self._sample_doc()))
+        return str(path)
+
+    def test_text_report(self, tmp_path):
+        proc = _run_tool(
+            "tools.journey_report", (self._write_doc(tmp_path), "--top", "2")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        out = proc.stdout
+        assert "[journeys] retained=3" in out
+        for stage in ("publish", "take", "pack", "launch", "redeem", "scatter"):
+            assert stage in out
+        assert "top 2 slowest" in out
+        assert "fault,slow" in out  # slowest journey's flags render
+
+    def test_json_report(self, tmp_path):
+        import json
+
+        proc = _run_tool(
+            "tools.journey_report", (self._write_doc(tmp_path), "--json")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        report = json.loads(proc.stdout)
+        assert report["journeys"] == 3
+        assert report["stages"]["publish"]["count"] == 3
+        # slowest first, with per-stage ms deltas
+        assert report["slowest"][0]["duration_ms"] == 40.0
+        assert report["slowest"][0]["stage_ms"]["take"] > 0
+
+    def test_bad_input_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "nope.json"
+        bad.write_text("{not json")
+        proc = _run_tool("tools.journey_report", (str(bad),))
+        assert proc.returncode == 1
+        assert "cannot read" in proc.stderr
+
+
 class TestHotpathProfile:
     """tools/hotpath_profile.py smoke (tier-1, not slow): it must run the
     flat_per_second loop under cProfile and emit a parseable table."""

@@ -351,6 +351,104 @@ class TestServiceInstrumentation:
         assert resp.throttle_millis == 250  # not throttled server-side
 
 
+class TestDoLimitErrorTagAudit:
+    """The backend do_limit spans must carry the error tag on exception
+    paths (QueueFullError, DeadlineExceededError, CacheError) — not just
+    success-path log events (the PR-7 span audit)."""
+
+    def _tpu_cache(self, engine):
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+        from api_ratelimit_tpu.utils import FakeTimeSource
+
+        base = BaseRateLimiter(FakeTimeSource(1_000_000), jitter_rand=None)
+        return TpuRateLimitCache(base, engine=engine)
+
+    def _request_and_limit(self, test_store):
+        from api_ratelimit_tpu.models import (
+            Descriptor,
+            RateLimitRequest,
+            Unit,
+        )
+        from api_ratelimit_tpu.models.config import (
+            RateLimit,
+            new_rate_limit_stats,
+        )
+        from api_ratelimit_tpu.models.response import RateLimitValue
+
+        store, _ = test_store
+        limit = RateLimit(
+            full_key="k_v",
+            stats=new_rate_limit_stats(store, "k_v"),
+            limit=RateLimitValue(requests_per_unit=5, unit=Unit.MINUTE),
+        )
+        request = RateLimitRequest(
+            domain="d", descriptors=(Descriptor.of(("k", "v")),)
+        )
+        return request, limit
+
+    @pytest.mark.parametrize(
+        "exc_type",
+        ["QueueFullError", "DeadlineExceededError", "CacheError"],
+    )
+    def test_tpu_do_limit_exception_tags_error(self, test_store, exc_type):
+        from api_ratelimit_tpu.backends.overload import QueueFullError
+        from api_ratelimit_tpu.limiter.cache import (
+            CacheError,
+            DeadlineExceededError,
+        )
+
+        exc_cls = {
+            "QueueFullError": QueueFullError,
+            "DeadlineExceededError": DeadlineExceededError,
+            "CacheError": CacheError,
+        }[exc_type]
+
+        class BoomEngine:
+            def submit(self, items):
+                raise exc_cls("boom")
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        cache = self._tpu_cache(BoomEngine())
+        request, limit = self._request_and_limit(test_store)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        with pytest.raises(exc_cls):
+            with tracer.start_span("rpc") as span, activate(span):
+                cache.do_limit(request, [limit])
+        (got,) = tracer.finished_spans()
+        assert got.tags.get("error") is True
+        assert got.tags.get("backend") == "tpu"
+        assert any(f.get("event") == "error" for _, f in got.logs)
+
+    def test_redis_do_limit_exception_tags_error(self, test_store):
+        from api_ratelimit_tpu.backends.redis import RedisRateLimitCache
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+        from api_ratelimit_tpu.limiter.cache import CacheError
+        from api_ratelimit_tpu.utils import FakeTimeSource
+
+        class BoomClient:
+            def pipe_do(self, cmds):
+                raise CacheError("redis down")
+
+        base = BaseRateLimiter(FakeTimeSource(1_000_000), jitter_rand=None)
+        cache = RedisRateLimitCache(BoomClient(), base)
+        request, limit = self._request_and_limit(test_store)
+        tracer = RecordingTracer()
+        set_global_tracer(tracer)
+        with pytest.raises(CacheError):
+            with tracer.start_span("rpc") as span, activate(span):
+                cache.do_limit(request, [limit])
+        (got,) = tracer.finished_spans()
+        assert got.tags.get("error") is True
+        assert got.tags.get("backend") == "redis"
+
+
 class TestZipkinExport:
     """Spans must land at a real (local) zipkin-compatible HTTP collector
     as valid v2 JSON (VERDICT round 1: a wire exporter, not just the
