@@ -158,7 +158,15 @@ class FallbackLimiter:
         base_limiter: BaseRateLimiter | None = None,
         scope=None,
         local_max_keys: int = 1 << 16,
+        lease_table=None,
     ):
+        """lease_table: optional backends.lease.LeaseTable. When set, every
+        descriptor is first offered to its outstanding lease (the device
+        owner granted real budget for it before going dark) and only the
+        remainder is answered by the configured rung — so an outage
+        degrades lease-by-lease as TTLs run out instead of flipping the
+        whole instance to the rung at once. An expired/exhausted lease
+        falls through to the rung exactly like the fail-open contract."""
         if mode not in FAILURE_MODES:
             raise ValueError(
                 f"failure mode must be one of {FAILURE_MODES}, got {mode!r}"
@@ -174,6 +182,7 @@ class FallbackLimiter:
             self._local = MemoryRateLimitCache(
                 base_limiter, max_keys=local_max_keys
             )
+        self._lease = lease_table
         self._lock = threading.Lock()
         self._degraded = False
         self._reason = ""
@@ -230,10 +239,50 @@ class FallbackLimiter:
                 error,
                 self.mode,
             )
+        # Lease-backed degradation (backends/lease.py): descriptors whose
+        # (key, window) still holds an outstanding lease are served from
+        # that REAL granted budget — the device owner reserved it before
+        # going dark — and only the remainder degrades to the rung. The
+        # hits_addend consumed here matches what the primary path would
+        # have consumed, so recovery continues the same counter.
+        lease_statuses: dict[int, DescriptorStatus] = {}
+        lease_response = DoLimitResponse()
+        if self._lease is not None:
+            hits_addend = max(1, request.hits_addend)
+            for i, descriptor in enumerate(request.descriptors):
+                limit = limits[i] if i < len(limits) else None
+                if limit is None:
+                    continue
+                status = self._lease.consume_for_fallback(
+                    request.domain,
+                    descriptor,
+                    limit,
+                    hits_addend,
+                    lease_response,
+                )
+                if status is not None:
+                    lease_statuses[i] = status
+
         if self.mode == FAILURE_MODE_DEGRADED:
             if self._c_local is not None:
                 self._c_local.inc()
-            return self._local.do_limit(request, limits)
+            if not lease_statuses:
+                return self._local.do_limit(request, limits)
+            # lease-served positions are masked out of the local limiter
+            # (their hits must not double-count into its approximation)
+            masked = [
+                None
+                if i in lease_statuses or i >= len(limits)
+                else limits[i]
+                for i in range(len(request.descriptors))
+            ]
+            response = self._local.do_limit(request, masked)
+            for i, status in lease_statuses.items():
+                response.descriptor_statuses[i] = status
+            response.throttle_millis = max(
+                response.throttle_millis, lease_response.throttle_millis
+            )
+            return response
         if self.mode == FAILURE_MODE_DENY:
             if self._c_deny is not None:
                 self._c_deny.inc()
@@ -244,6 +293,10 @@ class FallbackLimiter:
             code = Code.OK
         statuses = []
         for i in range(len(request.descriptors)):
+            status = lease_statuses.get(i)
+            if status is not None:
+                statuses.append(status)
+                continue
             limit = limits[i] if i < len(limits) else None
             statuses.append(
                 DescriptorStatus(
@@ -252,4 +305,5 @@ class FallbackLimiter:
                     limit_remaining=0,
                 )
             )
-        return DoLimitResponse(descriptor_statuses=statuses)
+        lease_response.descriptor_statuses = statuses
+        return lease_response

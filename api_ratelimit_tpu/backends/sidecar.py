@@ -29,8 +29,17 @@ connection; frontends pool connections for concurrency):
   request:  u32 magic 'RLSC' | u8 version=1 | u8 op | u16 flags
             op 1 SUBMIT: u32 n | uint32[6, n] C-order
                          rows: fp_lo, fp_hi, hits, limit, divider, jitter
+                         flags bit 1 (FLAG_LEASE): a lease-ops trailer
+                         follows the block — u32 len | the LeaseOps body
+                         (backends/lease.py encode_lease_ops: grant/renew
+                         riders referencing block columns plus settle
+                         records), read BEFORE the trace trailer. The
+                         grants' INCRBY is already in the hits column;
+                         the trailer is the liability bookkeeping the
+                         device owner registers after the launch.
                          flags bit 0 (FLAG_TRACE): a B3 trace trailer
-                         follows the block — u32 len | the TextMap carrier
+                         follows (after the lease trailer when both) —
+                         u32 len | the TextMap carrier
                          (tracing/propagation.py inject, newline-joined
                          `header:value` lines), so the frontend-process
                          span parents the device-owner-process spans
@@ -100,10 +109,15 @@ MAGIC = 0x524C5343  # 'RLSC'
 VERSION = 1
 OP_SUBMIT = 1
 OP_PING = 2
-# header flags (the u16 after op): bit 0 = B3 trace trailer appended
+# header flags (the u16 after op): bit 0 = B3 trace trailer appended,
+# bit 1 = lease-ops trailer appended (before the trace trailer)
 FLAG_TRACE = 1
+FLAG_LEASE = 2
 # sanity cap on the trace trailer — B3 TextMap is ~90 bytes
 MAX_TRACE_TRAILER = 1024
+# sanity cap on the lease trailer (a request carries a handful of grant/
+# settle records; 64 KiB is ~4k records)
+MAX_LEASE_TRAILER = 1 << 16
 
 _HDR = struct.Struct("<IBBH")  # magic, version, op, reserved
 _U32 = struct.Struct("<I")
@@ -324,6 +338,23 @@ class SlabSidecarServer:
                         )
                         return
                     payload = n_raw + _recv_exact(conn, ITEM_ROWS * n * 4)
+                    lease_blob = None
+                    if hdr_flags & FLAG_LEASE:
+                        # lease-ops trailer: read BEFORE fault handling so
+                        # the frame stays wire-coherent; decoded (and
+                        # validated) only after the engine answered
+                        (blob_len,) = _U32.unpack(
+                            _recv_exact(conn, _U32.size)
+                        )
+                        if blob_len > MAX_LEASE_TRAILER:
+                            conn.sendall(
+                                self._error(
+                                    f"lease trailer {blob_len} exceeds "
+                                    f"cap {MAX_LEASE_TRAILER}"
+                                )
+                            )
+                            return
+                        lease_blob = _recv_exact(conn, blob_len)
                     wire_ctx = None
                     if hdr_flags & FLAG_TRACE:
                         # B3 trace trailer: read it BEFORE any fault
@@ -406,6 +437,14 @@ class SlabSidecarServer:
                                     decode_items(payload)
                                 )
                         out = np.asarray(afters, dtype=np.uint32)
+                        if lease_blob is not None:
+                            # register the frame's lease liabilities with
+                            # the launch's post-increment counters as
+                            # floors; a malformed trailer is an error
+                            # reply, never a crash (the increments are
+                            # already applied — same posture as any
+                            # post-launch application error)
+                            self._apply_lease_blob(lease_blob, payload, out)
                         # close the span/journey BEFORE the reply hits the
                         # wire: once the client sees the response, this
                         # request's server-side trace must already exist
@@ -442,6 +481,19 @@ class SlabSidecarServer:
                         conn.sendall(self._error(str(e)))
         except (ConnectionError, OSError):
             return  # frontend went away
+
+    def _apply_lease_blob(
+        self, lease_blob: bytes, payload: bytes, out: np.ndarray
+    ) -> None:
+        """Decode one frame's lease trailer and register it against the
+        engine's liability registry (engines without one ignore lease
+        traffic — exotic test engines)."""
+        apply_ops = getattr(self._engine, "apply_lease_ops", None)
+        if apply_ops is None:
+            return
+        from .lease import decode_lease_ops
+
+        apply_ops(decode_block(payload), out, decode_lease_ops(lease_blob))
 
     @staticmethod
     def _error(message: str) -> bytes:
@@ -695,19 +747,32 @@ class SidecarEngineClient:
             return []
         return self._submit_payload(encode_items(items)).tolist()
 
-    def submit_rows(self, block: np.ndarray) -> np.ndarray:
+    def submit_rows(
+        self, block: np.ndarray, lease_ops=None
+    ) -> np.ndarray:
         """Zero-object verb: the uint32[6, n] row block IS the wire layout,
         so the request frame is one header + one buffer copy — no per-item
-        encode at all."""
+        encode at all. lease_ops (backends/lease.py LeaseOps) rides the
+        frame as the FLAG_LEASE trailer: the grants' INCRBY is already in
+        the hits column, the trailer is the liability bookkeeping the
+        device owner registers after the launch."""
         n = block.shape[1]
         if n == 0:
             return np.empty(0, dtype=np.uint32)
         payload = _U32.pack(n) + np.ascontiguousarray(
             block, dtype=np.uint32
         ).tobytes()
-        return self._submit_payload(payload)
+        extra_flags = 0
+        if lease_ops is not None and (lease_ops.grants or lease_ops.settles):
+            from .lease import encode_lease_ops
 
-    def _submit_payload(self, payload: bytes) -> np.ndarray:
+            payload += encode_lease_ops(lease_ops)
+            extra_flags = FLAG_LEASE
+        return self._submit_payload(payload, extra_flags)
+
+    def _submit_payload(
+        self, payload: bytes, extra_flags: int = 0
+    ) -> np.ndarray:
         t0 = time.perf_counter() if self._h_rpc is not None else 0.0
         if not self._breaker.allow():
             raise CacheError(
@@ -721,7 +786,7 @@ class SidecarEngineClient:
         # and ship zero extra bytes.
         parent = active_span()
         rpc_span = None
-        hdr_flags = 0
+        hdr_flags = extra_flags
         trailer = b""
         if parent is not None and parent.tracer is not None:
             rpc_span = parent.tracer.start_span(
@@ -731,7 +796,7 @@ class SidecarEngineClient:
             )
             raw = encode_textmap(rpc_span.context)
             trailer = _U32.pack(len(raw)) + raw
-            hdr_flags = FLAG_TRACE
+            hdr_flags |= FLAG_TRACE
         request = (
             _HDR.pack(MAGIC, VERSION, OP_SUBMIT, hdr_flags)
             + payload
@@ -845,7 +910,8 @@ class SidecarEngineClient:
 
 
 def new_sidecar_cache_from_settings(
-    settings, base_limiter, stats_scope=None, fault_injector=None
+    settings, base_limiter, stats_scope=None, fault_injector=None,
+    lease_table=None,
 ):
     """BACKEND_TYPE=tpu-sidecar factory: a TpuRateLimitCache whose device
     driver is the remote sidecar (runner.py backend switch)."""
@@ -853,6 +919,7 @@ def new_sidecar_cache_from_settings(
 
     return TpuRateLimitCache(
         base_limiter,
+        lease_table=lease_table,
         engine=SidecarEngineClient(
             settings.sidecar_socket,
             tls_ca=settings.sidecar_tls_ca,

@@ -58,6 +58,7 @@ from ..ops.slab import (
 )
 from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
+from .lease import LeaseOps, LeaseRegistry, apply_lease_ops
 from .overload import SlabSaturatedError
 
 _log = logging.getLogger(__name__)
@@ -291,6 +292,12 @@ class SlabDeviceEngine:
                 fault_injector=fault_injector,
                 max_queue=max_queue,
             )
+        # Device-owner lease liability registry (backends/lease.py): who
+        # holds how much un-settled leased budget, and the counter
+        # watermark each restored slab row must respect. Always built —
+        # inert (empty) until lease traffic arrives; the snapshotter
+        # persists it as leases.snap so a warm restart never double-grants.
+        self.lease_registry = LeaseRegistry(time_source)
         # (bucket, readback dtype name) -> True for every launch shape
         # compiled ahead of traffic; the health/readiness test asserts the
         # ladder is covered before the server reports healthy.
@@ -451,12 +458,20 @@ class SlabDeviceEngine:
             ).tolist()
         return self._batcher.submit(_items_to_block(items)).tolist()
 
-    def submit_rows(self, block: np.ndarray) -> np.ndarray:
+    def submit_rows(
+        self, block: np.ndarray, lease_ops=None
+    ) -> np.ndarray:
         """Zero-object verb: one uint32[6, n] row block (columns fp_lo,
         fp_hi, hits, limit, divider, jitter — the sidecar wire layout) ->
         uint32[n] post-increment counters. The caller may pass a reusable
         scratch block: when the batcher doesn't consume submits (no row
-        ring configured), an owned copy decouples it here."""
+        ring configured), an owned copy decouples it here.
+
+        lease_ops: optional backends.lease.LeaseOps piggybacked on this
+        submit — grants registered against the liability registry with the
+        rows' post-increment counters as floors, settles applied. The rows'
+        INCRBY inflation is already in the hits column; this is only the
+        host-side bookkeeping."""
         if block.shape[1] == 0:
             return np.empty(0, dtype=np.uint32)
         self._check_saturated()
@@ -465,10 +480,28 @@ class SlabDeviceEngine:
             # ring, and the verdicts come back in this thread's reusable
             # ticket buffer (valid until its next submit — the row path
             # consumes them immediately)
-            return self._dispatch.submit(block, reuse_out=True)
-        if not self._batcher.consumes_submits:
-            block = np.array(block, dtype=np.uint32)
-        return self._batcher.submit(block)
+            afters = self._dispatch.submit(block, reuse_out=True)
+        else:
+            wire = block
+            if not self._batcher.consumes_submits:
+                wire = np.array(block, dtype=np.uint32)
+            afters = self._batcher.submit(wire)
+        if lease_ops is not None:
+            self.apply_lease_ops(block, afters, lease_ops)
+        return afters
+
+    def apply_lease_ops(self, block, afters, ops) -> None:
+        """Register piggybacked lease grants/settles (backends/lease.py)
+        against this engine's liability registry — called by submit_rows
+        for in-process frontends and by the sidecar server after decoding
+        a wire frame's lease trailer."""
+        apply_lease_ops(
+            self.lease_registry,
+            block,
+            afters,
+            ops,
+            int(self._time_source.unix_now()),
+        )
 
     def flush(self) -> None:
         if self._dispatch is not None:
@@ -877,6 +910,7 @@ class TpuRateLimitCache:
         fault_injector=None,
         precompile: bool = False,
         dispatch_loop: bool = True,
+        lease_table=None,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -894,7 +928,17 @@ class TpuRateLimitCache:
 
         max_queue / watermark_* / overload / fault_injector: admission-
         control wiring for the in-process engine (see SlabDeviceEngine);
-        ignored when a caller-provided engine is passed."""
+        ignored when a caller-provided engine is passed.
+
+        lease_table: optional backends.lease.LeaseTable (LEASE_ENABLED).
+        When set, do_limit_resolved plans a lease grant for each descriptor
+        that missed the frontend-local decide path: the descriptor's row
+        ships hits + lease_n (a batched INCRBY riding the normal launch),
+        the returned counter registers the lease, and the caller's own
+        decision uses after - lease_n. Queued settle records drain onto
+        the same submits. Requires an engine whose submit_rows accepts
+        lease_ops (the in-process engine and the sidecar client both do);
+        silently disabled otherwise."""
         self._base = base_limiter
         # Prewarm the native host codec so the first request never pays the
         # on-demand g++ compile inside do_limit (ops/native.py ensure_built).
@@ -926,6 +970,10 @@ class TpuRateLimitCache:
         # engine and the sidecar client both do; exotic test engines fall
         # back to the _Item conversion)
         self._submit_rows = getattr(engine, "submit_rows", None)
+        # hierarchical quota leasing (backends/lease.py): only engines with
+        # the row verb can carry the grant riders, so exotic item-only test
+        # engines quietly run unleased
+        self._lease = lease_table if self._submit_rows is not None else None
         # per-thread scratch row block: do_limit_resolved fills columns in
         # place and the batcher's row ring copies them out under its lock,
         # so the steady-state request path allocates no numpy buffers
@@ -1116,6 +1164,8 @@ class TpuRateLimitCache:
         pending_count = 0
         keys = [None] * n if local_cache is not None else None
         over_local: list[bool] | None = None
+        lease = self._lease
+        grants: list | None = None
         for i in range(n):
             rec = resolved[i]
             if rec is None:
@@ -1140,18 +1190,46 @@ class TpuRateLimitCache:
                 divider,
                 base.expiration_seconds(divider) - divider,
             )
+            if lease is not None:
+                # lease grant rider: this descriptor missed the frontend-
+                # local decide path, so its row carries the lease INCRBY —
+                # hits + lease_n through the unmodified launch machinery
+                planned = lease.plan_grant(rec, hits_addend, now)
+                if planned is not None:
+                    block[2, pending_count] = hits_addend + planned.size
+                    if grants is None:
+                        grants = []
+                    grants.append((pending_count, planned))
             pending_count += 1
         if h_key is not None:
             h_key.record((time.perf_counter() - t0) * 1e3)
+
+        lease_ops = None
+        settles = ()
+        if lease is not None and pending_count:
+            settles = lease.drain_settles()
+            if grants or settles:
+                lease_ops = LeaseOps(
+                    grants=[
+                        (pos, p.size, p.window, p.ttl_s)
+                        for pos, p in grants or ()
+                    ],
+                    settles=settles,
+                )
 
         if span is not None:
             span.log_kv(event="lookup.start", batch_items=pending_count)
         try:
             if pending_count:
                 if self._submit_rows is not None:
-                    afters = self._submit_rows(
-                        block[:, :pending_count]
-                    ).tolist()
+                    if lease_ops is not None:
+                        afters = self._submit_rows(
+                            block[:, :pending_count], lease_ops=lease_ops
+                        ).tolist()
+                    else:
+                        afters = self._submit_rows(
+                            block[:, :pending_count]
+                        ).tolist()
                 else:
                     afters = self._engine_core.submit(
                         _block_to_items(block[:, :pending_count])
@@ -1159,10 +1237,24 @@ class TpuRateLimitCache:
             else:
                 afters = ()
         except Exception as e:
+            if settles:
+                # the settle records never reached the owner; requeue for
+                # the next successful submit (advisory, TTL-bounded)
+                lease.requeue_settles(settles)
+            if grants:
+                # riders whose answer was lost: release the in-flight
+                # marks so the next miss can plan a fresh grant
+                for _pos, planned in grants:
+                    lease.abort_grant(planned)
             # see do_limit: the exception path must error-tag the span
             if span is not None:
                 span.set_error(e)
             raise
+        if grants:
+            # install each granted lease and strip its rider from the
+            # caller's own post-increment position (after - lease_n)
+            for pos, planned in grants:
+                afters[pos] = lease.register_grant(planned, afters[pos])
         if span is not None:
             span.log_kv(event="tpu.lookup.done", client="slab")
 

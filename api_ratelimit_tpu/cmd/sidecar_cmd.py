@@ -166,6 +166,16 @@ def main() -> None:
         **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
+    # Lease liability gauges (backends/lease.py): frontends with
+    # LEASE_ENABLED ship grant/settle trailers on their SUBMIT frames; the
+    # device owner tracks the outstanding budget here — the Σ budgets term
+    # of the crash-overshoot bound, and the liability section of the
+    # warm-restart snapshot.
+    from ..backends.lease import LeaseRegistryStats
+
+    store.add_stat_generator(
+        LeaseRegistryStats(engine.lease_registry, scope.scope("lease"))
+    )
 
     # Warm restart (persist/): the sidecar IS the device owner, so the
     # snapshot/restore cycle lives here — restore the shared slab before

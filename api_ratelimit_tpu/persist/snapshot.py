@@ -48,6 +48,25 @@ SNAPSHOT_VERSION = 1
 ROW_WIDTH = 8
 COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
 
+# header `flags` values: what kind of table the payload holds. 0 (the
+# pre-flag format) is a slab shard; FLAG_LEASE_TABLE marks the lease
+# liability registry (backends/lease.py export_rows — one row per
+# outstanding (fp, window) grant). The flag keeps the two table kinds from
+# masquerading as each other: both are (n, 8) uint32.
+FLAG_LEASE_TABLE = 1
+
+# Mirror of backends/lease.py's liability row layout (tests pin equality).
+LEASE_ROW_WIDTH = 8
+(
+    LEASE_COL_FP_LO,
+    LEASE_COL_FP_HI,
+    LEASE_COL_WINDOW,
+    LEASE_COL_GRANTED,
+    LEASE_COL_SETTLED,
+    LEASE_COL_FLOOR,
+    LEASE_COL_EXPIRE,
+) = range(7)
+
 _HEADER = struct.Struct("<8sIIqQIIIIQ")
 _HEADER_CRC = struct.Struct("<I")
 HEADER_SIZE = _HEADER.size + _HEADER_CRC.size  # 60 bytes
@@ -72,12 +91,13 @@ class SnapshotHeader:
     shard_count: int
     payload_crc: int
     payload_len: int
+    flags: int = 0
 
     def pack(self) -> bytes:
         head = _HEADER.pack(
             MAGIC,
             self.version,
-            0,
+            self.flags,
             self.created_at,
             self.n_slots,
             self.row_width,
@@ -97,7 +117,7 @@ def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
     (
         magic,
         version,
-        _flags,
+        flags,
         created_at,
         n_slots,
         row_width,
@@ -125,6 +145,7 @@ def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
         shard_count=shard_count,
         payload_crc=payload_crc,
         payload_len=payload_len,
+        flags=flags,
     )
     if header.payload_len != header.n_slots * header.row_width * 4:
         raise SnapshotError(
@@ -141,6 +162,7 @@ def write_snapshot(
     shard_index: int = 0,
     shard_count: int = 1,
     fault_injector=None,
+    flags: int = 0,
 ) -> int:
     """Atomically write one shard's row table; returns bytes written.
 
@@ -169,6 +191,7 @@ def write_snapshot(
         shard_count=int(shard_count),
         payload_crc=zlib.crc32(payload),
         payload_len=len(payload),
+        flags=int(flags),
     )
     if action == "corrupt":
         mutated = bytearray(payload)
@@ -289,3 +312,61 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
         "dropped_expired": int(np.sum(occupied & ~live)),
         "dropped_window": int(np.sum(window_ended)),
     }
+
+
+def reconcile_leases(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
+    """Reconcile a restored lease-liability table (backends/lease.py
+    export_rows layout) against the current clock: TTL-dead leases and
+    fully-settled liabilities are dropped (their frontends can no longer
+    serve from them — the counted snapshot.restore_dropped_leases
+    population); live outstanding liabilities survive to re-seed the
+    registry and to floor the restored slab counters. Returns
+    (kept rows, {'restored', 'dropped'})."""
+    table = np.asarray(table, dtype=np.uint32)
+    if table.ndim != 2 or table.shape[1] < LEASE_COL_EXPIRE + 1:
+        raise SnapshotError(
+            f"cannot reconcile lease table of shape {table.shape}: need at "
+            f"least {LEASE_COL_EXPIRE + 1} row columns"
+        )
+    expire_at = table[:, LEASE_COL_EXPIRE].astype(np.int64)
+    outstanding = table[:, LEASE_COL_GRANTED].astype(np.int64) > table[
+        :, LEASE_COL_SETTLED
+    ].astype(np.int64)
+    keep = (expire_at > np.int64(now)) & outstanding
+    return table[keep], {
+        "restored": int(np.sum(keep)),
+        "dropped": int(np.sum(~keep)),
+    }
+
+
+def apply_lease_floors(
+    tables: list[np.ndarray], lease_rows: np.ndarray
+) -> tuple[int, int]:
+    """The never-double-grant rule: every live lease liability floors its
+    slab row's counter at the post-grant watermark the device had already
+    answered with. A slab snapshot older than a grant would otherwise
+    restore a counter BELOW budget the frontends are still serving from —
+    the device would re-admit tokens already handed out. Mutates the
+    reconciled tables in place; returns (rows floored, liabilities whose
+    row was not found — e.g. probe-stolen or swept slots, counted so the
+    uncovered overshoot stays observable)."""
+    floored = unmatched = 0
+    for row in np.asarray(lease_rows, dtype=np.uint32):
+        fp_lo, fp_hi = row[LEASE_COL_FP_LO], row[LEASE_COL_FP_HI]
+        window = row[LEASE_COL_WINDOW]
+        floor = row[LEASE_COL_FLOOR]
+        hit = False
+        for table in tables:
+            match = np.flatnonzero(
+                (table[:, COL_FP_LO] == fp_lo)
+                & (table[:, COL_FP_HI] == fp_hi)
+                & (table[:, COL_WINDOW] == window)
+            )
+            for idx in match:
+                hit = True
+                if table[idx, COL_COUNT] < floor:
+                    table[idx, COL_COUNT] = floor
+                    floored += 1
+        if not hit:
+            unmatched += 1
+    return floored, unmatched

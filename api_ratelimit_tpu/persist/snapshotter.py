@@ -36,9 +36,13 @@ import time
 import numpy as np
 
 from .snapshot import (
+    FLAG_LEASE_TABLE,
+    LEASE_ROW_WIDTH,
     ROW_WIDTH,
     SnapshotError,
+    apply_lease_floors,
     load_snapshot,
+    reconcile_leases,
     reconcile_rows,
     write_snapshot,
 )
@@ -57,6 +61,13 @@ def snapshot_paths(directory: str, shard_count: int) -> list[str]:
         os.path.join(directory, f"slab.{i:02d}-of-{shard_count:02d}.snap")
         for i in range(shard_count)
     ]
+
+
+def lease_snapshot_path(directory: str) -> str:
+    """The lease-liability section of the snapshot set (one file — the
+    registry is global, not per-shard), written with FLAG_LEASE_TABLE so
+    it can never masquerade as a slab shard."""
+    return os.path.join(directory, "leases.snap")
 
 
 class SlabSnapshotter:
@@ -119,6 +130,7 @@ class SlabSnapshotter:
         self._c_writes = self._c_errors = self._c_rejected = None
         self._g_bytes = self._g_age = None
         self._g_rows = self._g_dropped_expired = self._g_dropped_window = None
+        self._g_leases = self._g_dropped_leases = None
         self._h_write = None
         if scope is not None:
             snap = scope.scope("snapshot")
@@ -130,6 +142,8 @@ class SlabSnapshotter:
             self._g_rows = snap.gauge("restore_rows")
             self._g_dropped_expired = snap.gauge("restore_dropped_expired")
             self._g_dropped_window = snap.gauge("restore_dropped_window")
+            self._g_leases = snap.gauge("restore_leases")
+            self._g_dropped_leases = snap.gauge("restore_dropped_leases")
             self._h_write = snap.histogram("write_ms")
             scope.add_stat_generator(self)
         os.makedirs(directory, exist_ok=True)
@@ -189,6 +203,25 @@ class SlabSnapshotter:
                         shard_count=len(tables),
                         fault_injector=self._faults,
                     )
+                # lease-liability section: outstanding grants ride the
+                # same snapshot set so a restart never double-grants
+                # (backends/lease.py). Lease-free deployments keep the
+                # exact pre-lease snapshot set (no extra file/fault-site
+                # firing); once liabilities exist the file is maintained
+                # even when they drain back to zero — a stale liability
+                # file must never floor a fresh slab.
+                registry = getattr(self._engine, "lease_registry", None)
+                if registry is not None:
+                    rows = registry.export_rows(now)
+                    lease_path = lease_snapshot_path(self._dir)
+                    if rows.shape[0] or os.path.exists(lease_path):
+                        total += write_snapshot(
+                            lease_path,
+                            rows,
+                            created_at=now,
+                            fault_injector=self._faults,
+                            flags=FLAG_LEASE_TABLE,
+                        )
             except Exception as e:
                 self.write_errors_total += 1
                 if self._c_errors is not None:
@@ -244,6 +277,7 @@ class SlabSnapshotter:
                 for k in totals:
                     totals[k] += stats[k]
                 tables.append(table)
+            lease_stats = self._restore_leases(tables, now)
             self._engine.import_tables(tables)
         except (SnapshotError, OSError, ValueError) as e:
             self.load_rejected_total += 1
@@ -274,8 +308,65 @@ class SlabSnapshotter:
                 max(0, now - created_at) if created_at is not None else -1
             ),
             **totals,
+            **lease_stats,
         }
         return self.restore_stats
+
+    def _restore_leases(self, tables: list[np.ndarray], now: int) -> dict:
+        """The lease-liability half of restore: reconcile leases.snap
+        against the clock (TTL-dead and fully-settled liabilities drop —
+        snapshot.restore_dropped_leases), floor the reconciled slab
+        counters at each live liability's post-grant watermark (a restart
+        must never double-grant budget frontends still hold), and re-seed
+        the engine's registry. A bad lease file degrades to a slab-only
+        restore (counted in load_rejected), never a cold boot."""
+        registry = getattr(self._engine, "lease_registry", None)
+        path = lease_snapshot_path(self._dir)
+        stats = {"restored_leases": 0, "dropped_leases": 0}
+        if registry is None or not os.path.exists(path):
+            return stats
+        try:
+            header, rows = load_snapshot(path, fault_injector=self._faults)
+            if header.flags != FLAG_LEASE_TABLE:
+                raise SnapshotError(
+                    f"{path}: flags {header.flags} is not a lease table"
+                )
+            if header.row_width != LEASE_ROW_WIDTH:
+                raise SnapshotError(
+                    f"{path}: lease row width {header.row_width} != "
+                    f"{LEASE_ROW_WIDTH}"
+                )
+            kept, rec = reconcile_leases(rows, now)
+        except (SnapshotError, OSError, ValueError) as e:
+            self.load_rejected_total += 1
+            if self._c_rejected is not None:
+                self._c_rejected.inc()
+            _log.warning(
+                "lease liability snapshot rejected (slab restores without "
+                "floors): %s",
+                e,
+            )
+            return stats
+        floored, unmatched = apply_lease_floors(tables, kept)
+        registry.import_rows(kept)
+        stats = {
+            "restored_leases": rec["restored"],
+            "dropped_leases": rec["dropped"],
+        }
+        if self._g_leases is not None:
+            self._g_leases.set(rec["restored"])
+            self._g_dropped_leases.set(rec["dropped"])
+        if rec["restored"] or rec["dropped"]:
+            _log.info(
+                "lease liabilities restored: %d live (%d TTL-dead/settled "
+                "dropped), %d slab counters floored, %d liabilities "
+                "unmatched",
+                rec["restored"],
+                rec["dropped"],
+                floored,
+                unmatched,
+            )
+        return stats
 
     # -- lifecycle --
 

@@ -89,6 +89,7 @@ def create_limiter(
     stats_store: Store,
     fault_injector=None,
     overload=None,
+    lease_table=None,
 ) -> RateLimitCache:
     """BackendType switch (runner.go:43-64). The TPU backends get the
     `ratelimit` scope so the per-stage pipeline histograms
@@ -133,13 +134,15 @@ def create_limiter(
             # healthy: no request ever rides a first-touch XLA compile
             precompile=settings.tpu_precompile,
             dispatch_loop=settings.dispatch_loop,
+            lease_table=lease_table,
             **kwargs,
         )
     if backend == "tpu-sidecar":
         from .backends.sidecar import new_sidecar_cache_from_settings
 
         return new_sidecar_cache_from_settings(
-            settings, base, stats_scope=scope, fault_injector=fault_injector
+            settings, base, stats_scope=scope, fault_injector=fault_injector,
+            lease_table=lease_table,
         )
     if backend == "memory":
         return MemoryRateLimitCache(base)
@@ -176,6 +179,7 @@ class Runner:
         self.overload = None
         self.fault_injector = None
         self.snapshotter = None
+        self.lease_table = None
         self._ready = threading.Event()
 
     def get_stats_store(self) -> Store:
@@ -314,9 +318,42 @@ class Runner:
         )
         self.server.health.add_degraded_probe(self.overload.degraded_reason)
 
+        # Hierarchical quota leasing (LEASE_ENABLED; backends/lease.py):
+        # the frontend lease table answers hot-key decisions locally from
+        # device-granted budget slices. Rides the compiled-matcher fast
+        # path — HOST_FAST_PATH=false (the vectorization rollback arm)
+        # disables leasing with it.
+        self.lease_table = None
+        (
+            lease_on,
+            lease_min,
+            lease_max,
+            lease_ttl,
+            lease_near,
+        ) = settings.lease_config()
+        if lease_on and settings.backend_type in ("tpu", "tpu-sidecar"):
+            if not settings.host_fast_path:
+                logger.warning(
+                    "LEASE_ENABLED requires HOST_FAST_PATH; leasing disabled"
+                )
+            else:
+                from .backends.lease import LeaseTable
+
+                self.lease_table = LeaseTable(
+                    base,
+                    min_size=lease_min,
+                    max_size=lease_max,
+                    ttl_fraction=lease_ttl,
+                    near_limit_ratio=lease_near,
+                    scope=self.scope.scope("lease"),
+                )
+                self.server.health.add_degraded_probe(
+                    self.lease_table.degraded_reason
+                )
+
         cache = create_limiter(
             settings, base, self.stats_store, self.fault_injector,
-            self.overload,
+            self.overload, self.lease_table,
         )
 
         # Slab health gauges (ratelimit.slab.*) for engines that expose a
@@ -328,6 +365,21 @@ class Runner:
 
             self.stats_store.add_stat_generator(
                 SlabHealthStats(engine, self.scope.scope("slab"))
+            )
+        # Lease liability gauges for device-owning engines: how much
+        # un-settled leased budget is outstanding — the Σ budgets term of
+        # the crash-overshoot bound (backends/lease.py).
+        if (
+            self.lease_table is not None
+            and engine is not None
+            and getattr(engine, "lease_registry", None) is not None
+        ):
+            from .backends.lease import LeaseRegistryStats
+
+            self.stats_store.add_stat_generator(
+                LeaseRegistryStats(
+                    engine.lease_registry, self.scope.scope("lease")
+                )
             )
         # Watermark degraded probe: slab pressure/saturation shows up in
         # the /healthcheck body next to the fallback/overload reasons.
@@ -377,7 +429,12 @@ class Runner:
             from .backends.fallback import FallbackLimiter
 
             self.fallback = FallbackLimiter(
-                failure_mode, base_limiter=base, scope=self.scope
+                failure_mode,
+                base_limiter=base,
+                scope=self.scope,
+                # outstanding leases answer before the rung does: real
+                # device-granted budget outlives the device (lease.py)
+                lease_table=self.lease_table,
             )
             self.server.health.set_degraded_probe(
                 self.fallback.degraded_reason
@@ -396,6 +453,7 @@ class Runner:
             # sleeps shed instead of pinning workers through the drain
             draining_probe=lambda: not self.server.health.ok(),
             host_fast_path=settings.host_fast_path,
+            lease=self.lease_table,
         )
 
         def dump_config() -> str:

@@ -114,6 +114,7 @@ class RateLimitService:
         overload=None,
         draining_probe: Callable[[], bool] | None = None,
         host_fast_path: bool = True,
+        lease=None,
     ):
         """fallback: optional backends.fallback.FallbackLimiter — the
         FAILURE_MODE_DENY degradation ladder. When set, a backend
@@ -136,9 +137,18 @@ class RateLimitService:
         resolve -> cache.do_limit_resolved) when both the config and the
         cache support it (HOST_FAST_PATH). False pins the legacy
         get_limit/do_limit path — the rollback knob, and the bench's
-        host_path_overhead_pct A/B arm."""
+        host_path_overhead_pct A/B arm.
+
+        lease: optional backends.lease.LeaseTable (LEASE_ENABLED) — the
+        frontend half of hierarchical quota leasing. Consulted BEFORE
+        do_limit_resolved: a request whose matched descriptors are all
+        coverable by live leases (or the over-limit cache) is answered
+        entirely frontend-locally and never touches the device; misses
+        ride the device path, which plans lease grants for them. Rides
+        the compiled-matcher pipeline only (host_fast_path)."""
         self._runtime = runtime
         self._cache = cache
+        self._lease = lease if host_fast_path else None
         self._do_limit_resolved = (
             getattr(cache, "do_limit_resolved", None) if host_fast_path else None
         )
@@ -390,49 +400,73 @@ class RateLimitService:
                     sleep_on_throttle = sleep_on_throttle or limit.sleep_on_throttle
                     report_details = report_details or limit.report_details
 
-        try:
-            if resolved is not None:
-                do_limit_response = self._do_limit_resolved(request, resolved)
-            else:
-                do_limit_response = self._cache.do_limit(request, limits)
-        except DeadlineExceededError:
-            # expired in the batcher queue: abort, never answer late, and
-            # never consult the failure ladder (its answer would still be
-            # late)
-            raise
-        except OverloadError as e:
-            # Pressure ladder: queue full / slab saturated from the
-            # backend is a shed, answered by OVERLOAD_SHED_MODE policy.
-            # Without a controller the error surfaces to the transport
-            # (UNAVAILABLE) — overload is never routed to the FAILURE
-            # ladder, which would misread pressure as backend death.
-            if self._overload is None:
+        # Hierarchical quota leasing (backends/lease.py): a request whose
+        # matched descriptors are all coverable by live leases (or the
+        # over-limit cache) answers here, frontend-locally — the device,
+        # batcher, and dispatch loop never see it. Misses fall through to
+        # the device path below, which plans grants for them.
+        do_limit_response = None
+        if self._lease is not None and resolved is not None:
+            do_limit_response = self._lease.try_answer(request, resolved)
+            if do_limit_response is not None:
+                journeys.mark(journeys.STAGE_LEASE_LOCAL)
+
+        # leased answers skip the backend call and ladder bookkeeping
+        if do_limit_response is None:
+            try:
+                if resolved is not None:
+                    do_limit_response = self._do_limit_resolved(
+                        request, resolved
+                    )
+                else:
+                    do_limit_response = self._cache.do_limit(request, limits)
+            except DeadlineExceededError:
+                # expired in the batcher queue: abort, never answer late,
+                # and never consult the failure ladder (its answer would
+                # still be late)
                 raise
-            return self._shed_answer(
-                request, _limits_of(limits, resolved), e
-            )
-        except CacheError as e:
-            # Degradation ladder (FAILURE_MODE_DENY): a dead backend — or
-            # the sidecar breaker failing fast while open — degrades to a
-            # policy decision instead of an error storm. redis_error is
-            # counted HERE because the exception no longer reaches the
-            # boundary counter in should_rate_limit.
-            if self._fallback is None:
-                raise
-            self._stats.redis_error.add(1)
-            span = active_span()
-            if span is not None:
-                span.log_kv(
-                    event="fallback", failure_mode=self._fallback.mode
+            except OverloadError as e:
+                # Pressure ladder: queue full / slab saturated from the
+                # backend is a shed, answered by OVERLOAD_SHED_MODE policy.
+                # Without a controller the error surfaces to the transport
+                # (UNAVAILABLE) — overload is never routed to the FAILURE
+                # ladder, which would misread pressure as backend death.
+                if self._overload is None:
+                    raise
+                return self._shed_answer(
+                    request, _limits_of(limits, resolved), e
                 )
-            do_limit_response = self._fallback.do_limit(
-                request, _limits_of(limits, resolved), e
-            )
-        else:
-            if self._fallback is not None:
-                self._fallback.note_success()
-            if self._overload is not None:
-                self._overload.note_ok()
+            except CacheError as e:
+                # Degradation ladder (FAILURE_MODE_DENY): a dead backend —
+                # or the sidecar breaker failing fast while open — degrades
+                # to a policy decision instead of an error storm.
+                # redis_error is counted HERE because the exception no
+                # longer reaches the boundary counter in should_rate_limit.
+                # The lease table flips its sticky lease.degraded probe
+                # first: descriptors still holding live leases keep being
+                # served locally (try_answer above) for as long as their
+                # TTLs run, and the fallback consults outstanding leases
+                # per descriptor before answering by rung.
+                if self._lease is not None:
+                    self._lease.note_device_failure(e)
+                if self._fallback is None:
+                    raise
+                self._stats.redis_error.add(1)
+                span = active_span()
+                if span is not None:
+                    span.log_kv(
+                        event="fallback", failure_mode=self._fallback.mode
+                    )
+                do_limit_response = self._fallback.do_limit(
+                    request, _limits_of(limits, resolved), e
+                )
+            else:
+                if self._lease is not None:
+                    self._lease.note_success()
+                if self._fallback is not None:
+                    self._fallback.note_success()
+                if self._overload is not None:
+                    self._overload.note_ok()
         assert_(
             len(request.descriptors)
             == len(do_limit_response.descriptor_statuses)

@@ -213,6 +213,29 @@ class Settings:
     slab_snapshot_dir: str = ""
     slab_snapshot_interval_ms: float = 10_000.0
     slab_snapshot_stale_after_ms: float = 0.0
+    # --- hierarchical quota leasing (this framework; backends/lease.py) ---
+    # LEASE_ENABLED turns on the two-tier limiter: the device-authoritative
+    # slab grants budget slices (leases) to the frontend, which answers
+    # subsequent decisions for that (key, window) locally and settles
+    # asynchronously — the hot head of a Zipf stream stops reaching the
+    # device. false (the default) is the byte-identical rollback arm: the
+    # decide path is exactly the pre-lease pipeline (pinned by test, same
+    # discipline as HOST_FAST_PATH / DISPATCH_LOOP).
+    lease_enabled: bool = False
+    # adaptive grant sizing bounds: a fresh key starts at LEASE_MIN tokens,
+    # doubles on renew-after-exhaustion up to LEASE_MAX, halves when a
+    # lease expires mostly unconsumed
+    lease_min: int = 8
+    lease_max: int = 1024
+    # lease TTL as a fraction of the rule's window (clamped to the window
+    # end — a lease never crosses a window boundary); the unconsumed
+    # remainder of an expired lease is burned, so shorter TTLs bound the
+    # under-admission error
+    lease_ttl_fraction: float = 0.25
+    # past this fraction of the limit, grants shrink toward 1 token
+    # (min(size, headroom/2)) so accuracy degrades smoothly near the edge
+    # instead of reserving past the limit
+    lease_near_limit_ratio: float = 0.9
     # fault injection (testing/faults.py): comma-separated
     # site:kind:value rules, e.g.
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -348,6 +371,38 @@ class Settings:
             raise ValueError(f"JOURNEY_RING must be > 0, got {ring}")
         return bool(self.journey_recorder_enabled), slow_ms, retain, ring
 
+    def lease_config(self) -> tuple[bool, int, int, float, float]:
+        """Validated (enabled, min, max, ttl_fraction, near_limit_ratio)
+        for hierarchical quota leasing. Junk fails the boot like every
+        other knob — a typo'd lease bound must not silently become a
+        different overshoot contract."""
+        lease_min = int(self.lease_min)
+        lease_max = int(self.lease_max)
+        ttl_fraction = float(self.lease_ttl_fraction)
+        near_ratio = float(self.lease_near_limit_ratio)
+        if lease_min < 1:
+            raise ValueError(f"LEASE_MIN must be >= 1, got {lease_min}")
+        if lease_max < lease_min:
+            raise ValueError(
+                f"LEASE_MAX ({lease_max}) must not sit below LEASE_MIN "
+                f"({lease_min})"
+            )
+        if not 0.0 < ttl_fraction <= 1.0:
+            raise ValueError(
+                f"LEASE_TTL_FRACTION must be in (0, 1], got {ttl_fraction}"
+            )
+        if not 0.0 < near_ratio <= 1.0:
+            raise ValueError(
+                f"LEASE_NEAR_LIMIT_RATIO must be in (0, 1], got {near_ratio}"
+            )
+        return (
+            bool(self.lease_enabled),
+            lease_min,
+            lease_max,
+            ttl_fraction,
+            near_ratio,
+        )
+
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
         ValueError on junk — a typo'd chaos spec must fail the boot, not
@@ -470,6 +525,11 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
         "SLAB_SNAPSHOT_STALE_AFTER_MS",
         float,
     ),
+    ("lease_enabled", "LEASE_ENABLED", _parse_bool),
+    ("lease_min", "LEASE_MIN", int),
+    ("lease_max", "LEASE_MAX", int),
+    ("lease_ttl_fraction", "LEASE_TTL_FRACTION", float),
+    ("lease_near_limit_ratio", "LEASE_NEAR_LIMIT_RATIO", float),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]

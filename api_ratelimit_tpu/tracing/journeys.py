@@ -52,6 +52,10 @@ import time
 STAGES = ("publish", "take", "pack", "launch", "redeem", "scatter")
 # the owner-thread half of the itinerary, as carried by dispatch tickets
 OWNER_STAGES = ("take", "pack", "launch", "redeem", "scatter")
+# requests answered frontend-locally from a leased budget slice
+# (backends/lease.py) mark this single stage INSTEAD of the device set —
+# /debug/journeys shows at a glance which requests never left the frontend
+STAGE_LEASE_LOCAL = "lease_local"
 
 FLAG_SLOW = "slow"
 FLAG_SHED = "shed"

@@ -64,6 +64,15 @@ Artifact field guide (round 5 additions):
                                   cold-block sharded rows; host_cpus says
                                   whether the mesh could physically
                                   parallelize (1 core = shape check only)
+  lease_zipf.lease / rate_lease_off
+                                  the hierarchical-quota-leasing row
+                                  (round 8): a Zipf hot-key stream with
+                                  lease_hit_rate / device_offload_pct /
+                                  grants / burned_tokens sourced from the
+                                  runtime ratelimit.lease.* stats, plus
+                                  the lease-off A/B arm
+                                  (lease_overhead_pct; negative = the
+                                  leased arm is faster)
 """
 
 from __future__ import annotations
@@ -584,6 +593,20 @@ descriptors:
     shadow_mode: true
 """
 
+# Hierarchical quota leasing (backends/lease.py): a Zipf hot-key stream
+# where nothing is over limit, so the over-limit cache can't absorb it —
+# the workload whose hot head used to funnel every decision to the device.
+# With LEASE_ENABLED the slab grants budget slices and the hot head is
+# answered frontend-locally; the bench row reports lease_hit_rate /
+# device_offload_pct from the runtime ratelimit.lease.* stats plus the
+# lease-off A/B arm (lease_overhead_pct; negative = leasing is a win).
+_LEASE_ZIPF = """\
+domain: bench
+descriptors:
+  - key: api_key
+    rate_limit: {unit: minute, requests_per_unit: 1000000000}
+"""
+
 
 class _StaticRuntime:
     def __init__(self, yaml_text: str):
@@ -608,9 +631,20 @@ class _StaticRuntime:
 def _requests_for(config_key: str, n: int):
     from api_ratelimit_tpu.models.descriptors import Descriptor, RateLimitRequest
 
+    zipf_ids_local = None
+    if config_key == "lease_zipf":
+        # Zipf(1.5) hot head over a 1k-key universe (deterministic seed):
+        # the closed-loop drive revisits the head constantly, so after the
+        # first touch of each key the stream is lease-serveable — the
+        # workload leasing exists for. The engine tier keeps the harsher
+        # Zipf(1.1)/10M stream; this row measures the frontend tier.
+        rng = np.random.default_rng(11)
+        zipf_ids_local = rng.zipf(1.5, size=n).astype(np.uint64) % 1024
     reqs = []
     for i in range(n):
-        if config_key == "flat_per_second":
+        if config_key == "lease_zipf":
+            descs = (Descriptor.of(("api_key", f"k{zipf_ids_local[i]}")),)
+        elif config_key == "flat_per_second":
             descs = (Descriptor.of(("api_key", f"k{i % 1024}")),)
         elif config_key == "nested_tree":
             descs = (
@@ -769,13 +803,15 @@ def _build_service(
     on_tpu: bool = False,
     host_fast_path: bool = True,
     dispatch_loop: bool = True,
+    lease: bool = False,
 ):
     """One service stack for a scenario; telemetry=False builds the same
     stack with no stats scope on the backend (the A/B for recording
     overhead); host_fast_path=False pins the legacy per-object host path
     (the host_path_overhead_pct A/B arm); dispatch_loop=False pins the
-    leader-collects batcher (the dispatch_loop_overhead_pct A/B arm).
-    Returns (service, cache, store)."""
+    leader-collects batcher (the dispatch_loop_overhead_pct A/B arm);
+    lease=True wires a LeaseTable (LEASE_ENABLED production posture — the
+    lease_zipf scenario's primary arm). Returns (service, cache, store)."""
     import random
 
     from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
@@ -798,6 +834,16 @@ def _build_service(
         expiration_jitter_max_seconds=0,
         local_cache=local_cache,
     )
+    lease_table = None
+    if lease:
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+
+        lease_table = LeaseTable(
+            base,
+            scope=store.scope("ratelimit").scope("lease")
+            if telemetry
+            else None,
+        )
     cache = TpuRateLimitCache(
         base,
         n_slots=1 << 18,
@@ -820,6 +866,7 @@ def _build_service(
         # warmup's tail and pollute the first timed samples)
         precompile=True,
         dispatch_loop=dispatch_loop,
+        lease_table=lease_table,
     )
     service = RateLimitService(
         runtime=_StaticRuntime(yaml_text),
@@ -827,6 +874,7 @@ def _build_service(
         stats_scope=store.scope("ratelimit").scope("service"),
         time_source=RealTimeSource(),
         host_fast_path=host_fast_path,
+        lease=lease_table,
     )
     return service, cache, store
 
@@ -840,6 +888,7 @@ def bench_service(
     measure_host_path_overhead: bool = False,
     measure_dispatch_overhead: bool = False,
     measure_tracing_overhead: bool = False,
+    measure_lease: bool = False,
 ) -> dict:
     """One service-level scenario: threads driving should_rate_limit through
     the micro-batched TPU backend. Per-stage timings come from the runtime
@@ -874,7 +923,15 @@ def bench_service(
     tracing_overhead_pct. The primary rate measures the disabled path
     (NoopTracer, no recorder — the allocation-free default), so the
     artifact carries both the zero-cost-when-disabled claim and the
-    enabled cost as measurements, not assertions."""
+    enabled cost as measurements, not assertions.
+
+    measure_lease (the lease_zipf scenario): the PRIMARY arm runs with a
+    LeaseTable wired (hierarchical quota leasing, backends/lease.py) and
+    the artifact's `lease` block reports lease_hit_rate /
+    device_offload_pct / grants / burned_tokens plus the local-decide
+    latency — all sourced from the runtime ratelimit.lease.* stats the
+    drive itself recorded; a second drive with leasing off records
+    rate_lease_off + lease_overhead_pct (negative = leasing is a win)."""
     # the reference's BenchmarkParallelDoLimit drives GOMAXPROCS (= NCPU)
     # parallel workers (test/redis/bench_test.go); oversubscribing a small
     # box measures queueing, not the service (8 threads on the 1-core bench
@@ -883,7 +940,8 @@ def bench_service(
     n_threads = max(4, os.cpu_count() or 1)
     per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
     service, cache, store = _build_service(
-        config_key, yaml_text, telemetry=True, on_tpu=on_tpu
+        config_key, yaml_text, telemetry=True, on_tpu=on_tpu,
+        lease=measure_lease,
     )
     reqs = _requests_for(config_key, 2048)
     decisions_per_request = len(reqs[0].descriptors)
@@ -922,6 +980,65 @@ def bench_service(
         result["p99_co_located_est_ms"] = round(
             max(0.0, p99 - readback["p50"]), 3
         )
+    if measure_lease:
+        snap = store.debug_snapshot()
+
+        def lease_stat(name: str) -> int:
+            return int(snap.get(f"ratelimit.lease.{name}", 0))
+
+        decisions = lease_stat("decisions_seen")
+        local_hits = lease_stat("local_hits")
+        cache_hits = lease_stat("cache_hits")
+        lease_block = {
+            "decisions": decisions,
+            "local_hits": local_hits,
+            "grants": lease_stat("grants"),
+            "grant_tokens": lease_stat("grant_tokens"),
+            "renews": lease_stat("renews"),
+            "expired": lease_stat("expired"),
+            "burned_tokens": lease_stat("burned_tokens"),
+            "lease_hit_rate": (
+                round(local_hits / decisions, 4) if decisions else 0.0
+            ),
+            # decisions that never reached the device at all (lease +
+            # over-limit-cache hits inside the lease decide path)
+            "device_offload_pct": (
+                round((local_hits + cache_hits) / decisions * 100.0, 2)
+                if decisions
+                else 0.0
+            ),
+        }
+        hists = store.metrics_snapshot()["histograms"]
+        h = hists.get("ratelimit.lease.local_ms")
+        if h and h["count"]:
+            lease_block["local_ms"] = {
+                "count": h["count"],
+                "p50": round(h["p50"], 4),
+                "p99": round(h["p99"], 4),
+            }
+        result["lease"] = lease_block
+        # A/B arm: the identical stream with leasing off — every decision
+        # rides the device path (the pre-lease pipeline)
+        service_nl, cache_nl, _store_nl = _build_service(
+            config_key, yaml_text, telemetry=True, on_tpu=on_tpu,
+            lease=False,
+        )
+        for r in reqs[:32]:
+            service_nl.should_rate_limit(r)
+        total_nl, elapsed_nl, lat_nl = _drive_service(
+            service_nl, reqs, n_threads, per_thread
+        )
+        cache_nl.close()
+        rate_nl = total_nl * decisions_per_request / elapsed_nl
+        result["rate_lease_off"] = round(rate_nl)
+        result["p99_lease_off_ms"] = round(
+            float(np.percentile(lat_nl, 99)), 3
+        )
+        if rate_nl > 0:
+            # negative = the leased arm is FASTER than the device path
+            result["lease_overhead_pct"] = round(
+                (1.0 - result["rate"] / rate_nl) * 100.0, 2
+            )
     if measure_telemetry_overhead:
         service_off, cache_off, _ = _build_service(
             config_key, yaml_text, telemetry=False
@@ -1695,6 +1812,7 @@ def main() -> None:
         ("dual_window", _DUAL),
         ("near_limit_local_cache", _NEARLIMIT),
         ("shadow_mode", _SHADOW),
+        ("lease_zipf", _LEASE_ZIPF),
     ):
         if left() < 50:
             configs[key] = {"skipped": "budget"}
@@ -1730,6 +1848,10 @@ def main() -> None:
                 measure_tracing_overhead=(
                     key == "flat_per_second" and left() > 100
                 ),
+                # hierarchical quota leasing: the Zipf hot-key row runs
+                # leased as its primary arm and records hit rate /
+                # device offload / the lease-off A/B (backends/lease.py)
+                measure_lease=(key == "lease_zipf"),
             )
         except Exception as e:
             configs[key] = {"error": str(e)[-300:]}

@@ -168,6 +168,7 @@ class TestArtifactDiscipline:
             "dual_window",
             "near_limit_local_cache",
             "shadow_mode",
+            "lease_zipf",
             "sidecar",
         ):
             assert tier in configs, f"{tier} missing from artifact"

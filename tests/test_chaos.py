@@ -696,3 +696,423 @@ class TestFullStackAcceptance:
         finally:
             runner.stop()
             server.close()
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical quota leasing x the failure ladder (backends/lease.py):
+# while the device owner is dark, outstanding leases keep answering with
+# REAL granted budget; an expired/exhausted lease falls through to the
+# configured FAILURE_MODE_DENY rung, and the sticky lease.degraded probe
+# rides /healthcheck until the next device success.
+# ---------------------------------------------------------------------------
+
+LEASE_LADDER_YAML = """
+domain: chaos
+descriptors:
+  - key: k
+    rate_limit: {unit: minute, requests_per_unit: 50}
+"""
+
+
+class _FlakyEngine:
+    """Row-verb engine wrapper: raises CacheError while .down, else
+    delegates to a real SlabDeviceEngine (so lease grants execute)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.down = False
+
+    @property
+    def lease_registry(self):
+        return self._engine.lease_registry
+
+    def submit_rows(self, block, lease_ops=None):
+        if self.down:
+            raise CacheError("device owner dark")
+        return self._engine.submit_rows(block, lease_ops=lease_ops)
+
+    def flush(self):
+        self._engine.flush()
+
+    def close(self):
+        self._engine.close()
+
+
+def _lease_ladder_service(mode, store):
+    import random
+
+    from api_ratelimit_tpu.backends.lease import LeaseTable
+    from api_ratelimit_tpu.backends.tpu import (
+        SlabDeviceEngine,
+        TpuRateLimitCache,
+    )
+
+    ts = FakeTimeSource(1_000_000)
+    base = BaseRateLimiter(
+        ts, jitter_rand=random.Random(0), expiration_jitter_max_seconds=0
+    )
+    table = LeaseTable(
+        base,
+        min_size=4,
+        max_size=16,
+        scope=store.scope("ratelimit").scope("lease"),
+    )
+    engine = _FlakyEngine(
+        SlabDeviceEngine(
+            time_source=ts, n_slots=1 << 10, use_pallas=False, buckets=(128,)
+        )
+    )
+    fallback = None
+    if mode is not None:
+        fallback = FallbackLimiter(
+            mode,
+            base_limiter=base,
+            scope=store.scope("ratelimit"),
+            lease_table=table,
+        )
+    cache = TpuRateLimitCache(base, engine=engine, lease_table=table)
+    svc = RateLimitService(
+        runtime=_FakeRuntime({"config.chaos": LEASE_LADDER_YAML}),
+        cache=cache,
+        stats_scope=store.scope("ratelimit").scope("service"),
+        time_source=ts,
+        fallback=fallback,
+        lease=table,
+    )
+    return svc, engine, table, fallback, ts
+
+
+def _lease_req(value="hot"):
+    return RateLimitRequest(
+        domain="chaos", descriptors=(Descriptor.of(("k", value)),)
+    )
+
+
+class TestLeaseFailureLadder:
+    def test_outstanding_leases_serve_through_outage(self, test_store):
+        """Device dies mid-window: every decision covered by the live
+        lease budget still answers OK, with no redis_error and no
+        fallback consultation — the outage is invisible until the budget
+        runs out."""
+        store, sink = test_store
+        svc, engine, table, _, _ = _lease_ladder_service(
+            FAILURE_MODE_DENY, store
+        )
+        assert svc.should_rate_limit(_lease_req())[0] == Code.OK  # grant 4
+        engine.down = True
+        for _ in range(4):  # exactly the leased budget
+            assert svc.should_rate_limit(_lease_req())[0] == Code.OK
+        store.flush()
+        assert (
+            sink.counters.get(
+                "ratelimit.service.call.should_rate_limit.redis_error", 0
+            )
+            == 0
+        )
+        assert sink.counters.get("ratelimit.fallback.deny", 0) == 0
+        assert not table.degraded
+
+    @pytest.mark.parametrize(
+        "mode,expected_code",
+        [
+            (FAILURE_MODE_DENY, Code.OVER_LIMIT),
+            (FAILURE_MODE_ALLOW, Code.OK),
+            (FAILURE_MODE_DEGRADED, Code.OK),
+        ],
+    )
+    def test_exhausted_lease_falls_to_rung(self, test_store, mode, expected_code):
+        """Budget exhausted while the device is dark: the renewal attempt
+        hits CacheError and the request degrades to the configured rung —
+        with the sticky lease.degraded probe raised."""
+        store, sink = test_store
+        svc, engine, table, fallback, _ = _lease_ladder_service(mode, store)
+        svc.should_rate_limit(_lease_req())  # grant 4
+        engine.down = True
+        for _ in range(4):
+            svc.should_rate_limit(_lease_req())
+        # budget gone: the next request needs the device
+        code, statuses, _ = svc.should_rate_limit(_lease_req())
+        assert code == expected_code
+        assert statuses[0].code == expected_code
+        assert table.degraded
+        assert "lease.degraded" in table.degraded_reason()
+        store.flush()
+        assert sink.gauges["ratelimit.lease.degraded"] == 1
+        assert (
+            sink.counters[
+                "ratelimit.service.call.should_rate_limit.redis_error"
+            ]
+            == 1
+        )
+
+    def test_expired_lease_falls_to_rung(self, test_store):
+        """TTL expiry behaves exactly like exhaustion: once the lease is
+        dead and the device is dark, the rung answers (the fail-open
+        composition the ladder documents)."""
+        store, _ = test_store
+        svc, engine, table, _, ts = _lease_ladder_service(
+            FAILURE_MODE_ALLOW, store
+        )
+        svc.should_rate_limit(_lease_req())  # grant, TTL 15s
+        engine.down = True
+        assert svc.should_rate_limit(_lease_req())[0] == Code.OK  # leased
+        ts.advance(16)  # TTL passes (window still open)
+        code, _, _ = svc.should_rate_limit(_lease_req())
+        assert code == Code.OK  # the allow rung, not the lease
+        assert table.degraded
+
+    def test_healthcheck_carries_sticky_lease_probe(self, test_store):
+        from api_ratelimit_tpu.server.health import HealthChecker
+
+        store, sink = test_store
+        svc, engine, table, _, _ = _lease_ladder_service(
+            FAILURE_MODE_ALLOW, store
+        )
+        health = HealthChecker()
+        health.add_degraded_probe(table.degraded_reason)
+        svc.should_rate_limit(_lease_req())
+        assert health.http_response() == (200, "OK")
+        engine.down = True
+        for _ in range(6):  # exhaust the budget, then fail over
+            svc.should_rate_limit(_lease_req())
+        status, body = health.http_response()
+        assert status == 200 and "lease.degraded" in body
+        # recovery: the next successful device interaction clears it
+        engine.down = False
+        svc.should_rate_limit(_lease_req())
+        assert health.http_response() == (200, "OK")
+        store.flush()
+        assert sink.gauges["ratelimit.lease.degraded"] == 0
+
+    def test_fallback_serves_leased_descriptor_mixed_request(self, test_store):
+        """A request mixing a leased and an unleased descriptor while the
+        device is dark: the leased one answers from its REAL budget (exact
+        remaining), the other by the rung."""
+        store, _ = test_store
+        svc, engine, table, _, _ = _lease_ladder_service(
+            FAILURE_MODE_DENY, store
+        )
+        svc.should_rate_limit(_lease_req("a"))  # grant for "a"
+        engine.down = True
+        request = RateLimitRequest(
+            domain="chaos",
+            descriptors=(
+                Descriptor.of(("k", "a")),
+                Descriptor.of(("k", "never-seen")),
+            ),
+        )
+        code, statuses, _ = svc.should_rate_limit(request)
+        assert statuses[0].code == Code.OK  # from the lease
+        assert statuses[0].limit_remaining > 0
+        assert statuses[1].code == Code.OVER_LIMIT  # the deny rung
+        assert code == Code.OVER_LIMIT
+        store.flush()
+        snap = store.debug_snapshot()
+        assert snap["ratelimit.lease.fallback_hits"] == 1
+
+
+_LEASE_OWNER_CHILD = """\
+import os, sys, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+
+from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+snap_dir, sock, ctl = sys.argv[1], sys.argv[2], sys.argv[3]
+engine = SlabDeviceEngine(
+    RealTimeSource(),
+    n_slots=1 << 12,
+    use_pallas=False,
+    buckets=(128,),
+    block_mode=True,
+)
+snap = SlabSnapshotter(engine, snap_dir, interval_ms=3_600_000.0)
+snap.restore()  # warm boot: slab + lease liabilities (floors applied)
+server = SlabSidecarServer(sock, engine)
+with open(ctl + ".ready", "w") as f:
+    f.write("ok")
+while True:  # runs until SIGKILLed / SIGTERMed by the parent
+    if os.path.exists(ctl + ".snap_req"):
+        os.unlink(ctl + ".snap_req")
+        snap.snapshot_once()
+        with open(ctl + ".snap_done", "w") as f:
+            f.write("ok")
+    time.sleep(0.02)
+"""
+
+
+class TestSigkillDeviceOwnerWithLeases:
+    """The lease chaos acceptance: SIGKILL the device-owner process under
+    lease-held Zipf traffic. While leases live the frontend keeps
+    answering with ZERO failed requests; after the owner restarts from
+    its snapshot (slab + lease liabilities), total admitted for the hot
+    key overshoots the exact oracle by at most the outstanding lease
+    budgets at the kill — and with the liability floors restored, by 0."""
+
+    def test_kill9_under_lease_held_traffic(self, tmp_path):
+        import os
+        import random
+        import signal
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+        from api_ratelimit_tpu.service.ratelimit import RateLimitService
+        from api_ratelimit_tpu.stats import Store, TestSink
+        from api_ratelimit_tpu.testing.oracle import occurrence_rank
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        snap_dir = str(tmp_path / "snaps")
+        os.makedirs(snap_dir)
+        sock = str(tmp_path / "owner.sock")
+        ctl = str(tmp_path / "ctl")
+
+        def spawn():
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _LEASE_OWNER_CHILD.format(repo=repo),
+                    snap_dir,
+                    sock,
+                    ctl,
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+        def wait_ready(timeout=60.0):
+            deadline = time.time() + timeout
+            while not os.path.exists(ctl + ".ready"):
+                assert time.time() < deadline, "device owner never came up"
+                time.sleep(0.05)
+            os.unlink(ctl + ".ready")
+
+        # hour window: no window roll and no lease TTL expiry mid-test —
+        # "while leases live" holds for the whole run by construction
+        yaml_text = (
+            "domain: chaos\n"
+            "descriptors:\n"
+            "  - key: k\n"
+            "    rate_limit: {unit: hour, requests_per_unit: 50}\n"
+        )
+
+        proc = spawn()
+        try:
+            wait_ready()
+            from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
+
+            store = Store(TestSink())
+            base = BaseRateLimiter(
+                RealTimeSource(),
+                jitter_rand=random.Random(0),
+                expiration_jitter_max_seconds=0,
+            )
+            table = LeaseTable(base, min_size=4, max_size=16)
+            client = SidecarEngineClient(
+                sock, retries=0, breaker_threshold=0
+            )
+            cache = TpuRateLimitCache(
+                base, engine=client, lease_table=table
+            )
+            svc = RateLimitService(
+                runtime=_FakeRuntime({"config.chaos": yaml_text}),
+                cache=cache,
+                stats_scope=store.scope("ratelimit").scope("service"),
+                time_source=RealTimeSource(),
+                lease=table,
+            )
+
+            # Zipf-ish lease-held traffic: a hot key plus a tail
+            rng = np.random.default_rng(5)
+            tail = [f"t{int(i)}" for i in (rng.zipf(1.3, 40) % 8)]
+            stream = []
+            admitted_hot = 0
+            for i in range(30):
+                stream.append("hot")
+                code, _, _ = svc.should_rate_limit(_lease_req("hot"))
+                if code == Code.OK:
+                    admitted_hot += 1
+                if i < len(tail):
+                    svc.should_rate_limit(_lease_req(tail[i]))
+
+            # one deterministic snapshot (slab + lease liabilities)...
+            with open(ctl + ".snap_req", "w") as f:
+                f.write("go")
+            deadline = time.time() + 30
+            while not os.path.exists(ctl + ".snap_done"):
+                assert time.time() < deadline, "owner never snapshotted"
+                time.sleep(0.05)
+
+            held, outstanding = table.outstanding()
+            assert held >= 1 and outstanding > 0
+
+            # the hot key's own remaining leased budget (the zero-failure
+            # window): read it the way the decide path would
+            from api_ratelimit_tpu.ops.hashing import fingerprint64
+
+            fp_hot = fingerprint64(
+                "chaos", Descriptor.of(("k", "hot")).entries, 3600
+            )
+            now = int(time.time())
+            window = now - now % 3600
+            hot_lease = table._leases.get((fp_hot, window))
+            assert hot_lease is not None
+            budget = min(hot_lease.granted - hot_lease.consumed, 8)
+            assert budget > 0
+
+            # ...then kill -9 the owner mid-stream
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            # zero failed requests while leases live: the hot key's
+            # remaining budget answers locally with the owner DEAD
+            for _ in range(budget):
+                stream.append("hot")
+                code, _, _ = svc.should_rate_limit(_lease_req("hot"))
+                assert code == Code.OK
+                admitted_hot += 1
+
+            # owner restarts from the snapshot; frontends redial free
+            proc = spawn()
+            wait_ready()
+
+            # run the hot key well past its limit
+            for _ in range(60):
+                stream.append("hot")
+                code, _, _ = svc.should_rate_limit(_lease_req("hot"))
+                if code == Code.OK:
+                    admitted_hot += 1
+
+            # exact oracle for the single-key stream: first LIMIT
+            # occurrences admitted (testing/oracle.py semantics)
+            ids = np.zeros(
+                sum(1 for s in stream if s == "hot"), dtype=np.int64
+            )
+            oracle_admitted = int(np.sum(occurrence_rank(ids) + 1 <= 50))
+            overshoot = admitted_hot - oracle_admitted
+            # the PINNED bound: overshoot <= Σ outstanding lease budgets
+            # at the kill; with the liability floors restored it is 0
+            assert overshoot <= outstanding
+            assert overshoot <= 0, (
+                f"liability floors must prevent double-granting "
+                f"(admitted {admitted_hot}, oracle {oracle_admitted})"
+            )
+            client.close()
+            cache.close()
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

@@ -328,3 +328,44 @@ class TestResilienceSettings:
             ("snapshot.write", "torn_write"),
             ("snapshot.load", "corrupt"),
         ]
+
+
+class TestLeaseSettings:
+    def test_defaults_are_the_rollback_arm(self):
+        s = Settings()
+        assert s.lease_enabled is False  # byte-identical pre-lease pipeline
+        assert s.lease_min == 8
+        assert s.lease_max == 1024
+        assert s.lease_ttl_fraction == pytest.approx(0.25)
+        assert s.lease_near_limit_ratio == pytest.approx(0.9)
+        assert s.lease_config() == (False, 8, 1024, 0.25, 0.9)
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "LEASE_ENABLED": "true",
+                "LEASE_MIN": "2",
+                "LEASE_MAX": "256",
+                "LEASE_TTL_FRACTION": "0.5",
+                "LEASE_NEAR_LIMIT_RATIO": "0.8",
+            }
+        )
+        assert s.lease_config() == (True, 2, 256, 0.5, 0.8)
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="LEASE_ENABLED"):
+            new_settings({"LEASE_ENABLED": "sideways"})
+        with pytest.raises(ValueError, match="LEASE_MIN"):
+            new_settings({"LEASE_MIN": "four"})
+        with pytest.raises(ValueError, match="LEASE_MIN"):
+            new_settings({"LEASE_MIN": "0"}).lease_config()
+        with pytest.raises(ValueError, match="LEASE_MAX"):
+            new_settings({"LEASE_MIN": "64", "LEASE_MAX": "8"}).lease_config()
+        with pytest.raises(ValueError, match="LEASE_TTL_FRACTION"):
+            new_settings({"LEASE_TTL_FRACTION": "0"}).lease_config()
+        with pytest.raises(ValueError, match="LEASE_TTL_FRACTION"):
+            new_settings({"LEASE_TTL_FRACTION": "1.5"}).lease_config()
+        with pytest.raises(ValueError, match="LEASE_NEAR_LIMIT_RATIO"):
+            new_settings(
+                {"LEASE_NEAR_LIMIT_RATIO": "-0.1"}
+            ).lease_config()

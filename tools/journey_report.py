@@ -23,8 +23,18 @@ import json
 import sys
 
 # canonical stage order (tracing/journeys.py STAGES; duplicated here so the
-# report stays importable without the package installed)
-STAGE_ORDER = ("publish", "take", "pack", "launch", "redeem", "scatter")
+# report stays importable without the package installed). lease_local is
+# the frontend-local decide mark (backends/lease.py) — requests answered
+# from a leased budget carry it INSTEAD of the device stage set.
+STAGE_ORDER = (
+    "lease_local",
+    "publish",
+    "take",
+    "pack",
+    "launch",
+    "redeem",
+    "scatter",
+)
 
 
 def _percentile(ordered: list[float], q: float) -> float:

@@ -33,17 +33,49 @@ from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
     COL_DIVIDER,
     COL_EXPIRE,
     COL_WINDOW,
+    FLAG_LEASE_TABLE,
+    LEASE_COL_EXPIRE,
+    LEASE_COL_GRANTED,
+    LEASE_COL_SETTLED,
     SnapshotError,
     load_snapshot,
+    reconcile_leases,
     reconcile_rows,
 )
 
 
 def inspect_file(path: str, now: int | None) -> dict:
     """Fully validate one snapshot file and return its report dict;
-    raises SnapshotError on any validation failure."""
+    raises SnapshotError on any validation failure. Lease-liability
+    tables (FLAG_LEASE_TABLE — the leases.snap section) get their own
+    report shape: outstanding grants, unsettled tokens, and how the
+    boot-time reconcile at `now` would treat them."""
     header, table = load_snapshot(path)
     at = int(now) if now is not None else int(header.created_at)
+    if header.flags & FLAG_LEASE_TABLE:
+        granted = table[:, LEASE_COL_GRANTED].astype(np.int64)
+        settled = table[:, LEASE_COL_SETTLED].astype(np.int64)
+        expire_at = table[:, LEASE_COL_EXPIRE].astype(np.int64)
+        _kept, rec = reconcile_leases(table, at)
+        return {
+            "path": path,
+            "valid": True,
+            "kind": "leases",
+            "version": header.version,
+            "created_at": header.created_at,
+            "age_seconds": max(0, at - header.created_at),
+            "bytes": os.path.getsize(path),
+            "leases": {
+                "outstanding": int(table.shape[0]),
+                "granted_tokens": int(granted.sum()),
+                "settled_tokens": int(settled.sum()),
+                # the Σ budgets term of the crash-overshoot bound
+                "unsettled_tokens": int((granted - settled).sum()),
+                "ttl_dead_at_now": int(np.sum(expire_at <= at)),
+                "restorable": rec["restored"],
+                "dropped_on_restore": rec["dropped"],
+            },
+        }
     occupied = table.any(axis=1)
     expire_at = table[:, COL_EXPIRE].astype(np.int64)
     live = occupied & (expire_at > at)
@@ -52,6 +84,7 @@ def inspect_file(path: str, now: int | None) -> dict:
     report = {
         "path": path,
         "valid": True,
+        "kind": "slab",
         "version": header.version,
         "created_at": header.created_at,
         "age_seconds": max(0, at - header.created_at),
@@ -87,6 +120,27 @@ def inspect_file(path: str, now: int | None) -> dict:
 
 
 def _print_text(report: dict) -> None:
+    if report.get("kind") == "leases":
+        leases = report["leases"]
+        print(f"{report['path']}:")
+        print(
+            f"  header  v{report['version']} lease-liability table "
+            f"created_at={report['created_at']} "
+            f"(age {report['age_seconds']}s) "
+            f"({report['bytes']} bytes)  CRC OK"
+        )
+        print(
+            f"  leases  outstanding={leases['outstanding']} "
+            f"unsettled_tokens={leases['unsettled_tokens']} "
+            f"(granted={leases['granted_tokens']}, "
+            f"settled={leases['settled_tokens']})"
+        )
+        print(
+            f"  restore restorable={leases['restorable']} "
+            f"dropped={leases['dropped_on_restore']} "
+            f"ttl_dead={leases['ttl_dead_at_now']}"
+        )
+        return
     rows = report["rows"]
     print(f"{report['path']}:")
     print(
