@@ -32,6 +32,10 @@ proto:
 # FIRST so the suite exercises the real pack/scatter/fingerprint path —
 # tests/test_native.py then asserts availability, so a broken build fails
 # the tier instead of silently riding the pure-Python fallback.
+# Includes the slab differential-fuzz campaign (tests/test_slab_fuzz.py)
+# at its small default example count; crank SLAB_FUZZ_EXAMPLES (e.g.
+# `SLAB_FUZZ_EXAMPLES=2000 make tests_unit`) for the full idle-hardware
+# campaign.
 tests_unit: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 

@@ -7,16 +7,20 @@ until every caller times out (the reference's posture of bounded
 concurrency, MAX_SLEEPING_ROUTINES at ratelimit.go:337-341, generalized to
 the whole admission path).
 
-Three shed triggers, one policy:
+Two shed triggers, one policy:
 
     QueueFullError      the micro-batcher's hard OVERLOAD_MAX_QUEUE bound
     BrownoutError       the latency brownout — EWMA of batcher queue wait
                         crossed OVERLOAD_BROWNOUT_TARGET_MS (hysteresis:
                         exits below OVERLOAD_BROWNOUT_EXIT_MS)
-    SlabSaturatedError  HBM slab occupancy crossed SLAB_WATERMARK_CRITICAL
-                        (backends/tpu.py watermarks)
 
-All subclass OverloadError (itself a CacheError, so layers that only know
+(The old third trigger — SlabSaturatedError at the critical slab
+watermark — died with the open-addressed slab: the set-associative layout
+evicts least-valuable ways in-kernel, so occupancy pressure degrades
+per-key accuracy smoothly instead of shedding admission. See
+ops/slab.py.)
+
+Both subclass OverloadError (itself a CacheError, so layers that only know
 the generic failure contract stay safe). The service maps a shed to the
 configured posture (OVERLOAD_SHED_MODE):
 
@@ -67,14 +71,6 @@ class BrownoutError(OverloadError):
     token = "brownout"
 
 
-class SlabSaturatedError(OverloadError):
-    """HBM slab occupancy is past the critical watermark; new-key
-    admission degrades to policy instead of silently evicting live
-    counters (backends/tpu.py)."""
-
-    token = "slab_saturated"
-
-
 class AdmissionController:
     """One per process: owns the brownout signal, the shed policy, and the
     `overload.*` stats.
@@ -88,7 +84,6 @@ class AdmissionController:
         shed               requests shed by admission control (counter)
         queue_full         sheds from the hard queue bound (counter)
         brownout_shed      sheds from the latency brownout (counter)
-        slab_saturated     sheds from the critical slab watermark (counter)
         deadline_expired   items dropped after their deadline (counter)
         sleep_shed         throttle sleeps skipped under drain/overload
                            (counter; counted by the service)
@@ -138,7 +133,6 @@ class AdmissionController:
             self._c_kind = {
                 QueueFullError: ov.counter("queue_full"),
                 BrownoutError: ov.counter("brownout_shed"),
-                SlabSaturatedError: ov.counter("slab_saturated"),
             }
             self._c_deadline = ov.counter("deadline_expired")
             self._c_sleep_shed = ov.counter("sleep_shed")

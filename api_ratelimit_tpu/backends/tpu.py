@@ -48,32 +48,41 @@ from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint_many, split_fingerprints
 from ..ops.slab import (
+    HEALTH_DROPS,
+    HEALTH_EVICT_EXPIRED,
+    HEALTH_EVICT_LIVE,
+    HEALTH_EVICT_WINDOW,
+    HEALTH_WIDTH,
     ROW_WIDTH,
     make_slab,
     slab_export_copy,
     slab_import_rows,
     slab_live_slots,
     slab_step_after,
-    slab_sweep_expired,
+    default_ways,
+    validate_ways,
 )
 from ..tracing import tag_do_limit_start
 from .batcher import MicroBatcher
 from .lease import LeaseOps, LeaseRegistry, apply_lease_ops
-from .overload import SlabSaturatedError
 
 _log = logging.getLogger(__name__)
 
 
 def _loss_ppm(snap: dict) -> int:
-    """Lossy events (steals + drops) per million decisions — the alarmable
-    rate behind the fail-open contract (the reference documents the same
-    trade as "the request is assumed allowed on error", README.md:567-568):
-    every parity disagreement must trace to a counted lossy event, so this
-    ratio rising is the early warning that parity is eroding."""
+    """Lossy events (live-row evictions + in-batch contention drops) per
+    million decisions — the alarmable rate behind the fail-open contract
+    (the reference documents the same trade as "the request is assumed
+    allowed on error", README.md:567-568): every parity disagreement must
+    trace to a counted lossy event, so this ratio rising is the early
+    warning that parity is eroding. Expired/window-ended eviction reclaims
+    deliberately do NOT count: they displace no observable state."""
     decisions = snap.get("decisions", 0)
     if not decisions:
         return 0
-    return round((snap["steals"] + snap["drops"]) / decisions * 1_000_000)
+    return round(
+        (snap["evictions_live"] + snap["drops"]) / decisions * 1_000_000
+    )
 
 
 @dataclasses.dataclass(slots=True)
@@ -99,6 +108,7 @@ class SlabDeviceEngine:
         time_source,
         near_limit_ratio: float = 0.8,
         n_slots: int = 1 << 22,
+        ways: int = 0,
         batch_window_seconds: float = 0.0,
         max_batch: int = 65536,
         buckets: Sequence[int] = (128, 1024, 8192, 65536),
@@ -109,7 +119,6 @@ class SlabDeviceEngine:
         scope=None,
         max_queue: int = 0,
         watermark_high: float = 0.0,
-        watermark_critical: float = 0.0,
         overload=None,
         fault_injector=None,
         precompile: bool = False,
@@ -138,14 +147,22 @@ class SlabDeviceEngine:
         arm, same contract HOST_FAST_PATH set. Direct mode (window 0)
         ignores this knob.
 
-        watermark_high / watermark_critical: slab-occupancy watermarks in
-        (0, 1]; 0 disables. Evaluated on the health_snapshot (stats-flush)
-        cadence — never per batch. Past HIGH an expired-slot sweep
-        (ops/slab.py slab_sweep_expired) reclaims window-ended slots and a
-        degraded probe raises (watermark_reason); past CRITICAL submits
-        raise SlabSaturatedError so new-key admission degrades to the
-        configured shed posture instead of silently stealing live
-        counters."""
+        ways: set associativity (SLAB_WAYS) — the slab is n_slots/ways
+        sets of `ways` rows; a full set evicts its least-valuable way
+        in-kernel (ops/slab.py), so occupancy degrades smoothly and there
+        is no sweep pass or admission shed. 0 (the default) auto-selects
+        by platform: 128 on TPU (one lane register per set), 4 on hosts
+        (ops/slab.py default_ways). Power of two; clamped to n_slots for
+        tiny test slabs.
+
+        watermark_high: slab-occupancy fraction in (0, 1]; 0 disables.
+        Evaluated on the health_snapshot (stats-flush) cadence — never per
+        batch. Past it the degraded health probe raises (watermark_reason)
+        so operators see sustained pressure; admission is never shed —
+        collisions evict by value instead. (The old critical-watermark
+        shed died with the open-addressed layout; SLAB_WATERMARK_CRITICAL
+        is accepted-and-ignored at the settings layer with a deprecation
+        warning.)"""
         self._time_source = time_source
         self._near_limit_ratio = float(near_limit_ratio)
         if device is None:
@@ -158,6 +175,13 @@ class SlabDeviceEngine:
         if use_pallas is None:
             use_pallas = device.platform == "tpu"
         self._use_pallas = bool(use_pallas)
+        if not ways:
+            # SLAB_WAYS=0 (auto): platform-matched associativity — 128 on
+            # TPU (one lane register per set, the Mosaic scan shape), 8 on
+            # hosts (the scan is real per-item memory traffic there; see
+            # ops/slab.py default_ways). Same auto-select precedent as
+            # use_pallas above; snapshots rehash across geometry changes.
+            ways = default_ways(device.platform)
         # set after the first SUCCESSFUL pallas launch: the XLA-fallback
         # guard below only fires while the kernel is unproven on this
         # platform/toolchain, so a transient runtime error later (OOM, a
@@ -170,22 +194,27 @@ class SlabDeviceEngine:
             from ..parallel.sharded_slab import ShardedSlabEngine
 
             self._engine = ShardedSlabEngine(
-                mesh=mesh, n_slots_global=n_slots, use_pallas=self._use_pallas
+                mesh=mesh,
+                n_slots_global=n_slots,
+                ways=ways,
+                use_pallas=self._use_pallas,
             )
             self._state = None
+            self._ways = self._engine.ways
         else:
             self._state = jax.device_put(make_slab(n_slots), device)
+            self._ways = validate_ways(n_slots, ways)
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
         self._n_slots = n_slots
-        # lossy-event counters (probe steals / in-batch contention drops):
-        # per-launch device health vectors are parked un-fetched (reading 8
-        # bytes inline would add a D2H round trip to every launch) and
-        # drained on the stats-flush cadence. _state_lock serializes state
-        # rebinds (the steps donate their input state) against the
-        # occupancy read from the stats thread.
-        self._steals_total = 0
-        self._drops_total = 0
+        # lossy-event counters (the eviction mix / in-batch contention
+        # drops — ops/slab.py HEALTH_* layout): per-launch device health
+        # vectors are parked un-fetched (reading 16 bytes inline would add
+        # a D2H round trip to every launch) and drained on the stats-flush
+        # cadence. _state_lock serializes state rebinds (the steps donate
+        # their input state) against the occupancy read from the stats
+        # thread.
+        self._health_totals = [0] * HEALTH_WIDTH
         # decisions submitted to the device — the denominator that turns the
         # lossy-event counters into an alarmable RATE (VERDICT r4 weak #3:
         # absolute counts can triple silently; a ratio gauge cannot)
@@ -197,19 +226,13 @@ class SlabDeviceEngine:
         self.launch_sizes: collections.deque = collections.deque(maxlen=4096)
         self._pending_health: list = []
         self._state_lock = threading.Lock()
-        # slab-saturation watermarks: state machine driven by the occupancy
-        # gauge on the health_snapshot cadence (_apply_watermarks); the
-        # submit paths read one boolean.
+        # occupancy pressure watermark: a pure OBSERVABILITY threshold
+        # driven on the health_snapshot cadence (_apply_watermarks) — it
+        # raises the degraded health probe and nothing else. No sweep, no
+        # admission shed: the set-associative scan absorbs pressure by
+        # evicting least-valuable ways in-kernel.
         self._watermark_high = float(watermark_high)
-        self._watermark_critical = float(watermark_critical)
-        if 0 < self._watermark_critical < self._watermark_high:
-            raise ValueError(
-                f"critical watermark ({self._watermark_critical}) must not "
-                f"sit below the high watermark ({self._watermark_high})"
-            )
-        self._watermark_state = 0  # 0 normal / 1 high / 2 critical
-        self._saturated = False
-        self._sweeps_total = 0
+        self._watermark_state = 0  # 0 normal / 1 high
         # Both modes run double-buffered: the dispatcher's launch (pack +
         # owner routing in mesh mode + async device dispatch) of batch k+1
         # overlaps the collector's blocking readback of batch k (ADVICE r3:
@@ -308,9 +331,8 @@ class SlabDeviceEngine:
     def _drain_health_locked(self) -> None:
         pending, self._pending_health = self._pending_health, []
         for health in pending:
-            steals, drops = (int(v) for v in np.asarray(health))
-            self._steals_total += steals
-            self._drops_total += drops
+            for i, v in enumerate(np.asarray(health)):
+                self._health_totals[i] += int(v)
 
     def health_snapshot(self) -> dict:
         """Slab health for the stats tree (VERDICT round 1 weak #5): the two
@@ -330,8 +352,10 @@ class SlabDeviceEngine:
             self._drain_health_locked()
             live = int(slab_live_slots(self._state, now))
             snap = {
-                "steals": self._steals_total,
-                "drops": self._drops_total,
+                "evictions_expired": self._health_totals[HEALTH_EVICT_EXPIRED],
+                "evictions_window": self._health_totals[HEALTH_EVICT_WINDOW],
+                "evictions_live": self._health_totals[HEALTH_EVICT_LIVE],
+                "drops": self._health_totals[HEALTH_DROPS],
                 "decisions": self._decisions_total,
                 "live_slots": live,
                 "occupancy": live / self._n_slots,
@@ -341,36 +365,15 @@ class SlabDeviceEngine:
         return snap
 
     def _apply_watermarks(self, snap: dict, now: int) -> None:
-        """Occupancy -> watermark state machine. Past HIGH: run one
-        expired-slot sweep (single-chip; the mesh engine owns its own
-        state and only gets the saturation flag) and refresh the
-        occupancy the snapshot reports. Past CRITICAL: flip the
-        saturation flag the submit paths read."""
-        high, crit = self._watermark_high, self._watermark_critical
-        if high <= 0 and crit <= 0:
-            snap["sweeps"] = self._sweeps_total
-            snap["watermark"] = 0
-            return
+        """Occupancy -> pressure flag. Purely observational: past HIGH the
+        degraded health probe raises so operators see sustained pressure
+        building; admission and the launch path are untouched — the
+        eviction scan is the relief valve, and its mix (evictions_live
+        climbing) is the signal that pressure has started costing
+        counters."""
+        high = self._watermark_high
         occ = snap["occupancy"]
-        if high > 0 and occ >= high and self._engine is None:
-            with self._state_lock:
-                self._state, swept = slab_sweep_expired(self._state, now)
-                self._sweeps_total += 1
-                live = int(slab_live_slots(self._state, now))
-            _log.warning(
-                "slab high watermark (occupancy %.3f >= %.3f): sweep "
-                "reclaimed %d window-ended slots",
-                occ,
-                high,
-                int(swept),
-            )
-            snap["live_slots"] = live
-            occ = snap["occupancy"] = live / self._n_slots
-        state = 0
-        if crit > 0 and occ >= crit:
-            state = 2
-        elif high > 0 and occ >= high:
-            state = 1
+        state = 1 if (high > 0 and occ >= high) else 0
         if state != self._watermark_state:
             _log.warning(
                 "slab watermark state %d -> %d (occupancy %.3f)",
@@ -379,32 +382,17 @@ class SlabDeviceEngine:
                 occ,
             )
         self._watermark_state = state
-        self._saturated = state == 2
-        snap["sweeps"] = self._sweeps_total
         snap["watermark"] = state
 
     def watermark_reason(self) -> str | None:
         """HealthChecker degraded-probe contract: a reason string while the
-        slab sits past a watermark, else None."""
-        state = self._watermark_state
-        if state >= 2:
-            return (
-                f"slab saturated: occupancy >= critical watermark "
-                f"{self._watermark_critical:g}; new-key admission by policy"
-            )
-        if state == 1:
+        slab sits past the pressure watermark, else None."""
+        if self._watermark_state:
             return (
                 f"slab pressure: occupancy >= high watermark "
-                f"{self._watermark_high:g}; sweeping expired slots"
+                f"{self._watermark_high:g}; sets evicting by value"
             )
         return None
-
-    def _check_saturated(self) -> None:
-        if self._saturated:
-            raise SlabSaturatedError(
-                f"slab occupancy past critical watermark "
-                f"{self._watermark_critical:g}"
-            )
 
     def precompile(self) -> dict:
         """Dispatch-floor attack, part 1: compile every launch shape the
@@ -441,6 +429,86 @@ class SlabDeviceEngine:
             self._h_pack, self._h_launch, self._h_readback = saved
         return self.precompiled
 
+    def profile_slab_split(
+        self, scope=None, batch: int | None = None, iters: int = 30
+    ) -> dict:
+        """The `slab_split` stage baseline for future kernel work: times
+        the slab step's three memory-system stages — contiguous set
+        GATHER, W-wide SCAN arithmetic, one-row-per-way SCATTER — as
+        standalone jitted programs over this engine's live geometry
+        (ops/slab.py make_split_programs; each program IS the shipped
+        helper the fused step compiles). Runs against a detached device
+        copy of the table, so the donated-state chain and live counters
+        are untouched. When `scope` is given every sample also lands in
+        <scope>.split.{gather,scan,scatter}_ms histograms — bench.py and
+        tools/hotpath_profile.py report from those same histograms, so
+        the published baseline and /metrics cannot disagree. Returns
+        {batch, gather_ns, scan_ns, scatter_ns} (per-launch p50); {} on
+        the mesh engine (per-shard programs profile via
+        tools/profile_engine.py)."""
+        if self._engine is not None:
+            return {}
+        from ..ops.slab import make_split_programs
+
+        b = int(batch or min(self._max_bucket, 8192))
+        gather, scan, scatter = make_split_programs(self._ways)
+        with self._state_lock:
+            table = slab_export_copy(self._state)
+        rng = np.random.default_rng(7)
+
+        def u32(size):
+            return jnp.asarray(
+                rng.integers(0, 1 << 32, size=size, dtype=np.uint64).astype(
+                    np.uint32
+                )
+            )
+
+        fp_lo, fp_hi = u32(b), u32(b)
+        now = jnp.int32(int(self._time_source.unix_now()))
+        hists = {}
+        if scope is not None:
+            split_scope = scope.scope("split")
+            hists = {
+                k: split_scope.histogram(f"{k}_ms")
+                for k in ("gather", "scan", "scatter")
+            }
+
+        def timed(name, fn) -> int:
+            jax.block_until_ready(fn())  # compile + warm
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                ms = (time.perf_counter() - t0) * 1e3
+                samples.append(ms)
+                if name in hists:
+                    hists[name].record(ms)
+            return round(float(np.median(samples)) * 1e6)
+
+        rows = jax.block_until_ready(gather(table, fp_lo))
+        result = {"batch": b}
+        result["gather_ns"] = timed("gather", lambda: gather(table, fp_lo))
+        result["scan_ns"] = timed(
+            "scan", lambda: scan(rows, fp_lo, fp_hi, now)
+        )
+        # unique write targets (the fused step guarantees one writer per
+        # way); lanes past the table drop, like padding lanes do
+        idx = np.full(b, self._n_slots, dtype=np.int32)
+        k = min(b, self._n_slots)
+        idx[:k] = rng.permutation(self._n_slots)[:k].astype(np.int32)
+        write_idx = jnp.asarray(idx)
+        new_rows = u32((b, ROW_WIDTH))
+        # the scatter donates its table (matching the hot path); rebind the
+        # returned buffer each call — `table` is consumed by the first one
+        sc_state = {"t": table}
+
+        def sc():
+            sc_state["t"] = scatter(sc_state["t"], write_idx, new_rows)
+            return sc_state["t"]
+
+        result["scatter_ns"] = timed("scatter", sc)
+        return result
+
     def submit(self, items: list[_Item]) -> list[int]:
         """Batched fixed-window increment; returns each item's
         post-increment counter. Compatibility verb: the engine is
@@ -451,7 +519,6 @@ class SlabDeviceEngine:
             raise RuntimeError("engine is in block_mode; use submit_block")
         if not items:
             return []
-        self._check_saturated()
         if self._dispatch is not None:
             return self._dispatch.submit(
                 _items_to_block(items), owned=True, reuse_out=True
@@ -474,7 +541,6 @@ class SlabDeviceEngine:
         host-side bookkeeping."""
         if block.shape[1] == 0:
             return np.empty(0, dtype=np.uint32)
-        self._check_saturated()
         if self._dispatch is not None:
             # ring path: the frame is copied into this thread's submit
             # ring, and the verdicts come back in this thread's reusable
@@ -538,6 +604,12 @@ class SlabDeviceEngine:
         if self._engine is not None:
             return self._engine.shard_slots
         return self._n_slots
+
+    @property
+    def ways(self) -> int:
+        """Set associativity — stamped into snapshot headers so a restore
+        under a different SLAB_WAYS rehashes instead of misplacing rows."""
+        return self._ways
 
     def export_tables(self) -> list[np.ndarray]:
         """Quiesce-and-copy for the snapshotter: under the state lock only
@@ -620,6 +692,7 @@ class SlabDeviceEngine:
                 self._state, after_dev, health = slab_step_after(
                     self._state,
                     packed,
+                    ways=self._ways,
                     out_dtype=dtype,
                     use_pallas=self._use_pallas,
                 )
@@ -641,7 +714,11 @@ class SlabDeviceEngine:
                 _log.warning("pallas slab kernel failed; using XLA path: %s", e)
                 self._use_pallas = False
                 self._state, after_dev, health = slab_step_after(
-                    self._state, packed, out_dtype=dtype, use_pallas=False
+                    self._state,
+                    packed,
+                    ways=self._ways,
+                    out_dtype=dtype,
+                    use_pallas=False,
                 )
             self._pending_health.append(health)
             self._decisions_total += n
@@ -687,11 +764,6 @@ class SlabDeviceEngine:
         were ever renamed)."""
         return self._block_batcher
 
-    @property
-    def saturated(self) -> bool:
-        """True while occupancy sits past the critical watermark."""
-        return self._saturated
-
     def submit_block(self, block: np.ndarray) -> np.ndarray:
         """Batched fixed-window increment over one uint32[6, n] column
         block (the sidecar wire layout: fp_lo, fp_hi, hits, limit, divider,
@@ -702,7 +774,6 @@ class SlabDeviceEngine:
         block with numpy row copies only. Requires block_mode=True."""
         if not self._block_batcher:
             raise RuntimeError("engine not in block_mode")
-        self._check_saturated()
         if self._dispatch is not None:
             # wire blocks are one-shot buffers: hand ownership to the ring
             # (no arena copy); results are owned arrays (the server may
@@ -837,54 +908,77 @@ def _items_to_block(items: list[_Item]) -> np.ndarray:
 class SlabHealthStats:
     """StatGenerator exporting the slab's health on every stats flush:
 
-        ratelimit.slab.steals      cumulative live-victim displacements
+        ratelimit.slab.evictions.expired  in-kernel reclaims of expired
+                                          (TTL-dead) ways — pure reuse
+        ratelimit.slab.evictions.window   evictions of live ways whose
+                                          fixed window had ended (no
+                                          decision state displaced)
+        ratelimit.slab.evictions.live     evictions of live in-window ways
+                                          — the ONLY lossy tier (the
+                                          evicted key fails open)
         ratelimit.slab.drops       cumulative in-batch contention drops
         ratelimit.slab.decisions   cumulative decisions submitted on-device
-        ratelimit.slab.loss_ppm    (steals+drops) per million decisions
-                                   over the window SINCE THE LAST FLUSH —
-                                   the parity-erosion alarm gauge. A
-                                   lifetime ratio would dilute with uptime
-                                   (1e9 clean decisions hide a lost
-                                   100k-decision burst under ~100ppm); the
-                                   per-window delta stays alarmable
-                                   forever, and the cumulative counters
-                                   are still exported for dashboards that
-                                   prefer their own windows.
+        ratelimit.slab.loss_ppm    (evictions.live + drops) per million
+                                   decisions over the window SINCE THE
+                                   LAST FLUSH — the parity-erosion alarm
+                                   gauge. A lifetime ratio would dilute
+                                   with uptime (1e9 clean decisions hide a
+                                   lost 100k-decision burst under
+                                   ~100ppm); the per-window delta stays
+                                   alarmable forever, and the cumulative
+                                   counters are still exported for
+                                   dashboards that prefer their own
+                                   windows.
         ratelimit.slab.live_slots  currently live (unexpired) slots
-        ratelimit.slab.occupancy   live fraction x 1e6 (gauges are ints)
-        ratelimit.slab.sweeps      cumulative high-watermark sweep passes
-        ratelimit.slab.watermark   0 normal / 1 high / 2 critical
+        ratelimit.slab.occupancy   live fraction x 1e6 (gauges are ints) —
+                                   a SMOOTH gauge all the way to 100%: the
+                                   set scan absorbs pressure by value-
+                                   ranked eviction, never by shedding
+        ratelimit.slab.watermark   0 normal / 1 past SLAB_WATERMARK_HIGH
+                                   (observability only)
 
-    Both lossy behaviors fail open (ops/slab.py:30-39); these gauges make
-    the loss rate operable instead of silent. Works for the in-process
-    engine and the mesh-sharded engine alike (both expose
+    The lossy behaviors fail open (ops/slab.py docstring); these gauges
+    make the loss rate operable instead of silent. Works for the
+    in-process engine and the mesh-sharded engine alike (both expose
     health_snapshot())."""
 
     def __init__(self, engine, scope):
         self._engine = engine
-        self._last = {"steals": 0, "drops": 0, "decisions": 0}
+        self._last = {
+            "evictions_live": 0,
+            "drops": 0,
+            "decisions": 0,
+        }
+        # dotted literals (not a sub-scope): the metrics lint treats each
+        # literal as a Prometheus family name, and bare "expired"/"window"
+        # would collide with the lease counters of the same spelling
         self._gauges = {
-            "steals": scope.gauge("steals"),
+            "evictions_expired": scope.gauge("evictions.expired"),
+            "evictions_window": scope.gauge("evictions.window"),
+            "evictions_live": scope.gauge("evictions.live"),
             "drops": scope.gauge("drops"),
             "decisions": scope.gauge("decisions"),
             "loss_ppm": scope.gauge("loss_ppm"),
             "live_slots": scope.gauge("live_slots"),
             "occupancy": scope.gauge("occupancy"),
-            "sweeps": scope.gauge("sweeps"),
             "watermark": scope.gauge("watermark"),
         }
 
     def generate_stats(self) -> None:
         snap = self._engine.health_snapshot()
-        self._gauges["steals"].set(snap["steals"])
-        self._gauges["drops"].set(snap["drops"])
+        for k in (
+            "evictions_expired",
+            "evictions_window",
+            "evictions_live",
+            "drops",
+        ):
+            self._gauges[k].set(snap[k])
         self._gauges["decisions"].set(snap.get("decisions", 0))
         delta = {k: snap.get(k, 0) - v for k, v in self._last.items()}
         self._last = {k: snap.get(k, 0) for k in self._last}
         self._gauges["loss_ppm"].set(_loss_ppm(delta))
         self._gauges["live_slots"].set(snap["live_slots"])
         self._gauges["occupancy"].set(int(snap["occupancy"] * 1_000_000))
-        self._gauges["sweeps"].set(snap.get("sweeps", 0))
         self._gauges["watermark"].set(snap.get("watermark", 0))
 
 
@@ -895,6 +989,7 @@ class TpuRateLimitCache:
         self,
         base_limiter: BaseRateLimiter,
         n_slots: int = 1 << 22,
+        ways: int = 0,
         batch_window_seconds: float = 0.0,
         max_batch: int = 65536,
         buckets: Sequence[int] = (128, 1024, 8192, 65536),
@@ -905,7 +1000,6 @@ class TpuRateLimitCache:
         stats_scope=None,
         max_queue: int = 0,
         watermark_high: float = 0.0,
-        watermark_critical: float = 0.0,
         overload=None,
         fault_injector=None,
         precompile: bool = False,
@@ -950,6 +1044,7 @@ class TpuRateLimitCache:
                 time_source=base_limiter.time_source,
                 near_limit_ratio=base_limiter.near_limit_ratio,
                 n_slots=n_slots,
+                ways=ways,
                 batch_window_seconds=batch_window_seconds,
                 max_batch=max_batch,
                 buckets=buckets,
@@ -959,7 +1054,6 @@ class TpuRateLimitCache:
                 scope=stats_scope,
                 max_queue=max_queue,
                 watermark_high=watermark_high,
-                watermark_critical=watermark_critical,
                 overload=overload,
                 fault_injector=fault_injector,
                 precompile=precompile,

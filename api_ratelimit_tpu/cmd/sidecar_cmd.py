@@ -133,12 +133,13 @@ def main() -> None:
         ewma_alpha=settings.overload_ewma_alpha,
         scope=scope,
     )
-    watermark_high, watermark_critical = settings.slab_watermarks()
+    settings.warn_deprecated_knobs(logger)
 
     engine = SlabDeviceEngine(
         time_source=RealTimeSource(),
         near_limit_ratio=settings.near_limit_ratio,
         n_slots=settings.tpu_slab_slots,
+        ways=settings.slab_ways_count(),
         batch_window_seconds=settings.tpu_batch_window,
         max_batch=settings.tpu_batch_limit,
         use_pallas=None if settings.tpu_use_pallas else False,
@@ -150,8 +151,7 @@ def main() -> None:
         block_mode=True,
         scope=scope,
         max_queue=settings.overload_max_queue,
-        watermark_high=watermark_high,
-        watermark_critical=watermark_critical,
+        watermark_high=settings.slab_watermark(),
         overload=overload,
         fault_injector=fault_injector,
         # compile the bucket ladder before the first frontend connects —

@@ -70,3 +70,29 @@ def split_fingerprints(fps: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     lo = (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     hi = (fps >> np.uint64(32)).astype(np.uint32)
     return lo, hi
+
+
+def set_index(fp_lo, n_sets: int):
+    """THE set-index split of the 64-bit fingerprint for the W-way
+    set-associative slab (ops/slab.py): the low log2(n_sets) bits of the
+    LOW fingerprint half select the set; the full (lo, hi) pair stays the
+    stored tag, so set selection never weakens key identity. The HIGH half
+    is deliberately left out: the mesh owner hash ((fp_lo ^ fp_hi) mod
+    n_dev, parallel/sharded_slab.py) draws on fp_hi's low bits and the
+    in-set way-preference rotation on fp_hi's bits [log2 W, 2*log2 W)
+    (ops/slab.py _choose_ways), and keeping the three selectors on
+    disjoint bit sources keeps them statistically independent — within
+    one (shard, set) cell the owner hash has already pinned fp_hi's low
+    bits, so a rotation drawn from them would collide n_dev times more
+    often than chance.
+
+    One definition serves every consumer — the device kernel, the
+    snapshot rehash migration (persist/snapshot.py), and the per-set
+    occupancy histogram (tools/snapshot_inspect.py) — so placement can
+    never diverge between restore and runtime. Works on numpy and jnp
+    uint32 arrays alike (a pure mask)."""
+    if n_sets <= 0 or n_sets & (n_sets - 1):
+        raise ValueError(f"n_sets must be a power of two, got {n_sets}")
+    # a bare python-int mask stays weak-typed under numpy and jax alike,
+    # so the result keeps fp_lo's uint32 dtype in both worlds
+    return fp_lo & (n_sets - 1)

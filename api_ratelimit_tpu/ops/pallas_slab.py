@@ -229,6 +229,142 @@ def _slab_apply_kernel(
     out_refs[9][...] = jnp.where(valid & is_over, over_delta_over, zero)
 
 
+# --- the W-way set scan -----------------------------------------------------
+#
+# The set-associative layout (ops/slab.py) makes the lookup/insert/evict
+# decision a bounded W-wide scan per item, and with W == LANES a set is
+# EXACTLY one lane register: sets tile across the grid one per sublane row
+# (tile = (block_rows, 128) — block_rows items' sets per grid step), and
+# the scan's reductions (any(match), argmin(victim score), the picked-way
+# select) are single cross-lane ops. XLA still owns the set gather that
+# produces these tiles (contiguous W-row blocks ride the native dynamic
+# gather); this kernel owns everything between gather and sort: liveness,
+# tag match, the tiered eviction valuation, and the way choice.
+
+# eviction tier packing — MUST mirror ops/slab.py (_choose_ways); the
+# interpret-mode differential test pins the two scans bit-for-bit
+_SCORE_TIER_SHIFT = 28
+_TIER_WINDOW_ENDED, _TIER_LIVE = 1, 2
+
+
+def _way_scan_kernel(
+    now_ref,
+    st_fp_lo_ref,
+    st_fp_hi_ref,
+    st_count_ref,
+    st_window_ref,
+    st_expire_ref,
+    st_div_ref,
+    q_fp_lo_ref,
+    q_fp_hi_ref,
+    out_ref,
+):
+    now = now_ref[0]
+    expire = st_expire_ref[...]
+    div = st_div_ref[...]
+    count = st_count_ref[...]
+    live = expire > now
+    match = (
+        live
+        & (st_fp_lo_ref[...] == q_fp_lo_ref[...])
+        & (st_fp_hi_ref[...] == q_fp_hi_ref[...])
+    )
+    window_ended = live & (div > 0) & (st_window_ref[...] + div <= now)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, expire.shape, 1)
+    way_bits = 7  # log2(LANES); this kernel is the ways == 128 shape
+    # fp_hi bits [7, 14) — the same rotation source as the XLA scan
+    # (ops/slab.py _choose_ways): low bits belong to the mesh owner hash,
+    # top bits to the sort tiebreaker. The mask keeps the arithmetic
+    # int32 shift exact.
+    pref = (q_fp_hi_ref[...] >> jnp.int32(way_bits)) & jnp.int32(LANES - 1)
+    rot = (lane - pref) & jnp.int32(LANES - 1)
+    count_cap = (1 << (_SCORE_TIER_SHIFT - way_bits)) - 1
+    cnt = jnp.minimum(count, jnp.int32(count_cap))
+    tier = jnp.where(
+        live,
+        jnp.where(window_ended, _TIER_WINDOW_ENDED, _TIER_LIVE),
+        0,
+    )
+    sub = jnp.where(live, (cnt << way_bits) | rot, rot)
+    score = (tier << _SCORE_TIER_SHIFT) | sub
+
+    # argmin via min + first-lane-at-min: scores are unique within a row
+    # (rot is a bijection over lanes), so the select is exact
+    min_score = jnp.min(score, axis=1, keepdims=True)
+    victim = jnp.min(
+        jnp.where(score == min_score, lane, jnp.int32(LANES)),
+        axis=1,
+        keepdims=True,
+    )
+    m_any = jnp.max(match.astype(jnp.int32), axis=1, keepdims=True)
+    m_way = jnp.min(
+        jnp.where(match, lane, jnp.int32(LANES)), axis=1, keepdims=True
+    )
+    way = jnp.where(m_any > 0, m_way, victim)
+
+    # one output tile: lane 0 = chosen way, lane 1 = matched flag (the
+    # caller slices; a (b, 2) output would fight the lane tiling)
+    out_ref[...] = jnp.where(lane == 0, way, m_any)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_way_scan(
+    st_fp_lo: jnp.ndarray,  # uint32[b, W] the gathered set planes
+    st_fp_hi: jnp.ndarray,
+    st_count: jnp.ndarray,
+    st_window: jnp.ndarray,
+    st_expire: jnp.ndarray,
+    st_div: jnp.ndarray,
+    q_fp_lo: jnp.ndarray,  # uint32[b] the querying items
+    q_fp_hi: jnp.ndarray,
+    now: jnp.ndarray,  # int32 scalar
+    interpret: bool = False,
+):
+    """Run the W-way set scan over gathered set planes; returns
+    (int32[b] chosen way, bool[b] matched) — bit-identical to the XLA
+    scan in ops/slab.py _choose_ways (pinned by tests/test_pallas_slab.py).
+    Requires W == LANES (= 128, the default SLAB_WAYS): a set per sublane
+    row is the whole point of the shape."""
+    b, w = st_fp_lo.shape
+    if w != LANES:
+        raise ValueError(f"pallas way scan needs ways == {LANES}, got {w}")
+    block_rows = math.gcd(b, BLOCK_ROWS)
+
+    as_i32 = lambda x: x.astype(jnp.int32)
+    # per-item query words broadcast across the lane axis: the kernel has
+    # no per-sublane scalar path, and the (b, W) planes it joins are the
+    # dominant traffic anyway
+    q_lo = jnp.broadcast_to(as_i32(q_fp_lo)[:, None], (b, w))
+    q_hi = jnp.broadcast_to(as_i32(q_fp_hi)[:, None], (b, w))
+
+    block = pl.BlockSpec((block_rows, LANES), lambda i, *_: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b // block_rows,),
+        in_specs=[block] * 8,
+        out_specs=[block],
+        scratch_shapes=[],
+    )
+    (out,) = pl.pallas_call(
+        _way_scan_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, w), jnp.int32)],
+        interpret=interpret,
+    )(
+        now.astype(jnp.int32).reshape(1),
+        as_i32(st_fp_lo),
+        as_i32(st_fp_hi),
+        as_i32(st_count),
+        as_i32(st_window),
+        as_i32(st_expire),
+        as_i32(st_div),
+        q_lo,
+        q_hi,
+    )
+    return out[:, 0], out[:, 1] > 0
+
+
 @functools.partial(
     jax.jit, static_argnames=("decide", "lean", "interpret")
 )

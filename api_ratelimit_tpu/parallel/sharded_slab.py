@@ -68,6 +68,12 @@ def _require_shard_map():
     return shard_map
 
 from ..ops.slab import (
+    DEFAULT_WAYS,
+    HEALTH_DROPS,
+    HEALTH_EVICT_EXPIRED,
+    HEALTH_EVICT_LIVE,
+    HEALTH_EVICT_WINDOW,
+    HEALTH_WIDTH,
     PACKED_OUT_ROWS,
     ROW_FP_HI,
     ROW_FP_LO,
@@ -79,7 +85,9 @@ from ..ops.slab import (
     _slab_update_sorted,
     _unpack,
     _unsort,
+    default_ways,
     live_slot_count,
+    validate_ways,
 )
 
 SHARD_AXIS = "shard"
@@ -105,7 +113,7 @@ def _owner_mask(fp_lo, fp_hi, axis: str):
     return owner == me.astype(jnp.uint32)
 
 
-def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
+def _sharded_body(table, packed, *, ways: int, use_pallas: bool, axis: str):
     """Per-device body under shard_map. table: local shard [n_local, ROW_WIDTH];
     packed: replicated uint32[7, b]. Returns (new local shard, replicated
     uint32[8, b] results in arrival order, uint32[2] mesh-wide health)."""
@@ -115,7 +123,7 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
     state, s_before, s_after, d, order, health = _slab_step_sorted(
-        SlabState(table=table), batch, now, near_ratio, n_probes, use_pallas
+        SlabState(table=table), batch, now, near_ratio, ways, use_pallas
     )
 
     # Unsort ON DEVICE (the host-side unsort trick of slab_step_packed does
@@ -140,7 +148,7 @@ def _sharded_body(table, packed, *, n_probes: int, use_pallas: bool, axis: str):
 
 
 def _sharded_body_after(
-    table, packed, *, n_probes: int, cap: int, use_pallas: bool, axis: str
+    table, packed, *, ways: int, cap: int, use_pallas: bool, axis: str
 ):
     """after-mode per-device body: stateful update only; psum the single
     saturating-cast post-increment row (see ops/slab.py compact modes) and
@@ -151,7 +159,7 @@ def _sharded_body_after(
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
     state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
-        SlabState(table=table), batch, now, n_probes, use_pallas=use_pallas
+        SlabState(table=table), batch, now, ways, use_pallas=use_pallas
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     after = jnp.where(owned, after, jnp.uint32(0))
@@ -178,18 +186,18 @@ def _build_step(mesh: Mesh, body, out_spec: P, **kw):
     return jax.jit(mapped, donate_argnums=(0,))
 
 
-def sharded_slab_step(mesh: Mesh, n_probes: int = 4, use_pallas: bool = False):
+def sharded_slab_step(mesh: Mesh, ways: int = DEFAULT_WAYS, use_pallas: bool = False):
     """Build the jitted mesh-wide full step: (state, packed) -> (state,
     out[8, b]). state is sharded P(axis, None); packed and out are
     replicated. Compiled once per batch-bucket shape (the backend pads to
     fixed buckets)."""
     return _build_step(
-        mesh, _sharded_body, P(None, None), n_probes=n_probes, use_pallas=use_pallas
+        mesh, _sharded_body, P(None, None), ways=ways, use_pallas=use_pallas
     )
 
 
 def sharded_slab_step_after(
-    mesh: Mesh, cap: int, n_probes: int = 4, use_pallas: bool = False
+    mesh: Mesh, cap: int, ways: int = DEFAULT_WAYS, use_pallas: bool = False
 ):
     """Build the jitted mesh-wide after-mode step: (state, packed) ->
     (state, after[b] saturated at cap), the production readback path."""
@@ -197,7 +205,7 @@ def sharded_slab_step_after(
         mesh,
         _sharded_body_after,
         P(None),
-        n_probes=n_probes,
+        ways=ways,
         cap=cap,
         use_pallas=use_pallas,
     )
@@ -234,14 +242,14 @@ def sharded_slab_step_after(
 
 
 def _sharded_body_after_compact(
-    table, block, *, n_probes: int, cap: int, use_pallas: bool, axis: str
+    table, block, *, ways: int, cap: int, use_pallas: bool, axis: str
 ):
     """block: [1, 7, bucket] — this device's own bucket only. No owner
     masking needed: the host routed every item here because this shard owns
     it. Returns ([1, bucket] saturated counters, mesh-summed health)."""
     batch, now, _near = _unpack(block[0])
     state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
-        SlabState(table=table), batch, now, n_probes, use_pallas=use_pallas
+        SlabState(table=table), batch, now, ways, use_pallas=use_pallas
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     health = jax.lax.psum(health, axis)
@@ -253,7 +261,7 @@ def _sharded_body_after_compact(
 
 
 def sharded_slab_step_after_compact(
-    mesh: Mesh, cap: int, n_probes: int = 4, use_pallas: bool = False
+    mesh: Mesh, cap: int, ways: int = DEFAULT_WAYS, use_pallas: bool = False
 ):
     """(state, blocks[n_dev, 7, bucket]) -> (state, after[n_dev, bucket],
     health[2]); state and blocks sharded on the leading axis, after sharded
@@ -263,7 +271,7 @@ def sharded_slab_step_after_compact(
         functools.partial(
             _sharded_body_after_compact,
             axis=axis,
-            n_probes=n_probes,
+            ways=ways,
             cap=cap,
             use_pallas=use_pallas,
         ),
@@ -285,7 +293,7 @@ class ShardedSlabEngine:
         self,
         mesh: Mesh | None = None,
         n_slots_global: int = 1 << 22,
-        n_probes: int = 4,
+        ways: int = 0,
         use_pallas: bool = False,
     ):
         if mesh is None:
@@ -299,6 +307,15 @@ class ShardedSlabEngine:
                 f"({n_dev}) x a power of two"
             )
         self.n_slots_global = n_slots_global
+        # per-shard associativity: every SET lives wholly on one shard
+        # (owner routing picks the shard, the set-index split then picks a
+        # set within the shard's own flat table), so per-shard snapshots
+        # stay flat (n_local, ROW_WIDTH) arrays and the v1->v2 rehash
+        # migration applies per shard file. ways=0 auto-selects by the
+        # mesh's device platform (ops/slab.py default_ways).
+        if not ways:
+            ways = default_ways(next(iter(mesh.devices.flat)).platform)
+        self.ways = validate_ways(n_local, ways)
         axis = mesh.axis_names[0]
         self._state_sharding = NamedSharding(mesh, P(axis, None))
         self._batch_sharding = NamedSharding(mesh, P(None, None))
@@ -306,14 +323,14 @@ class ShardedSlabEngine:
             jnp.zeros((n_slots_global, ROW_WIDTH), dtype=jnp.uint32),
             self._state_sharding,
         )
-        self._n_probes = n_probes
         self._use_pallas = use_pallas
-        self._step = sharded_slab_step(mesh, n_probes=n_probes, use_pallas=use_pallas)
+        self._step = sharded_slab_step(mesh, ways=self.ways, use_pallas=use_pallas)
         self._after_steps: dict[int, object] = {}
         self._compact_steps: dict[int, object] = {}
         self._blocks_sharding = NamedSharding(mesh, P(axis, None, None))
-        self.steals_total = 0
-        self.drops_total = 0
+        # cumulative mesh-wide health: the eviction mix + contention drops
+        # (ops/slab.py HEALTH_* layout)
+        self.health_totals = [0] * HEALTH_WIDTH
         axis_name = axis
         self._live_slots = jax.jit(
             _require_shard_map()(
@@ -346,7 +363,7 @@ class ShardedSlabEngine:
         step = self._after_steps.get(cap)
         if step is None:
             step = sharded_slab_step_after(
-                self.mesh, cap, n_probes=self._n_probes, use_pallas=self._use_pallas
+                self.mesh, cap, ways=self.ways, use_pallas=self._use_pallas
             )
             self._after_steps[cap] = step
         packed_dev = jax.device_put(packed, self._batch_sharding)
@@ -413,7 +430,7 @@ class ShardedSlabEngine:
             step = sharded_slab_step_after_compact(
                 self.mesh,
                 cap,
-                n_probes=self._n_probes,
+                ways=self.ways,
                 use_pallas=self._use_pallas,
             )
             self._compact_steps[cap] = step
@@ -490,9 +507,8 @@ class ShardedSlabEngine:
     def _drain_health_locked(self) -> None:
         pending, self._pending_health = self._pending_health, []
         for health in pending:
-            steals, drops = (int(v) for v in np.asarray(health))
-            self.steals_total += steals
-            self.drops_total += drops
+            for i, v in enumerate(np.asarray(health)):
+                self.health_totals[i] += int(v)
 
     def health_snapshot(self, now: int | None = None) -> dict:
         """Cumulative mesh-wide lossy-event counters + live-slot occupancy
@@ -505,8 +521,10 @@ class ShardedSlabEngine:
             self._drain_health_locked()
             live = int(self._live_slots(self._state, now))
             return {
-                "steals": self.steals_total,
-                "drops": self.drops_total,
+                "evictions_expired": self.health_totals[HEALTH_EVICT_EXPIRED],
+                "evictions_window": self.health_totals[HEALTH_EVICT_WINDOW],
+                "evictions_live": self.health_totals[HEALTH_EVICT_LIVE],
+                "drops": self.health_totals[HEALTH_DROPS],
                 "live_slots": live,
                 "occupancy": live / self.n_slots_global,
             }

@@ -6,7 +6,7 @@ One file per slab shard. Layout (all integers little-endian):
     offset  size  field
     0       8     magic          b"SLABSNP1"
     8       4     version        format version (SNAPSHOT_VERSION)
-    12      4     flags          reserved, 0
+    12      4     flags          bit 0: lease table; bits 16-31: slab ways
     16      8     created_at     unix seconds the copy was taken at
     24      8     n_slots        rows in this shard's table
     32      4     row_width      uint32 words per row (ops/slab.py ROW_WIDTH)
@@ -41,7 +41,17 @@ import zlib
 import numpy as np
 
 MAGIC = b"SLABSNP1"
-SNAPSHOT_VERSION = 1
+# Version history:
+#   1  open-addressed slab (PR 4): rows placed by the K-probe double hash;
+#      flags carried only FLAG_LEASE_TABLE (PR 8).
+#   2  W-way set-associative slab: a row may live ONLY in set
+#      fp_lo mod n_sets (ops/hashing.py set_index); the header flags'
+#      high half records the ways the writer ran with. v1 files (and v2
+#      files written under a different SLAB_WAYS) load fine and are
+#      REHASHED into sets at restore (migrate_rows_to_sets) — an
+#      old-version snapshot is migrated, never rejected.
+SNAPSHOT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 # Mirror of ops/slab.py's fused row format (tests/test_persist.py pins the
 # equivalence) — redeclared here so offline tools read rows without jax.
@@ -52,8 +62,11 @@ COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
 # pre-flag format) is a slab shard; FLAG_LEASE_TABLE marks the lease
 # liability registry (backends/lease.py export_rows — one row per
 # outstanding (fp, window) grant). The flag keeps the two table kinds from
-# masquerading as each other: both are (n, 8) uint32.
+# masquerading as each other: both are (n, 8) uint32. Bits 16-31 carry the
+# writer's set associativity (v2 slab shards; 0 = unknown/v1 — the loader
+# treats that as "rehash on restore").
 FLAG_LEASE_TABLE = 1
+FLAG_WAYS_SHIFT = 16
 
 # Mirror of backends/lease.py's liability row layout (tests pin equality).
 LEASE_ROW_WIDTH = 8
@@ -93,6 +106,13 @@ class SnapshotHeader:
     payload_len: int
     flags: int = 0
 
+    @property
+    def ways(self) -> int:
+        """Set associativity the writer ran with; 0 = unknown (a v1 file,
+        or a lease table) — restore rehashes when it differs from the
+        running config."""
+        return (self.flags >> FLAG_WAYS_SHIFT) & 0xFFFF
+
     def pack(self) -> bytes:
         head = _HEADER.pack(
             MAGIC,
@@ -131,10 +151,10 @@ def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
     (header_crc,) = _HEADER_CRC.unpack_from(raw, _HEADER.size)
     if zlib.crc32(raw[: _HEADER.size]) != header_crc:
         raise SnapshotError(f"{path}: header CRC mismatch")
-    if version != SNAPSHOT_VERSION:
+    if version not in SUPPORTED_VERSIONS:
         raise SnapshotError(
-            f"{path}: snapshot version {version} != supported "
-            f"{SNAPSHOT_VERSION}"
+            f"{path}: snapshot version {version} not in supported "
+            f"{SUPPORTED_VERSIONS}"
         )
     header = SnapshotHeader(
         version=version,
@@ -163,8 +183,13 @@ def write_snapshot(
     shard_count: int = 1,
     fault_injector=None,
     flags: int = 0,
+    ways: int = 0,
+    version: int = SNAPSHOT_VERSION,
 ) -> int:
     """Atomically write one shard's row table; returns bytes written.
+    ways (slab shards only) stamps the writer's set associativity into
+    the header flags so a restore under a different SLAB_WAYS knows to
+    rehash. `version` exists for tests that craft old-format fixtures.
 
     fault_injector (testing/faults.py) is consulted at site
     'snapshot.write': 'error' raises OSError before any byte lands;
@@ -182,8 +207,10 @@ def write_snapshot(
     if table.ndim != 2:
         raise ValueError(f"snapshot table must be 2-D, got {table.shape}")
     payload = table.tobytes()
+    if ways:
+        flags = int(flags) | (int(ways) << FLAG_WAYS_SHIFT)
     header = SnapshotHeader(
-        version=SNAPSHOT_VERSION,
+        version=int(version),
         created_at=int(created_at),
         n_slots=table.shape[0],
         row_width=table.shape[1],
@@ -284,8 +311,8 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
       * rows whose FIXED WINDOW ended (window + divider <= now) carry no
         decision state even while TTL-pinned — the next touch would roll
         the window and restart at 0 (ops/slab.py same_window gate) — so
-        they are dropped too, exactly the population slab_sweep_expired
-        reclaims under the high watermark;
+        they are dropped too, exactly the population the set scan evicts
+        ahead of any live-window row;
       * live rows inside a still-open window keep their counts: these are
         the counters a warm restart exists to preserve.
 
@@ -312,6 +339,80 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
         "dropped_expired": int(np.sum(occupied & ~live)),
         "dropped_window": int(np.sum(window_ended)),
     }
+
+
+def migrate_rows_to_sets(
+    table: np.ndarray, ways: int
+) -> tuple[np.ndarray, dict]:
+    """Rehash a shard table into the W-way set-associative layout — the
+    boot migration for v1 (open-addressed) snapshots and for v2 snapshots
+    written under a different SLAB_WAYS. Row CONTENT is layout-independent
+    (fp, count, window, expire, divider); only PLACEMENT moves: each
+    occupied row lands in set `fp_lo mod n_sets` (the same
+    ops/hashing.py set_index split the kernel uses), filling ways in
+    descending-count order so that if a set overflows its W ways the
+    lowest-count rows are the ones dropped (counted — the same
+    least-valuable-first rule the in-kernel eviction applies).
+
+    Call AFTER reconcile_rows: dead and window-ended rows are already
+    gone, so only live counters compete for ways. Returns (migrated
+    table, {'placed', 'dropped_overflow'})."""
+    table = np.asarray(table, dtype=np.uint32)
+    n_slots = table.shape[0]
+    if ways <= 0 or ways & (ways - 1):
+        raise SnapshotError(f"ways must be a power of two, got {ways}")
+    ways = min(ways, n_slots)
+    if n_slots % ways:
+        raise SnapshotError(
+            f"table of {n_slots} rows does not split into {ways}-way sets"
+        )
+    n_sets = n_slots // ways
+    out = np.zeros_like(table)
+    occupied = np.flatnonzero(table.any(axis=1))
+    placed = dropped = 0
+    if occupied.size == 0:
+        return out, {"placed": 0, "dropped_overflow": 0}
+    rows = table[occupied]
+    # the set-index split (ops/hashing.py set_index): low bits of fp_lo
+    sets = (rows[:, COL_FP_LO] & np.uint32(n_sets - 1)).astype(np.int64)
+    counts = rows[:, COL_COUNT].astype(np.int64)
+    # group by set; within a set highest counts first (overflow drops the
+    # least valuable), stable so equal counts keep their original order
+    order = np.lexsort((-counts, sets))
+    sets_sorted = sets[order]
+    run_start = np.r_[0, np.flatnonzero(sets_sorted[1:] != sets_sorted[:-1]) + 1]
+    marker = np.zeros(order.size, dtype=np.int64)
+    marker[run_start] = 1
+    run_id = np.cumsum(marker) - 1
+    rank = np.arange(order.size) - run_start[run_id]
+    keep = rank < ways
+    placed_idx = order[keep]
+    out[sets[placed_idx] * ways + rank[keep]] = rows[placed_idx]
+    placed = int(keep.sum())
+    dropped = int((~keep).sum())
+    return out, {"placed": placed, "dropped_overflow": dropped}
+
+
+def set_occupancy_histogram(
+    table: np.ndarray, ways: int, now: int | None = None
+) -> np.ndarray:
+    """int64[ways + 1] histogram of per-set occupancy: entry k = how many
+    sets hold exactly k occupied (or, with `now`, live) rows. The offline
+    inspector renders this so operators can see set pressure — a mass
+    near W means collisions are about to start costing live evictions."""
+    table = np.asarray(table, dtype=np.uint32)
+    n_slots = table.shape[0]
+    ways = min(ways, n_slots) if ways > 0 else n_slots
+    if ways & (ways - 1) or n_slots % ways:
+        raise SnapshotError(
+            f"table of {n_slots} rows does not split into {ways}-way sets"
+        )
+    if now is None:
+        used = table.any(axis=1)
+    else:
+        used = table[:, COL_EXPIRE].astype(np.int64) > int(now)
+    per_set = used.reshape(n_slots // ways, ways).sum(axis=1)
+    return np.bincount(per_set, minlength=ways + 1).astype(np.int64)
 
 
 def reconcile_leases(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:

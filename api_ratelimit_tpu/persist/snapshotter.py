@@ -39,9 +39,11 @@ from .snapshot import (
     FLAG_LEASE_TABLE,
     LEASE_ROW_WIDTH,
     ROW_WIDTH,
+    SNAPSHOT_VERSION,
     SnapshotError,
     apply_lease_floors,
     load_snapshot,
+    migrate_rows_to_sets,
     reconcile_leases,
     reconcile_rows,
     write_snapshot,
@@ -194,6 +196,7 @@ class SlabSnapshotter:
                 now = int(self._time_source.unix_now())
                 paths = snapshot_paths(self._dir, len(tables))
                 total = 0
+                ways = int(getattr(self._engine, "ways", 0))
                 for i, (path, table) in enumerate(zip(paths, tables)):
                     total += write_snapshot(
                         path,
@@ -202,6 +205,7 @@ class SlabSnapshotter:
                         shard_index=i,
                         shard_count=len(tables),
                         fault_injector=self._faults,
+                        ways=ways,
                     )
                 # lease-liability section: outstanding grants ride the
                 # same snapshot set so a restart never double-grants
@@ -251,8 +255,15 @@ class SlabSnapshotter:
             return self.restore_stats
         now = int(self._time_source.unix_now())
         shard_slots = int(getattr(self._engine, "shard_slots"))
+        engine_ways = int(getattr(self._engine, "ways", 0))
         tables: list[np.ndarray] = []
-        totals = {"restored": 0, "dropped_expired": 0, "dropped_window": 0}
+        totals = {
+            "restored": 0,
+            "dropped_expired": 0,
+            "dropped_window": 0,
+            "migrated": 0,
+            "dropped_overflow": 0,
+        }
         created_at = None
         try:
             for i, path in enumerate(paths):
@@ -274,7 +285,19 @@ class SlabSnapshotter:
                 if created_at is None or header.created_at < created_at:
                     created_at = header.created_at  # oldest shard bounds loss
                 table, stats = reconcile_rows(table, now)
-                for k in totals:
+                # layout migration: a v1 (open-addressed) shard, or a v2
+                # shard written under a different SLAB_WAYS, rehashes its
+                # live rows into the running set geometry — an old
+                # snapshot is migrated, never rejected. Same-geometry v2
+                # files skip the rehash entirely.
+                if engine_ways and (
+                    header.version < SNAPSHOT_VERSION
+                    or header.ways != engine_ways
+                ):
+                    table, mig = migrate_rows_to_sets(table, engine_ways)
+                    totals["migrated"] += mig["placed"]
+                    totals["dropped_overflow"] += mig["dropped_overflow"]
+                for k in stats:
                     totals[k] += stats[k]
                 tables.append(table)
             lease_stats = self._restore_leases(tables, now)
@@ -294,11 +317,14 @@ class SlabSnapshotter:
             self._g_dropped_window.set(totals["dropped_window"])
         _log.info(
             "slab restored from %s: %d live rows (%d expired, %d "
-            "window-ended dropped), snapshot age %ds",
+            "window-ended dropped, %d rehashed into sets, %d set-overflow "
+            "dropped), snapshot age %ds",
             self._dir,
             totals["restored"],
             totals["dropped_expired"],
             totals["dropped_window"],
+            totals["migrated"],
+            totals["dropped_overflow"],
             max(0, now - created_at) if created_at is not None else -1,
         )
         # success contract: 'restored' carries the live-row COUNT and there

@@ -112,7 +112,7 @@ def create_limiter(
 
             devices = jax.devices()[: settings.tpu_mesh_devices]
             mesh = Mesh(np.array(devices), ("shard",))
-        watermark_high, watermark_critical = settings.slab_watermarks()
+        settings.warn_deprecated_knobs(logger)
         kwargs = {}
         ladder = settings.buckets()
         if ladder is not None:
@@ -120,14 +120,14 @@ def create_limiter(
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
+            ways=settings.slab_ways_count(),
             batch_window_seconds=settings.tpu_batch_window,
             max_batch=settings.tpu_batch_limit,
             use_pallas=None if settings.tpu_use_pallas else False,
             mesh=mesh,
             stats_scope=scope,
             max_queue=settings.overload_max_queue,
-            watermark_high=watermark_high,
-            watermark_critical=watermark_critical,
+            watermark_high=settings.slab_watermark(),
             overload=overload,
             fault_injector=fault_injector,
             # the bucket ladder compiles BEFORE the server reports

@@ -96,6 +96,15 @@ class Settings:
     max_sleeping_routines: int = 0  # src/service/ratelimit.go:337-341
     # --- TPU backend knobs (this framework) ---
     tpu_slab_slots: int = 1 << 22
+    # set associativity of the slab (ops/slab.py): the table is
+    # TPU_SLAB_SLOTS / SLAB_WAYS sets of SLAB_WAYS rows, and every
+    # lookup/insert/evict is one W-wide vector scan over the key's set.
+    # 0 (the default) auto-selects by platform — 128 on TPU (one lane
+    # register per set, the Mosaic way-scan shape), 4 on hosts where the
+    # scan is real per-item memory traffic (ops/slab.py default_ways).
+    # Explicit values must be a power of two; snapshots taken under a
+    # different SLAB_WAYS rehash at restore, never reject.
+    slab_ways: int = 0
     tpu_batch_window: float = 0.0  # seconds; 0 = direct mode
     tpu_batch_limit: int = 65536
     tpu_mesh_devices: int = 0  # 0 = single chip; N = shard slab over N devices
@@ -194,10 +203,13 @@ class Settings:
     # time_remaining / x-envoy-expected-rq-timeout-ms) and drop expired
     # work before device launches instead of answering late
     overload_deadline_propagation: bool = True
-    # slab-saturation watermarks (occupancy fractions in (0, 1]; 0 = off):
-    # past HIGH an expired-slot sweep reclaims window-ended slots and the
-    # healthcheck reports pressure; past CRITICAL new submits shed by the
-    # OVERLOAD_SHED_MODE posture instead of silently evicting live counters
+    # slab pressure watermark (occupancy fraction in (0, 1]; 0 = off):
+    # past HIGH the healthcheck reports pressure (degraded probe) —
+    # observability only; the set-associative slab absorbs collisions by
+    # in-kernel least-valuable-way eviction, never by shedding admission.
+    # SLAB_WATERMARK_CRITICAL is DEPRECATED and ignored: setting it logs a
+    # one-line warning at boot instead of failing (the critical-watermark
+    # admission shed died with the open-addressed layout).
     slab_watermark_high: float = 0.0
     slab_watermark_critical: float = 0.0
     # --- warm restart (this framework; persist/) ---
@@ -309,25 +321,47 @@ class Settings:
             )
         return v
 
-    def slab_watermarks(self) -> tuple[float, float]:
-        """Validated (high, critical) occupancy watermarks; each 0 = off.
-        Junk (out of (0, 1], or critical below high) fails the boot."""
+    def slab_watermark(self) -> float:
+        """Validated SLAB_WATERMARK_HIGH occupancy pressure watermark
+        (0 = off; drives only the degraded health probe). Junk (out of
+        [0, 1]) fails the boot. A set SLAB_WATERMARK_CRITICAL is
+        DEPRECATED: it no longer gates anything (the set-associative slab
+        evicts in-kernel instead of shedding) and is reported once at
+        boot by warn_deprecated_knobs(), never a boot failure."""
         high = float(self.slab_watermark_high)
-        crit = float(self.slab_watermark_critical)
-        for name, v in (
-            ("SLAB_WATERMARK_HIGH", high),
-            ("SLAB_WATERMARK_CRITICAL", crit),
-        ):
-            if v < 0 or v > 1:
-                raise ValueError(
-                    f"{name} must be an occupancy fraction in [0, 1], got {v}"
-                )
-        if 0 < crit < high:
+        if high < 0 or high > 1:
             raise ValueError(
-                f"SLAB_WATERMARK_CRITICAL ({crit}) must not sit below "
-                f"SLAB_WATERMARK_HIGH ({high})"
+                f"SLAB_WATERMARK_HIGH must be an occupancy fraction in "
+                f"[0, 1], got {high}"
             )
-        return high, crit
+        return high
+
+    def slab_ways_count(self) -> int:
+        """Validated SLAB_WAYS set associativity; 0 = auto (the engine
+        picks the platform default — ops/slab.py default_ways). Junk
+        (non-power-of-two, negative) fails the boot like every other
+        knob — a typo'd associativity must not silently become a
+        different table geometry."""
+        ways = int(self.slab_ways)
+        if ways == 0:
+            return 0
+        if ways < 0 or ways & (ways - 1):
+            raise ValueError(
+                f"SLAB_WAYS must be 0 (auto) or a positive power of two, "
+                f"got {ways}"
+            )
+        return ways
+
+    def warn_deprecated_knobs(self, log) -> None:
+        """One-line deprecation warnings for knobs that are accepted but
+        ignored, so old deployment configs keep booting (the runner and
+        the sidecar call this once at startup)."""
+        if float(self.slab_watermark_critical) > 0:
+            log.warning(
+                "SLAB_WATERMARK_CRITICAL is deprecated and ignored: the "
+                "set-associative slab evicts least-valuable ways in-kernel "
+                "instead of shedding admission (see README, slab layout)"
+            )
 
     def snapshot_config(self) -> tuple[str, float, float]:
         """Validated (dir, interval_ms, stale_after_ms) for the warm-
@@ -514,6 +548,7 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ),
     ("slab_watermark_high", "SLAB_WATERMARK_HIGH", float),
     ("slab_watermark_critical", "SLAB_WATERMARK_CRITICAL", float),
+    ("slab_ways", "SLAB_WAYS", int),
     ("slab_snapshot_dir", "SLAB_SNAPSHOT_DIR", str),
     (
         "slab_snapshot_interval_ms",

@@ -18,6 +18,173 @@ from __future__ import annotations
 
 import numpy as np
 
+# mirrors of the ops/slab.py layout/constants (redeclared so the oracle
+# stays importable without jax; tests pin the equivalence)
+ROW_WIDTH = 8
+COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
+SCORE_TIER_SHIFT = 28
+EVICT_NONE, EVICT_EXPIRED, EVICT_WINDOW, EVICT_LIVE = range(4)
+
+
+class SetSlabOracle:
+    """Exact sequential host model of the W-way set-associative slab step
+    (ops/slab.py): set selection, fingerprint match, eviction valuation
+    (dead, then window-ended, then lowest-count live — rotation tiebreak),
+    within-batch duplicate serialization, the winner-per-way contention
+    rule (a same-batch fingerprint match always outlives a colliding
+    evictor; among colliding inserts the higher top-16 fp_hi bits win),
+    and the health counters. The differential fuzz campaign
+    (tests/test_slab_fuzz.py) holds the device step to this model
+    bit-for-bit — results, final table, AND eviction mix — at arbitrary
+    occupancy, which is what makes >100% load a testable regime instead
+    of an untestable one.
+
+    One modeled restriction: when two DISTINCT colliding keys share their
+    top-16 fp_hi bits, the device sort interleaves their segments and
+    both undercount (probability 2^-16 per colliding pair in production,
+    documented in ops/slab.py); the oracle raises instead of guessing, and
+    the fuzz generators construct fingerprints with unique top bits."""
+
+    def __init__(self, n_slots: int, ways: int):
+        ways = min(int(ways), int(n_slots))
+        if ways <= 0 or ways & (ways - 1):
+            raise ValueError(f"ways must be a positive power of two: {ways}")
+        if n_slots % ways:
+            raise ValueError(f"{n_slots} rows don't split into {ways}-way sets")
+        self.n_slots = int(n_slots)
+        self.ways = ways
+        self.n_sets = self.n_slots // ways
+        self.way_bits = max(1, (ways - 1).bit_length())
+        slot_bits = self.n_slots.bit_length()
+        self.fp_bits = max(0, min(16, 32 - slot_bits - 1))
+        self.table = np.zeros((self.n_slots, ROW_WIDTH), dtype=np.uint64)
+        # cumulative uint32[4]: evictions expired/window/live + drops —
+        # the ops/slab.py HEALTH_* layout
+        self.health = [0, 0, 0, 0]
+
+    def _choose(self, fp_lo: int, fp_hi: int, now: int):
+        """(slot, matched, evict_class) against the CURRENT table — the
+        kernel scans every item against the pre-batch state."""
+        base = (fp_lo & (self.n_sets - 1)) * self.ways
+        count_cap = (1 << (SCORE_TIER_SHIFT - self.way_bits)) - 1
+        pref = (fp_hi >> self.way_bits) & (self.ways - 1)
+        best_w, best_score = 0, 1 << 62
+        for w in range(self.ways):
+            r = self.table[base + w]
+            live = int(r[COL_EXPIRE]) > now
+            if (
+                live
+                and int(r[COL_FP_LO]) == fp_lo
+                and int(r[COL_FP_HI]) == fp_hi
+            ):
+                return base + w, True, EVICT_NONE
+            ended = (
+                live
+                and int(r[COL_DIVIDER]) > 0
+                and int(r[COL_WINDOW]) + int(r[COL_DIVIDER]) <= now
+            )
+            tier = (1 if ended else 2) if live else 0
+            rot = (w - pref) & (self.ways - 1)
+            sub = (
+                ((min(int(r[COL_COUNT]), count_cap) << self.way_bits) | rot)
+                if live
+                else rot
+            )
+            score = (tier << SCORE_TIER_SHIFT) | sub
+            if score < best_score:
+                best_score, best_w = score, w
+        victim = self.table[base + best_w]
+        v_exp = int(victim[COL_EXPIRE])
+        if v_exp > now:
+            ended = (
+                int(victim[COL_DIVIDER]) > 0
+                and int(victim[COL_WINDOW]) + int(victim[COL_DIVIDER]) <= now
+            )
+            cls = EVICT_WINDOW if ended else EVICT_LIVE
+        else:
+            cls = EVICT_EXPIRED if v_exp > 0 else EVICT_NONE
+        return base + best_w, False, cls
+
+    def step_batch(self, items, now: int):
+        """items: list of (fp_lo, fp_hi, hits, limit, divider, jitter);
+        hits == 0 marks padding. Returns (before, after, codes,
+        health_delta) in arrival order — codes by the decide rule
+        (2 = OVER when after > limit, else 1)."""
+        now = int(now)
+        n = len(items)
+        before, after, codes = [0] * n, [0] * n, [0] * n
+        # pass 1: scan every item against the pre-batch table
+        segs: dict = {}  # (slot, fp_lo, fp_hi) -> [matched, cls, [idx...]]
+        order = []  # first-arrival order of segment keys, for stable wins
+        for i, (fp_lo, fp_hi, hits, _limit, _div, _jit) in enumerate(items):
+            if hits <= 0:
+                continue
+            slot, matched, cls = self._choose(fp_lo, fp_hi, now)
+            key = (slot, fp_lo, fp_hi)
+            if key not in segs:
+                segs[key] = [matched, cls, []]
+                order.append(key)
+            segs[key][2].append(i)
+        # pass 2: serialize duplicates + pick each way's winning segment
+        by_slot: dict = {}
+        delta = [0, 0, 0, 0]
+        for key in order:
+            slot, fp_lo, fp_hi = key
+            matched, cls, idxs = segs[key]
+            row = self.table[slot]
+            div = max(int(items[idxs[0]][4]), 1)
+            cur_window = (now // div) * div
+            running = (
+                int(row[COL_COUNT])
+                if matched and int(row[COL_WINDOW]) == cur_window
+                else 0
+            )
+            for i in idxs:
+                hits, limit = int(items[i][2]), int(items[i][3])
+                before[i] = running
+                running += hits
+                after[i] = running
+                codes[i] = 2 if after[i] > limit else 1
+            by_slot.setdefault(slot, []).append(
+                (key, matched, cls, running, idxs[-1], cur_window)
+            )
+        writes = []
+        for slot, contenders in by_slot.items():
+            winner = None
+            for c in contenders:
+                if c[1]:  # a fingerprint match always wins the way
+                    winner = c
+            if winner is None:
+                tops = [c[0][2] >> (32 - self.fp_bits) for c in contenders]
+                if len(set(tops)) != len(tops):
+                    raise AssertionError(
+                        "distinct colliding keys share top fp_hi bits: the "
+                        "device sort would interleave their segments "
+                        "(2^-16 per pair; construct fuzz fps uniquely)"
+                    )
+                winner = max(contenders, key=lambda c: c[0][2] >> (32 - self.fp_bits))
+            delta[3] += len(contenders) - 1  # losing segments drop, counted
+            (slot_, fp_lo, fp_hi), _m, cls, total, last_i, _cur_window = winner
+            if cls != EVICT_NONE:
+                delta[cls - 1] += 1
+            # the kernel's row write takes divider/jitter (and therefore
+            # the stored window) from the segment's LAST item
+            div = max(int(items[last_i][4]), 1)
+            jit = int(items[last_i][5])
+            cur_window = (now // div) * div
+            writes.append(
+                (
+                    slot,
+                    [fp_lo, fp_hi, total, cur_window, now + div + jit, div, 0, 0],
+                )
+            )
+        # pass 3: ONE write per way, after every scan (the kernel scatter)
+        for slot, row in writes:
+            self.table[slot] = np.array(row, dtype=np.uint64)
+        for k in range(4):
+            self.health[k] += delta[k]
+        return before, after, codes, delta
+
 
 def occurrence_rank(ids: np.ndarray) -> np.ndarray:
     """rank[i] = how many earlier stream positions hold the same id.

@@ -35,7 +35,8 @@ Artifact field guide (round 5 additions):
                                   loop rate for diagnosis)
   engine.parity.lossy_events/explained
                                   structural drift bound: every false_ok
-                                  must be covered by drops + steals*limit
+                                  must be covered by drops +
+                                  evictions_live*limit
   service.stages                  per-stage count/p50/p99 sourced from the
                                   RUNTIME histograms recorded during the
                                   drive (queue_wait/pack/launch/readback/
@@ -197,6 +198,30 @@ def zipf_ids(n_keys: int, batch: int, n_batches: int, seed: int = 0) -> np.ndarr
     return ids.reshape(n_batches, batch).astype(np.uint32)
 
 
+def default_ways_bench(on_tpu: bool) -> int:
+    """The platform default SLAB_WAYS the engine would auto-select
+    (ops/slab.py default_ways) — the bench measures the SHIPPED geometry:
+    128-way sets on TPU, 8-way on the CPU fallback."""
+    from api_ratelimit_tpu.ops.slab import default_ways
+
+    return default_ways("tpu" if on_tpu else "cpu")
+
+
+def fmix32_np(x: np.ndarray) -> np.ndarray:
+    """murmur3 finalizer on uint32 — the numpy twin of bench_engine_zipf's
+    on-device `fmix`. The slab's set/way/shard selectors read disjoint
+    bit FIELDS of the fingerprint (ops/hashing.py), so host-staged ids
+    must expand through a real finalizer: a bare `ids * odd-constant`
+    leaves its low bits a lattice and collides way preferences that
+    hashed production fingerprints never would."""
+    x = np.asarray(x, dtype=np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    return x ^ (x >> np.uint32(16))
+
+
 def measure_link(device) -> dict:
     """Host<->device link diagnostics for the artifact: dispatch+readback
     round-trip latency and D2H bandwidth. In this dev environment the chip
@@ -242,8 +267,9 @@ def bench_engine_zipf(
         decision shipped back (packbits of the over-limit mask)
       * the same split into device-pipeline time vs readback drain, so a
         slow dev tunnel is attributed instead of hidden
-      * parity vs the exact oracle + the slab health counters (steals,
-        drops, live slots) that attribute any parity loss (VERDICT r3 #7)
+      * parity vs the exact oracle + the slab health counters (the
+        eviction mix, drops, live slots) that attribute any parity loss
+        (VERDICT r3 #7)
     Deferred into the returned extras closure (main() runs it after the
     tier sweep so its cold-cache compiles can't starve the other tiers):
       * rate_xla_update / rate_pallas_update: the other engine's twin
@@ -275,6 +301,7 @@ def bench_engine_zipf(
     # any such warm-replay speedup.
     n_batches = 32
     use_pallas = engine_use_pallas(on_tpu)
+    ways = default_ways_bench(on_tpu)
     now = int(time.time())
 
     def fmix(x):  # murmur3 finalizer: a bijection on uint32
@@ -305,7 +332,7 @@ def bench_engine_zipf(
             expand(ids),
             jnp.int32(now),
             jnp.float32(0.8),
-            n_probes=4,
+            ways=ways,
             use_pallas=use_pallas,
             count_health=True,
             # only the code comes back: the lean kernel skips the five
@@ -325,7 +352,7 @@ def bench_engine_zipf(
             state,
             expand(ids),
             jnp.int32(now),
-            n_probes=4,
+            ways=ways,
             count_health=True,
             use_pallas=use_pallas,
         )
@@ -395,7 +422,7 @@ def bench_engine_zipf(
                 fetched_first = fetched_pass
         t_e2e = time.perf_counter() - t0
         decisions = k * batch
-        steals, drops = (
+        ev_expired, ev_window, ev_live, drops = (
             int(v) for v in np.asarray(jnp.stack(healths)).sum(axis=0)
         )
         live = int(slab_live_slots(state, now))
@@ -438,7 +465,9 @@ def bench_engine_zipf(
                 else {}
             ),
             "health": {
-                "steals": steals,
+                "evictions_expired": ev_expired,
+                "evictions_window": ev_window,
+                "evictions_live": ev_live,
                 "drops": drops,
                 "live_slots": live,
                 "occupancy": round(live / n_slots, 4),
@@ -463,6 +492,7 @@ def bench_engine_zipf(
     result = {
         "batch": batch,
         "n_slots": n_slots,
+        "ways": ways,
         "pallas": use_pallas,
         **decided,
     }
@@ -481,19 +511,22 @@ def bench_engine_zipf(
     over_bits = np.concatenate([np.unpackbits(b) for b in bits])
     full = parity_report(stream, over_bits, limit=100, code_over=1)
     health = decided.get("health", {})
-    steals, drops = health.get("steals", 0), health.get("drops", 0)
+    ev_live = health.get("evictions_live", 0)
+    drops = health.get("drops", 0)
     result["parity"] = {
         "agreement": round(full["agreement"], 6),
         "false_over": full["false_over"],
         "false_ok": full["false_ok"],
         "oracle_over_frac": round(full["oracle_over_frac"], 4),
-        # structural drift bound (VERDICT r4 weak #3): each drop can cost at
-        # most 1 false_ok, each steal at most `limit` (=100 here) — the
-        # counters cover all timed steps, a superset of the parity window
-        # (warmup + first staged pass), so `explained` failing means
-        # disagreements exist that no counted lossy event accounts for.
-        "lossy_events": steals + drops,
-        "explained": bool(full["false_ok"] <= drops + steals * 100),
+        # structural drift bound (VERDICT r4 weak #3): each drop can cost
+        # at most 1 false_ok, each LIVE eviction at most `limit` (=100
+        # here; expired/window reclaims displace no observable state) —
+        # the counters cover all timed steps, a superset of the parity
+        # window (warmup + first staged pass), so `explained` failing
+        # means disagreements exist that no counted lossy event accounts
+        # for.
+        "lossy_events": ev_live + drops,
+        "explained": bool(full["false_ok"] <= drops + ev_live * 100),
     }
     print(f"[engine] parity={result['parity']}", file=sys.stderr)
     publish(result)
@@ -537,6 +570,151 @@ def bench_engine_zipf(
             staged_box["arrays"] = []
 
     return result, extras
+
+
+def bench_slab_occupancy(device, on_tpu: bool, left=lambda: 1e9) -> dict:
+    """The cliff-is-gone sweep (ISSUE 9 acceptance): offered LIVE-KEY load
+    from 10% to 120% of slab capacity against the production after-mode
+    step, one point per load factor. At each point a fresh slab is
+    pre-filled with `load * n_slots` distinct keys (one shared long
+    window, so every key stays live for the whole point), then a uniform
+    stream over those same keys is timed: throughput, p99 per-launch
+    latency, and the eviction mix.
+
+    What the old layout did here: past SLAB_WATERMARK_CRITICAL it
+    refused new keys outright (SlabSaturatedError — offered load above
+    the watermark was a SERVED-rate cliff), and below it leaned on
+    stop-the-world sweeps. The set-associative slab instead absorbs
+    >100% load by in-kernel least-valuable-way eviction: the sweep's
+    acceptance shape is rate staying monotone-smooth through 1.2x while
+    `evictions.live` (not throughput) carries the pressure."""
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        SlabBatch,
+        _slab_update_sorted,
+        _unsort,
+        make_slab,
+        slab_live_slots,
+    )
+
+    batch = (1 << 17) if on_tpu else (1 << 13)
+    n_slots = (1 << 21) if on_tpu else (1 << 16)
+    n_timed = 24  # timed launches per load point
+    now = int(time.time())
+    use_pallas = engine_use_pallas(on_tpu)
+    ways = default_ways_bench(on_tpu)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix32_np_dev(ids),
+            fp_hi=fmix32_np_dev(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 1 << 30),  # never over: pure update load
+            divider=jnp.full_like(ids, 1 << 20).astype(jnp.int32),  # one window
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    def fmix32_np_dev(x):  # murmur3 finalizer, on device (see fmix32_np)
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    @functools.partial(
+        jax.jit, donate_argnames=("state",), static_argnames=("use_pallas",)
+    )
+    def step(state, ids, use_pallas):
+        state, _b, s_after, _i, order, health, _ = _slab_update_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now),
+            ways=ways,
+            count_health=True,
+            use_pallas=use_pallas,
+        )
+        after = jnp.minimum(_unsort(s_after, order), jnp.uint32(0xFFFF))
+        return state, after.astype(jnp.uint16), health
+
+    rng = np.random.RandomState(9)
+    points = []
+    result = {
+        "batch": batch,
+        "n_slots": n_slots,
+        "ways": ways,
+        "pallas": use_pallas,
+        "points": points,
+    }
+    for load in (0.1, 0.25, 0.5, 0.75, 0.9, 1.0, 1.1, 1.2):
+        if left() < 30:
+            points.append({"load": load, "skipped": "budget"})
+            continue
+        n_keys = int(load * n_slots)
+        state = make_slab(n_slots, device=device)
+        # pre-fill: every key once (insert path; the tail past capacity
+        # starts evicting) — untimed
+        fill = np.arange(n_keys, dtype=np.uint32)
+        rng.shuffle(fill)
+        for off in range(0, n_keys, batch):
+            chunk = np.zeros(batch, dtype=np.uint32)
+            src = fill[off : off + batch]
+            chunk[: src.size] = src
+            chunk[src.size :] = src[0] if src.size else 0  # dup-pad, harmless
+            state, _a, _h = step(state, jax.device_put(chunk, device), use_pallas)
+        # timed: uniform stream over the SAME live key set
+        staged = [
+            jax.device_put(
+                rng.randint(0, n_keys, size=batch).astype(np.uint32), device
+            )
+            for _ in range(n_timed)
+        ]
+        jax.block_until_ready(staged[-1])
+        healths = []
+        # warm the timed shape once (the fill above already compiled it)
+        state, _a, h = step(state, staged[0], use_pallas)
+        jax.block_until_ready(h)
+        times = []
+        for ids in staged:
+            t0 = time.perf_counter()
+            state, _a, h = step(state, ids, use_pallas)
+            jax.block_until_ready(h)
+            times.append(time.perf_counter() - t0)
+            healths.append(h)
+        ev = np.asarray(jnp.stack(healths)).sum(axis=0)
+        live = int(slab_live_slots(state, now))
+        point = {
+            "load": load,
+            "n_keys": n_keys,
+            "rate": round(n_timed * batch / sum(times)),
+            "p99_launch_ms": round(
+                float(np.percentile(np.array(times) * 1e3, 99)), 3
+            ),
+            "occupancy": round(live / n_slots, 4),
+            "evictions": {
+                "expired": int(ev[0]),
+                "window": int(ev[1]),
+                "live": int(ev[2]),
+                "drops": int(ev[3]),
+            },
+        }
+        points.append(point)
+        print(f"[slab_occupancy] {point}", file=sys.stderr)
+        del state, staged
+    rates = [p["rate"] for p in points if "rate" in p]
+    if rates:
+        # the acceptance shape in one number: worst point-to-point dip
+        # across the sweep (0 = perfectly monotone-smooth; the OLD layout
+        # shed admission outright past the critical watermark)
+        worst_dip = max(
+            (1 - b / a) for a, b in zip(rates, rates[1:])
+        ) if len(rates) > 1 else 0.0
+        result["worst_rate_dip_pct"] = round(max(0.0, worst_dip) * 100, 2)
+        result["rate_at_50pct"] = next(
+            (p["rate"] for p in points if p.get("load") == 0.5), None
+        )
+    return result
 
 
 # ---------------- service-level benches (configs[0..3]) ----------------
@@ -746,6 +924,34 @@ _DISPATCH_STAGE_HISTOGRAMS = (
 )
 
 
+# The slab step's memory-system stages, in NANOSECONDS per launch: the
+# contiguous set gather, the W-wide scan arithmetic, and the row scatter —
+# recorded by SlabDeviceEngine.profile_slab_split into the same runtime
+# histograms GET /metrics renders (ratelimit.slab.split.*). The baseline
+# future kernel work (Mosaic scan fusion, gather tiling) measures against.
+_SLAB_STAGE_HISTOGRAMS = (
+    ("gather_ns", "ratelimit.slab.split.gather_ms"),
+    ("scan_ns", "ratelimit.slab.split.scan_ms"),
+    ("scatter_ns", "ratelimit.slab.split.scatter_ms"),
+)
+
+
+def _slab_split(store) -> dict:
+    """Per-launch slab-stage count/p50/p99 (ns) from the runtime
+    histograms profile_slab_split recorded."""
+    hists = store.metrics_snapshot()["histograms"]
+    out = {}
+    for short, name in _SLAB_STAGE_HISTOGRAMS:
+        h = hists.get(name)
+        if h and h["count"]:
+            out[short] = {
+                "count": h["count"],
+                "p50": round(h["p50"] * 1e6),
+                "p99": round(h["p99"] * 1e6),
+            }
+    return out
+
+
 def _dispatch_split(store) -> dict:
     """Per-stage count/p50/p99 (ns) for the dispatch loop's owner cycle,
     from the runtime histograms recorded during the timed drive."""
@@ -953,6 +1159,13 @@ def bench_service(
     total, elapsed, lat = _drive_service(service, reqs, n_threads, per_thread)
     p99 = round(float(np.percentile(lat, 99)), 3)
     stages = _stage_timings(store)
+    # slab stage-split baseline (off the timed path, against a detached
+    # table copy): gather/scan/scatter ns into ratelimit.slab.split.*
+    eng = getattr(cache, "engine", None)
+    if eng is not None and hasattr(eng, "profile_slab_split"):
+        eng.profile_slab_split(
+            scope=store.scope("ratelimit").scope("slab"), iters=15
+        )
     cache.close()
 
     result = {
@@ -972,6 +1185,9 @@ def bench_service(
     dispatch_split = _dispatch_split(store)
     if dispatch_split:
         result["dispatch_split"] = dispatch_split
+    slab_split = _slab_split(store)
+    if slab_split:
+        result["slab_split"] = slab_split
     readback = stages.get("readback_ms")
     if readback:
         # co-located estimate: the measured p99 minus the typical blocking
@@ -1205,12 +1421,10 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
 
     def pack(ids: np.ndarray) -> np.ndarray:
         packed = np.zeros((7, ids.size), dtype=np.uint32)
-        # two independent murmur-finalizer bijections (see bench_engine_zipf)
-        x = ids.astype(np.uint64)
-        lo = (x * 0x9E3779B185EBCA87) & 0xFFFFFFFF
-        hi = ((x ^ 0xA5A5A5A5) * 0xC2B2AE3D27D4EB4F) & 0xFFFFFFFF
-        packed[ROW_FP_LO] = lo
-        packed[ROW_FP_HI] = hi
+        # two independent murmur-finalizer bijections (see fmix32_np)
+        x = ids.astype(np.uint32)
+        packed[ROW_FP_LO] = fmix32_np(x)
+        packed[ROW_FP_HI] = fmix32_np(x ^ np.uint32(0xA5A5A5A5))
         packed[ROW_HITS] = 1
         packed[ROW_LIMIT] = 100
         packed[ROW_DIVIDER] = 1
@@ -1281,13 +1495,15 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
     dev0 = jax.devices()[0]
     state = jax.device_put(make_slab(engine.n_slots_global), dev0)
     state, after, _h = slab_step_after(
-        state, blocks[-1], out_dtype=jnp.uint16, use_pallas=engine_use_pallas(on_tpu)
+        state, blocks[-1], ways=default_ways_bench(on_tpu),
+        out_dtype=jnp.uint16, use_pallas=engine_use_pallas(on_tpu)
     )
     np.asarray(after)
     t0 = time.perf_counter()
     for b in slices[2]:
         state, after, _h = slab_step_after(
-            state, b, out_dtype=jnp.uint16, use_pallas=engine_use_pallas(on_tpu)
+            state, b, ways=default_ways_bench(on_tpu),
+            out_dtype=jnp.uint16, use_pallas=engine_use_pallas(on_tpu)
         )
         np.asarray(after)
     single_elapsed = time.perf_counter() - t0
@@ -1335,6 +1551,7 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
         single_jit = jax.jit(
             _ft.partial(
                 slab_step_after,
+                ways=default_ways_bench(on_tpu),
                 out_dtype=jnp.uint16,
                 use_pallas=engine_use_pallas(on_tpu),
             ),
@@ -1360,7 +1577,8 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
         )
         c1 = c1[0] if isinstance(c1, list) else c1
         step_fn = sharded_slab_step_after_compact(
-            mesh, 0xFFFF, n_probes=4, use_pallas=engine_use_pallas(on_tpu)
+            mesh, 0xFFFF, ways=default_ways_bench(on_tpu),
+            use_pallas=engine_use_pallas(on_tpu),
         )
         sharded_state_shapes = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
@@ -1804,6 +2022,19 @@ def main() -> None:
         import traceback
 
         traceback.print_exc()
+    emit()
+
+    # the set-associative acceptance sweep: live-key load 10% -> 120% of
+    # capacity, proving occupancy is a smooth gauge (no admission cliff)
+    if left() < 60:
+        configs["slab_occupancy"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["slab_occupancy"] = bench_slab_occupancy(
+                device, on_tpu, left
+            )
+        except Exception as e:
+            configs["slab_occupancy"] = {"error": str(e)[-300:]}
     emit()
 
     for key, yaml_text in (

@@ -552,14 +552,38 @@ class TestEngineParity:
             eng.submit_rows(np.array(block))
         eng.close()
 
-    def test_saturation_parity(self):
-        for arm in (True, False):
-            eng = self._engine(arm)
-            eng._saturated = True
-            block = np.zeros((6, 1), dtype=np.uint32)
-            block[2] = 1
-            from api_ratelimit_tpu.backends.overload import SlabSaturatedError
+    def test_full_occupancy_parity(self):
+        """There is no saturation shed anymore: past 100% live occupancy
+        both arms keep answering (the set scan evicts in-kernel), and the
+        answers stay byte-identical across arms."""
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
 
-            with pytest.raises(SlabSaturatedError):
-                eng.submit_rows(block)
-            eng.close()
+        outs = {}
+        for arm in (True, False):
+            eng = SlabDeviceEngine(
+                time_source=FakeTimeSource(700_000),
+                n_slots=128,
+                use_pallas=False,
+                batch_window_seconds=0.002,
+                buckets=(8,),
+                max_batch=8,
+                dispatch_loop=arm,
+            )
+            got = []
+            try:
+                # 160 distinct keys through one 128-way set: the tail 32
+                # inserts each evict a live way instead of shedding
+                for i in range(160):
+                    block = np.zeros((6, 1), dtype=np.uint32)
+                    block[0] = i + 1
+                    block[2] = 1
+                    block[3] = 1000
+                    block[4] = 60
+                    got.append(eng.submit_rows(block).tobytes())
+                snap = eng.health_snapshot()
+                assert snap["occupancy"] == 1.0
+                assert snap["evictions_live"] == 32
+            finally:
+                eng.close()
+            outs[arm] = got
+        assert outs[True] == outs[False]

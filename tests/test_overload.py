@@ -22,7 +22,6 @@ from api_ratelimit_tpu.backends.overload import (
     BrownoutError,
     OverloadError,
     QueueFullError,
-    SlabSaturatedError,
 )
 from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
 from api_ratelimit_tpu.limiter.cache import DeadlineExceededError
@@ -382,20 +381,21 @@ class TestShedPostures:
         )
 
     def test_backend_overload_error_answers_by_posture(self, test_store):
-        """QueueFullError/SlabSaturatedError surfacing from the cache is a
-        shed, not a backend failure: the posture answers it."""
+        """An OverloadError surfacing from the cache layer (rather than
+        the batcher's own admission check) is a shed, not a backend
+        failure: the posture answers it."""
         store, sink = test_store
         controller = _controller(store, shed_mode=SHED_MODE_ALLOW)
         svc, cache = _service(store, overload=controller)
-        cache.raise_error = SlabSaturatedError("slab critical")
+        cache.raise_error = QueueFullError("ring full")
         overall, _, headers = svc.should_rate_limit(_req())
         assert overall == Code.OK
         assert any(
-            h.key == "x-ratelimit-shed" and h.value == "slab_saturated"
+            h.key == "x-ratelimit-shed" and h.value == "queue_full"
             for h in headers
         )
         store.flush()
-        assert sink.counters["ratelimit.overload.slab_saturated"] == 1
+        assert sink.counters["ratelimit.overload.queue_full"] == 1
 
     def test_no_controller_reraises_overload(self, test_store):
         store, _ = test_store
@@ -501,69 +501,93 @@ def _engine(ts, **kw):
 
 
 def _fill(engine, n, divider=60, jitter=300):
+    # structured fingerprints with pairwise-distinct (set, way-preference)
+    # under the default geometry (1024 slots / 128 ways = 8 sets): fp_lo
+    # walks the sets, fp_hi bits [7, 14) (the rotation source,
+    # ops/slab.py _choose_ways) walk the ways within each set — so a
+    # ONE-batch fill deterministically creates n live rows instead of
+    # dropping a handful to in-batch way contention
     items = [
-        _Item(fp=i + 1, hits=1, limit=1000, divider=divider, jitter=jitter)
+        _Item(
+            fp=((((i + 1) >> 3) << 39) | (i + 1)),
+            hits=1,
+            limit=1000,
+            divider=divider,
+            jitter=jitter,
+        )
         for i in range(n)
     ]
     engine.submit(items)
 
 
 class TestSlabWatermarks:
-    def test_high_watermark_sweep_restores_occupancy(self):
-        """Slots whose fixed window ended but whose jittered TTL keeps them
-        'live' are exactly what the high-watermark sweep reclaims."""
+    def test_high_watermark_is_pure_observability(self):
+        """The pressure watermark raises the degraded probe and NOTHING
+        else: no sweep pass, no admission shed — the set-associative scan
+        absorbs pressure by evicting least-valuable ways in-kernel."""
         ts = FakeTimeSource(1_000_000)
         engine = _engine(ts, watermark_high=0.05)
         _fill(engine, 100)  # occupancy ~0.098 >= 0.05
         snap = engine.health_snapshot()
-        # windows still open: the sweep ran but had nothing to reclaim
-        assert snap["sweeps"] == 1
         assert snap["watermark"] == 1
         assert snap["live_slots"] == 100
+        assert "sweeps" not in snap  # the stop-the-world sweep is gone
         assert "pressure" in engine.watermark_reason()
-        # window (60s) ends; TTL jitter (300s) would pin the slots for
-        # minutes — the sweep reclaims them now
+        # rows stay TTL-pinned past their window end — nothing reclaims
+        # them eagerly; the eviction scan reuses them lazily, per insert
         ts.advance(120)
         snap = engine.health_snapshot()
-        assert snap["sweeps"] == 2
+        assert snap["live_slots"] == 100
+        assert snap["watermark"] == 1
+        # TTL (window 60s + jitter 300s) passes: occupancy drains itself
+        ts.advance(300)
+        snap = engine.health_snapshot()
         assert snap["live_slots"] == 0
         assert snap["watermark"] == 0
         assert engine.watermark_reason() is None
 
-    def test_critical_watermark_sheds_new_admission(self):
+    def test_full_occupancy_never_sheds_admission(self):
+        """The old critical-watermark cliff is gone: at (and past) 100%
+        live occupancy every submit still answers — colliding inserts
+        evict the least-valuable way in-kernel, and the eviction mix is
+        the only signal pressure emits."""
         ts = FakeTimeSource(1_000_000)
-        engine = _engine(ts, watermark_high=0.02, watermark_critical=0.05)
-        _fill(engine, 100)
+        # 128 slots = exactly one 128-way set: wave A fills it completely
+        engine = _engine(ts, n_slots=128, buckets=(128,), max_batch=128)
+        for i in range(128):
+            assert engine.submit(
+                [_Item(fp=i + 1, hits=1, limit=1000, divider=60, jitter=300)]
+            ) == [1]
         snap = engine.health_snapshot()
-        assert snap["watermark"] == 2
-        assert engine.saturated
-        assert "saturated" in engine.watermark_reason()
-        with pytest.raises(SlabSaturatedError):
-            engine.submit(
-                [_Item(fp=999, hits=1, limit=10, divider=60, jitter=0)]
-            )
-        # windows roll over; the sweep drains occupancy and admission
-        # reopens — the saturation answer is a state, not a latch
-        ts.advance(120)
+        assert snap["live_slots"] == 128
+        assert snap["occupancy"] == 1.0
+        # wave B: 64 NEW keys against the full set — each submit answers
+        # (count restarts at 1, the fail-open posture) by evicting a live
+        # way, and every displacement is counted, never silent
+        for i in range(64):
+            assert engine.submit(
+                [_Item(fp=1000 + i, hits=1, limit=1000, divider=60, jitter=300)]
+            ) == [1]
         snap = engine.health_snapshot()
-        assert snap["watermark"] == 0
-        assert not engine.saturated
-        assert engine.submit(
-            [_Item(fp=999, hits=1, limit=10, divider=60, jitter=0)]
-        ) == [1]
+        assert snap["occupancy"] == 1.0  # still full, still serving
+        assert snap["evictions_live"] == 64
+        assert snap["watermark"] == 0  # no watermark configured: no alarm
 
     def test_watermarks_off_by_default(self):
         ts = FakeTimeSource(1_000_000)
         engine = _engine(ts)
         _fill(engine, 100)
         snap = engine.health_snapshot()
-        assert snap["watermark"] == 0 and snap["sweeps"] == 0
+        assert snap["watermark"] == 0
         assert engine.watermark_reason() is None
 
-    def test_misordered_watermarks_rejected(self):
+    def test_critical_watermark_kwarg_is_gone(self):
+        """The shed path is deleted, not deprecated-but-alive: the engine
+        no longer even accepts the knob (settings translate a configured
+        SLAB_WATERMARK_CRITICAL into a boot-time deprecation warning)."""
         ts = FakeTimeSource(1_000_000)
-        with pytest.raises(ValueError, match="critical watermark"):
-            _engine(ts, watermark_high=0.9, watermark_critical=0.5)
+        with pytest.raises(TypeError):
+            _engine(ts, watermark_high=0.9, watermark_critical=0.95)
 
 
 # -- settings ----------------------------------------------------------------
@@ -582,7 +606,6 @@ class TestOverloadSettings:
                 "OVERLOAD_EWMA_ALPHA": "0.5",
                 "OVERLOAD_DEADLINE_PROPAGATION": "false",
                 "SLAB_WATERMARK_HIGH": "0.85",
-                "SLAB_WATERMARK_CRITICAL": "0.95",
             }
         )
         assert s.shed_mode() == "deny"
@@ -591,7 +614,7 @@ class TestOverloadSettings:
         assert s.overload_brownout_exit_ms == 2.0
         assert s.overload_ewma_alpha == 0.5
         assert s.overload_deadline_propagation is False
-        assert s.slab_watermarks() == (0.85, 0.95)
+        assert s.slab_watermark() == 0.85
 
     def test_defaults_are_inert(self):
         from api_ratelimit_tpu.settings import new_settings
@@ -601,7 +624,7 @@ class TestOverloadSettings:
         assert s.overload_max_queue == 0
         assert s.overload_brownout_target_ms == 0.0
         assert s.overload_deadline_propagation is True
-        assert s.slab_watermarks() == (0.0, 0.0)
+        assert s.slab_watermark() == 0.0
 
     def test_junk_shed_mode_fails_boot(self):
         from api_ratelimit_tpu.settings import new_settings
@@ -614,14 +637,32 @@ class TestOverloadSettings:
         from api_ratelimit_tpu.settings import new_settings
 
         with pytest.raises(ValueError, match="SLAB_WATERMARK"):
-            new_settings({"SLAB_WATERMARK_HIGH": "1.5"}).slab_watermarks()
-        with pytest.raises(ValueError, match="SLAB_WATERMARK_CRITICAL"):
-            new_settings(
-                {
-                    "SLAB_WATERMARK_HIGH": "0.9",
-                    "SLAB_WATERMARK_CRITICAL": "0.5",
-                }
-            ).slab_watermarks()
+            new_settings({"SLAB_WATERMARK_HIGH": "1.5"}).slab_watermark()
+
+    def test_critical_watermark_deprecated_not_fatal(self, caplog):
+        """An old deployment config carrying SLAB_WATERMARK_CRITICAL (even
+        one the old validator would have rejected as misordered) keeps
+        booting: the knob is accepted-and-ignored with one warning line."""
+        import logging
+
+        from api_ratelimit_tpu.settings import new_settings
+
+        s = new_settings(
+            {"SLAB_WATERMARK_HIGH": "0.9", "SLAB_WATERMARK_CRITICAL": "0.5"}
+        )
+        assert s.slab_watermark() == 0.9  # no ordering validation, no raise
+        log = logging.getLogger("test.deprecations")
+        with caplog.at_level(logging.WARNING):
+            s.warn_deprecated_knobs(log)
+        assert any(
+            "SLAB_WATERMARK_CRITICAL is deprecated" in r.message
+            for r in caplog.records
+        )
+        # unset: silent
+        caplog.clear()
+        with caplog.at_level(logging.WARNING):
+            new_settings({}).warn_deprecated_knobs(log)
+        assert not caplog.records
 
     def test_queue_full_fault_kind_parses(self):
         rules = parse_fault_spec("batcher.submit:queue_full:0.5")

@@ -714,8 +714,10 @@ def test_metrics_endpoint_full_pipeline(tmp_path):
             "ratelimit_device_pack_ms_bucket",
             "ratelimit_device_launch_ms_bucket",
             "ratelimit_device_readback_ms_bucket",
-            # slab health gauges (evictions = steals/drops; occupancy)
-            "ratelimit_slab_steals",
+            # slab health gauges (eviction mix + contention drops; occupancy)
+            "ratelimit_slab_evictions_expired",
+            "ratelimit_slab_evictions_window",
+            "ratelimit_slab_evictions_live",
             "ratelimit_slab_drops",
             "ratelimit_slab_occupancy",
             "ratelimit_slab_live_slots",

@@ -31,6 +31,17 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def _fmix32(x: np.ndarray) -> np.ndarray:
+    """murmur3 finalizer — a bijection on uint32 (same expansion the bench
+    uses to turn staged key ids into well-mixed fingerprint halves)."""
+    x = np.asarray(x, dtype=np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    return x ^ (x >> np.uint32(16))
+
+
 def make_limit(store, rpu, unit, key):
     return RateLimit(
         full_key=key,
@@ -92,12 +103,22 @@ class TestShardedEngine:
         cache = make_sharded_cache(ts, mesh)
         limits = [make_limit(store, 5, Unit.HOUR, f"k_{i}") for i in range(64)]
         descriptors = [("k", str(i)) for i in range(64)]
-        # 64 distinct keys in one batch, repeated: each counts on its own shard
+        # Warm round: 64 distinct keys INSERT in one batch — two keys whose
+        # set and way-preference collide may drop one write (the documented
+        # fail-open in-batch contention undercount, counted in `drops`).
+        # Advancing into the next hour window makes every key resident
+        # (rows survive, the window rolls to base 0), so the strict rounds
+        # below all take the fingerprint-MATCH path, where a same-batch
+        # winner is never displaced and counting is exact.
+        cache.do_limit(req(*descriptors), limits)
+        ts.advance(3600 - ts.unix_now() % 3600)
+        # 64 distinct resident keys in one batch, repeated: each counts on
+        # its own shard, independently and exactly
         for round_no in range(6):
             resp = cache.do_limit(req(*descriptors), limits)
             want = Code.OK if round_no < 5 else Code.OVER_LIMIT
             for s in resp.descriptor_statuses:
-                assert s.code == want
+                assert s.code == want, round_no
         cache.close()
 
     def test_parity_vs_memory_oracle_random_stream(self, mesh):
@@ -188,11 +209,15 @@ class TestCompactedMode:
         )
 
         packed = np.zeros((7, b), dtype=np.uint32)
-        ids = rng.integers(0, 200, size=b).astype(np.uint64)
-        packed[ROW_FP_LO] = (ids * 0x9E3779B185EBCA87 & 0xFFFFFFFF).astype(np.uint32)
-        packed[ROW_FP_HI] = ((ids ^ 0xA5) * 0xC2B2AE3D27D4EB4F & 0xFFFFFFFF).astype(
-            np.uint32
-        )
+        ids = rng.integers(0, 200, size=b).astype(np.uint32)
+        # two independent murmur-finalizer bijections, the same quality the
+        # real fingerprint path (ops/hashing.py xxhash) delivers: the slab's
+        # set/way/shard selectors read disjoint LOW-bit fields, so a bare
+        # `ids * odd-constant` expansion (whose low bits form a lattice)
+        # would systematically collide way preferences that production
+        # fingerprints never would
+        packed[ROW_FP_LO] = _fmix32(ids)
+        packed[ROW_FP_HI] = _fmix32(ids ^ np.uint32(0x9E3779B9))
         packed[ROW_HITS] = 1
         packed[ROW_HITS, b - 1] = 0  # one padding lane rides along
         packed[ROW_LIMIT] = limit
@@ -222,8 +247,24 @@ class TestCompactedMode:
         first = engine.step_after(packed, cap=0xFFFF)
         second = engine.step_after_compact(packed, cap=0xFFFF)
         valid = packed[2] > 0
-        # every valid item's counter advanced by exactly its in-batch total
-        assert (np.asarray(second)[valid] > np.asarray(first, np.uint32)[valid]).all()
+        a1 = np.asarray(first, np.uint32)[valid]
+        a2 = np.asarray(second)[valid]
+        # counters never regress across modes, and every item whose counter
+        # did NOT advance must trace to a counted in-batch contention drop
+        # (two distinct random keys colliding on one way — the documented
+        # fail-open undercount; the loser re-inserts from 0 next batch)
+        assert (a2 >= a1).all()
+        stuck = np.flatnonzero(a2 <= a1)
+        drops = engine.health_snapshot(now=now)["drops"]
+        from api_ratelimit_tpu.ops.slab import ROW_FP_HI, ROW_FP_LO
+
+        fp = packed[ROW_FP_LO][valid].astype(np.uint64) | (
+            packed[ROW_FP_HI][valid].astype(np.uint64) << np.uint64(32)
+        )
+        stuck_keys = len(set(fp[stuck].tolist()))
+        assert stuck_keys <= drops
+        # and the overwhelming majority advanced
+        assert (a2 > a1).sum() >= a1.size - 8
 
     def test_skewed_batch_grows_bucket(self, mesh):
         # all items one key -> one shard owns the whole batch; the bucket
@@ -246,7 +287,9 @@ class TestCompactedMode:
         engine.step_after_compact(self._packed(rng, 512, 1_000_000))
         snap = engine.health_snapshot(now=1_000_000)
         assert snap["live_slots"] > 0
-        assert snap["steals"] >= 0 and snap["drops"] >= 0
+        assert snap["drops"] >= 0
+        for k in ("evictions_expired", "evictions_window", "evictions_live"):
+            assert snap[k] >= 0
 
     def test_launch_collect_split_matches_sync(self, mesh):
         """The double-buffered split (VERDICT r4 weak #2): two launches in
@@ -310,7 +353,7 @@ class TestPerDeviceCostScaling:
         c1 = single.lower(state, block).compile().cost_analysis()
         c1 = c1[0] if isinstance(c1, list) else c1
 
-        step = sharded_slab_step_after_compact(mesh, 0xFFFF, n_probes=4, use_pallas=False)
+        step = sharded_slab_step_after_compact(mesh, 0xFFFF, ways=128, use_pallas=False)
         blocks = jax.device_put(
             np.zeros((n_dev, 7, batch // n_dev), dtype=np.uint32),
             engine._blocks_sharding,

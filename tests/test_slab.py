@@ -18,31 +18,36 @@ N_SLOTS = 1 << 12
 
 
 def make_batch(items, pad_to=None):
-    """items: list of (fp, hits, limit, divider)."""
+    """items: list of (fp, hits, limit, divider[, jitter])."""
     b = len(items)
     size = pad_to or b
     fp = np.zeros(size, dtype=np.uint64)
     hits = np.zeros(size, dtype=np.uint32)
     limit = np.zeros(size, dtype=np.uint32)
     divider = np.ones(size, dtype=np.int32)
-    for i, (f, h, l, d) in enumerate(items):
+    jitter = np.zeros(size, dtype=np.int32)
+    for i, item in enumerate(items):
+        f, h, l, d = item[:4]
         fp[i], hits[i], limit[i], divider[i] = f, h, l, d
+        if len(item) > 4:
+            jitter[i] = item[4]
     return SlabBatch(
         fp_lo=jnp.asarray((fp & 0xFFFFFFFF).astype(np.uint32)),
         fp_hi=jnp.asarray((fp >> 32).astype(np.uint32)),
         hits=jnp.asarray(hits),
         limit=jnp.asarray(limit),
         divider=jnp.asarray(divider),
-        jitter=jnp.zeros(size, dtype=jnp.int32),
+        jitter=jnp.asarray(jitter),
     )
 
 
-def run(state, items, now, pad_to=None, near_ratio=0.8):
+def run(state, items, now, pad_to=None, near_ratio=0.8, ways=128):
     state, res = slab_update_and_decide(
         state,
         make_batch(items, pad_to),
         jnp.int32(now),
         jnp.float32(near_ratio),
+        ways=ways,
     )
     return state, res
 
@@ -310,41 +315,94 @@ class TestCompactReadbackModes:
 
 
 class TestSlabHealth:
-    """The slab's two documented fail-open lossy behaviors must be counted,
-    not silent (ops/slab.py:30-39): probe steals and within-batch
-    contention drops, plus the live-slot occupancy gauge."""
+    """The slab's lossy behaviors must be counted, not silent (ops/slab.py
+    docstring): the eviction mix (expired / window-ended / live) and
+    within-batch contention drops, plus the live-slot occupancy gauge.
+    Health layout: uint32[4] = (evict_expired, evict_window, evict_live,
+    drops) — ops/slab.py HEALTH_* indices."""
 
     def test_no_loss_on_clean_traffic(self):
         state = make_slab(N_SLOTS)
         state, res = run(state, [(KEY_A, 1, 10, 60), (KEY_B, 1, 10, 60)], now=1000)
-        steals, drops = (int(v) for v in res.health)
-        assert (steals, drops) == (0, 0)
+        assert [int(v) for v in res.health] == [0, 0, 0, 0]
 
     def test_within_batch_contention_drop_counted(self):
-        # empty 4-slot table: first probe lands on fp_lo & 3, so three
-        # distinct keys with equal fp_lo mod 4 fight for one slot; one
-        # write wins, two drop (and fail open — their counts restart)
+        # 4 sets x 1 way: three distinct keys with equal fp_lo mod 4 fight
+        # for one way; one write wins, two drop (and fail open — their
+        # counts restart)
         state = make_slab(4)
         keys = [(0x0 << 32) | 0x10, (0x1 << 32) | 0x20, (0x2 << 32) | 0x30]
-        state, res = run(state, [(k, 1, 10, 60) for k in keys], now=1000)
-        steals, drops = (int(v) for v in res.health)
+        state, res = run(state, [(k, 1, 10, 60) for k in keys], now=1000, ways=1)
+        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
         assert drops == 2
-        assert steals == 0
+        assert (ev_exp, ev_win, ev_live) == (0, 0, 0)  # fresh ways: no evict
         # every item still got a decision (fail open)
         assert [int(a) for a in res.after] == [1, 1, 1]
 
-    def test_probe_steal_counted(self):
-        # 2-slot table fully live with other keys: a third key finds every
-        # candidate live and non-matching -> displaces candidate 0's victim
+    def test_live_eviction_counted_lowest_count_first(self):
+        # one 2-way set, both ways live in open windows with different
+        # counts: a third key must evict the LOWEST-COUNT live way
         state = make_slab(2)
-        state, res = run(state, [((0x5 << 32) | 0x0, 1, 10, 60)], now=1000)
-        state, res = run(state, [((0x6 << 32) | 0x1, 1, 10, 60)], now=1000)
-        assert tuple(int(v) for v in res.health) == (0, 0)
-        state, res = run(state, [((0x7 << 32) | 0x2, 1, 10, 60)], now=1000)
-        steals, drops = (int(v) for v in res.health)
-        assert steals == 1
-        assert drops == 0
-        assert int(res.after[0]) == 1  # the stealer starts fresh
+        heavy = (0x5 << 32) | 0x0
+        light = (0x6 << 32) | 0x1
+        state, _ = run(state, [(heavy, 5, 100, 60)], now=1000, ways=2)
+        state, res = run(state, [(light, 1, 100, 60)], now=1000, ways=2)
+        assert [int(v) for v in res.health] == [0, 0, 0, 0]
+        state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=1000, ways=2)
+        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        assert (ev_exp, ev_win, ev_live, drops) == (0, 0, 1, 0)
+        assert int(res.after[0]) == 1  # the evictor starts fresh
+        # the heavy key survived (the light one was the victim)
+        state, res = run(state, [(heavy, 1, 100, 60)], now=1000, ways=2)
+        assert int(res.before[0]) == 5
+
+    def test_window_ended_evicts_before_live(self):
+        # one 2-way set: way A live in an OPEN window, way B live by TTL
+        # but its fixed window ended — the insert must take B
+        state = make_slab(2)
+        open_key = (0x5 << 32) | 0x0
+        ended_key = (0x6 << 32) | 0x1
+        # ended_key: 1s window + large jitter pins the slot past rollover
+        state, _ = run(state, [(ended_key, 7, 100, 1, 300)], now=1000, ways=2)
+        state, _ = run(state, [(open_key, 3, 100, 3600)], now=1002, ways=2)
+        state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=1002, ways=2)
+        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        assert (ev_exp, ev_win, ev_live, drops) == (0, 1, 0, 0)
+        # the open-window counter survived
+        state, res = run(state, [(open_key, 1, 100, 3600)], now=1002, ways=2)
+        assert int(res.before[0]) == 3
+
+    def test_expired_reclaim_counted_before_any_live(self):
+        # one 2-way set: one expired (dead) way, one live — the insert
+        # reuses the dead way and counts an expired reclaim, never a
+        # live eviction
+        state = make_slab(2)
+        dead_key = (0x5 << 32) | 0x0
+        live_key = (0x6 << 32) | 0x1
+        state, _ = run(state, [(dead_key, 2, 100, 1)], now=1000, ways=2)
+        state, _ = run(state, [(live_key, 4, 100, 3600)], now=2000, ways=2)
+        state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=2000, ways=2)
+        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        assert (ev_exp, ev_win, ev_live, drops) == (1, 0, 0, 0)
+        state, res = run(state, [(live_key, 1, 100, 3600)], now=2000, ways=2)
+        assert int(res.before[0]) == 4
+
+    def test_same_batch_winner_never_evicted(self):
+        # a key that MATCHES a live row in this batch must survive an
+        # evictor colliding with its way in the same batch: the evictor's
+        # write drops (counted), the matcher's increment persists
+        state = make_slab(1)  # one set, one way: maximum contention
+        a = (0x5 << 32) | 0x0
+        b = (0x6 << 32) | 0x1
+        state, _ = run(state, [(a, 2, 100, 3600)], now=1000, ways=1)
+        # same batch: a matches its live row, b would have to evict it
+        state, res = run(state, [(b, 1, 100, 3600), (a, 1, 100, 3600)], now=1000, ways=1)
+        assert [int(x) for x in res.after] == [1, 3]
+        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        assert drops == 1  # b's insert lost
+        assert ev_live == 0  # and displaced nothing
+        state, res = run(state, [(a, 1, 100, 3600)], now=1000, ways=1)
+        assert int(res.before[0]) == 3  # a's chain unbroken
 
     def test_live_slots_occupancy(self):
         from api_ratelimit_tpu.ops.slab import slab_live_slots

@@ -1,0 +1,268 @@
+"""Differential fuzz: the set-associative device step vs the exact host
+oracle (testing/oracle.py SetSlabOracle) AT AND PAST 100% occupancy.
+
+The open-addressed slab could only be fuzzed below saturation (past it,
+admission shed and the stream stopped being comparable). The set-associative
+layout makes overload a TESTABLE regime: eviction is deterministic (dead,
+then window-ended, then lowest-count live, rotation tiebreak — never a
+same-batch winner), so the oracle models the step bit-for-bit — per-item
+before/after/code, the final table, and the eviction mix — while offered
+live-key load sits well past capacity.
+
+Campaign style follows tests/test_race.py's SLAB_FUZZ_EXAMPLES contract,
+but seeded-numpy rather than hypothesis (the image ships without it, and a
+skipped fuzz campaign protects nothing): small default example counts keep
+`make tests_unit` fast; an extended idle-hardware campaign sets
+SLAB_FUZZ_EXAMPLES (e.g. 2000) to mine the same properties much deeper.
+Every failure message carries the (seed, step) pair that reproduces it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from api_ratelimit_tpu.ops.slab import (
+    OUT_AFTER,
+    OUT_BEFORE,
+    OUT_CODE,
+    OUT_ORDER,
+    ROW_DIVIDER,
+    ROW_FP_HI,
+    ROW_FP_LO,
+    ROW_HITS,
+    ROW_JITTER,
+    ROW_LIMIT,
+    ROW_SCALARS,
+    make_slab,
+    slab_step_packed,
+    validate_ways,
+)
+from api_ratelimit_tpu.testing.oracle import SetSlabOracle
+
+FUZZ_EXAMPLES = int(os.environ.get("SLAB_FUZZ_EXAMPLES", "0") or 0)
+
+
+def _fmix32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def _fp(key_id: int) -> tuple[int, int]:
+    """(fp_lo, fp_hi) for a fuzz key: fp_lo well mixed (set spread);
+    fp_hi's TOP 16 bits carry the unique key id (so the oracle's
+    winner-per-way rule is exact — see SetSlabOracle docstring) and its
+    low 16 bits are mixed (they feed the way-preference rotation)."""
+    return (
+        _fmix32(key_id),
+        (((key_id + 1) & 0xFFFF) << 16) | (_fmix32(key_id ^ 0xA5A5) & 0xFFFF),
+    )
+
+
+def _pack(items, now: int, pad_to: int) -> np.ndarray:
+    """items: (fp_lo, fp_hi, hits, limit, divider, jitter) -> uint32[7, b]."""
+    packed = np.zeros((7, pad_to), dtype=np.uint32)
+    for i, (fp_lo, fp_hi, hits, limit, div, jit) in enumerate(items):
+        packed[ROW_FP_LO, i] = fp_lo
+        packed[ROW_FP_HI, i] = fp_hi
+        packed[ROW_HITS, i] = hits
+        packed[ROW_LIMIT, i] = limit
+        packed[ROW_DIVIDER, i] = div
+        packed[ROW_JITTER, i] = jit
+    packed[ROW_SCALARS, 0] = np.uint32(now)
+    packed[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+    return packed
+
+
+class _Harness:
+    """Drives the device step and the host oracle in lockstep and compares
+    every observable: per-item before/after/code, the per-batch health
+    vector, and (on demand) the whole row table."""
+
+    def __init__(self, n_slots: int, ways: int, pad_to: int):
+        self.state = make_slab(n_slots)
+        # the same clamp the engine applies (tiny slab => fully associative)
+        self.ways = validate_ways(n_slots, ways)
+        self.oracle = SetSlabOracle(n_slots, ways)
+        self.pad_to = pad_to
+
+    def step(self, items, now: int, label=""):
+        assert len(items) <= self.pad_to
+        packed = _pack(items, now, self.pad_to)
+        self.state, out, health = slab_step_packed(
+            self.state, jnp.asarray(packed), ways=self.ways
+        )
+        out = np.asarray(out)
+        order = out[OUT_ORDER].astype(np.int64)
+        got = {}
+        for name, row in (
+            ("before", OUT_BEFORE),
+            ("after", OUT_AFTER),
+            ("code", OUT_CODE),
+        ):
+            arr = np.empty(self.pad_to, dtype=np.uint32)
+            arr[order] = out[row]
+            got[name] = arr
+        w_before, w_after, w_codes, w_delta = self.oracle.step_batch(items, now)
+        for i, (_fp_lo, _fp_hi, hits, _l, _d, _j) in enumerate(items):
+            if hits <= 0:
+                continue
+            ctx = (label, i, items[i])
+            assert int(got["before"][i]) == w_before[i], ctx
+            assert int(got["after"][i]) == w_after[i], ctx
+            assert int(got["code"][i]) == w_codes[i], ctx
+        assert [int(v) for v in np.asarray(health)] == w_delta, label
+        return got
+
+    def assert_tables_equal(self, label=""):
+        dev = np.asarray(self.state.table).astype(np.uint64)
+        np.testing.assert_array_equal(dev, self.oracle.table, err_msg=str(label))
+
+
+class TestFuzzSequentialOverCapacity:
+    """Random op streams over a key pool 3x slab capacity: every decision,
+    every eviction choice, and the final table must match the oracle
+    exactly — the >100%-occupancy regime the old layout could not serve."""
+
+    def test_stream_matches_oracle(self):
+        examples = FUZZ_EXAMPLES or 25
+        for seed in range(examples):
+            rng = np.random.default_rng(seed)
+            h = _Harness(n_slots=16, ways=4, pad_to=8)
+            limit = int(rng.integers(1, 7))
+            now = 700_000
+            for step in range(int(rng.integers(1, 51))):
+                key_id = int(rng.integers(0, 48))  # 48 keys, 16 slots
+                hits = int(rng.integers(1, 4))
+                now += int(rng.integers(0, 91))
+                fp_lo, fp_hi = _fp(key_id)
+                # divider/jitter derived from the key (production
+                # fingerprints include the window unit, so one fp == one
+                # divider)
+                div = 60 if key_id % 2 else 5
+                jit = key_id % 7
+                h.step(
+                    [(fp_lo, fp_hi, hits, limit, div, jit)],
+                    now,
+                    label=(seed, step, key_id),
+                )
+            h.assert_tables_equal(label=seed)
+
+    def test_fully_associative_clamp_matches_oracle(self):
+        """Tiny slabs clamp ways to n_slots (one fully associative set);
+        the oracle must agree there too."""
+        examples = FUZZ_EXAMPLES or 10
+        for seed in range(examples):
+            rng = np.random.default_rng(10_000 + seed)
+            h = _Harness(n_slots=8, ways=128, pad_to=8)  # clamps to ways=8
+            now = 700_000
+            for step in range(20):
+                now += int(rng.integers(0, 30))
+                key_id = int(rng.integers(0, 24))
+                fp_lo, fp_hi = _fp(key_id)
+                h.step([(fp_lo, fp_hi, 1, 4, 30, 0)], now, label=(seed, step))
+            h.assert_tables_equal(label=seed)
+
+
+class TestFuzzDuplicateHeavyBatches:
+    """Batched streams with heavy in-batch duplication and way contention:
+    duplicate serialization, the winner-per-way rule, and the counted
+    drops must all match the oracle item-for-item."""
+
+    def test_batches_match_oracle(self):
+        examples = FUZZ_EXAMPLES or 25
+        for seed in range(examples):
+            rng = np.random.default_rng(20_000 + seed)
+            h = _Harness(n_slots=16, ways=4, pad_to=16)
+            limit = int(rng.integers(1, 10))
+            now = 700_000
+            for batch_no in range(int(rng.integers(1, 9))):
+                now += int(rng.integers(0, 31))
+                size = int(rng.integers(1, 17))
+                # 24 keys over 16 slots: duplicates AND way contention
+                batch = [
+                    (int(rng.integers(0, 24)), int(rng.integers(1, 5)))
+                    for _ in range(size)
+                ]
+                items = [
+                    (*_fp(key_id), hits, limit, 60, key_id % 5)
+                    for key_id, hits in batch
+                ]
+                h.step(items, now, label=(seed, batch_no, batch))
+            h.assert_tables_equal(label=seed)
+
+
+class TestMidWindowEvictThenReinsert:
+    """The lossy tier, pinned end to end: a full set evicts its
+    lowest-count live way; the evicted key re-inserts MID-WINDOW and
+    restarts from zero (the fail-open posture on a lost counter) — and
+    the oracle agrees at every step."""
+
+    def test_evict_reinsert_cycle(self):
+        h = _Harness(n_slots=4, ways=4, pad_to=8)
+        now = 700_000
+        keys = [_fp(i) for i in range(5)]
+        counts = [5, 4, 3, 2]
+        for (fp_lo, fp_hi), c in zip(keys[:4], counts):
+            h.step([(fp_lo, fp_hi, c, 100, 3600, 0)], now)
+        occupied = h.oracle.table[:, 4] > now
+        assert occupied.all()  # one full 4-way set
+        # key E: the set is full of live in-window rows — the LOWEST-COUNT
+        # way (key D, count 2) is the victim
+        now += 10
+        h.step([(*keys[4], 1, 100, 3600, 0)], now, label="insert E")
+        assert h.oracle.health[2] == 1  # one live eviction
+        d_lo, d_hi = keys[3]
+        stored_fps = set(h.oracle.table[:, 0].tolist())
+        assert d_lo not in stored_fps
+        # key D returns mid-window: its counter RESTARTED (before == 0,
+        # fail open), displacing the current lowest-count way (E, count 1)
+        now += 10
+        got = h.step([(d_lo, d_hi, 1, 100, 3600, 0)], now, label="reinsert D")
+        assert int(got["before"][0]) == 0 and int(got["after"][0]) == 1
+        assert h.oracle.health[2] == 2
+        # the high-count survivors kept exact counts through both evictions
+        for (fp_lo, fp_hi), c in zip(keys[:3], counts[:3]):
+            got = h.step([(fp_lo, fp_hi, 1, 100, 3600, 0)], now, label="survivor")
+            assert int(got["before"][0]) == c
+        h.assert_tables_equal()
+
+
+class TestAtScaleOneSidedParity:
+    """parity_report's contract at 120% offered live-key load: the slab
+    may fail OPEN (false_ok — a counted eviction/drop), never CLOSED
+    (false_over must be 0 at any occupancy)."""
+
+    def test_false_over_is_zero_past_capacity(self):
+        from api_ratelimit_tpu.testing.oracle import parity_report
+
+        n_slots, ways, batch = 1024, 128, 64
+        n_keys = int(n_slots * 1.2)  # 120% of capacity, one shared window
+        rng = np.random.default_rng(11)
+        ids = rng.integers(0, n_keys, size=4096).astype(np.int64)
+        codes = np.empty(ids.size, dtype=np.uint32)
+        now, limit = 700_000, 3
+        state = make_slab(n_slots)
+        for off in range(0, ids.size, batch):
+            chunk = ids[off : off + batch]
+            items = [(*_fp(int(k)), 1, limit, 3600, 0) for k in chunk]
+            packed = _pack(items, now, batch)
+            state, out, _health = slab_step_packed(
+                state, jnp.asarray(packed), ways=ways
+            )
+            out = np.asarray(out)
+            order = out[OUT_ORDER].astype(np.int64)
+            arr = np.empty(batch, dtype=np.uint32)
+            arr[order] = out[OUT_CODE]
+            codes[off : off + chunk.size] = arr[: chunk.size]
+        report = parity_report(ids, codes, limit)
+        assert report["false_over"] == 0
+        assert report["oracle_over_frac"] > 0.05  # the stream really saturates
+        # past-capacity eviction costs SOME open failures, but bounded ones
+        assert report["agreement"] > 0.5

@@ -146,6 +146,18 @@ class TestHotpathProfile:
         assert proc.returncode == 0, proc.stderr[-500:]
         assert "path=legacy" in proc.stdout
 
+    def test_slab_split_baseline(self):
+        proc = _run_tool("tools.hotpath_profile", ("--slab-split",))
+        assert proc.returncode == 0, proc.stderr[-500:]
+        lines = proc.stdout.splitlines()
+        summary = [ln for ln in lines if ln.startswith("[slab_split] batch=")]
+        assert summary, proc.stdout[-300:]
+        assert int(summary[0].split("batch=")[1]) > 0
+        for stage in ("gather_ns", "scan_ns", "scatter_ns"):
+            rows = [ln for ln in lines if ln.strip().startswith(stage)]
+            assert rows, (stage, proc.stdout[-300:])
+            assert "p50=" in rows[0] and "p99=" in rows[0]
+
     def test_dispatch_arm_profiles_owner_thread(self):
         proc = _run_tool(
             "tools.hotpath_profile", ("-n", "120", "--top", "8", "--dispatch")

@@ -589,7 +589,8 @@ class TestSlabHealthStats:
                 [limit],
             )
         snap = cache.engine.health_snapshot()
-        assert snap["steals"] == 0 and snap["drops"] == 0
+        assert snap["evictions_live"] == 0 and snap["drops"] == 0
+        assert snap["evictions_expired"] == 0 and snap["evictions_window"] == 0
         assert snap["live_slots"] == 4
         assert 0 < snap["occupancy"] < 1
         # the alarm-gauge denominator: 4 decisions submitted, none lossy
@@ -600,7 +601,9 @@ class TestSlabHealthStats:
             SlabHealthStats(cache.engine, store.scope("ratelimit").scope("slab"))
         )
         store.flush()
-        assert sink.gauges["ratelimit.slab.steals"] == 0
+        assert sink.gauges["ratelimit.slab.evictions.expired"] == 0
+        assert sink.gauges["ratelimit.slab.evictions.window"] == 0
+        assert sink.gauges["ratelimit.slab.evictions.live"] == 0
         assert sink.gauges["ratelimit.slab.drops"] == 0
         assert sink.gauges["ratelimit.slab.decisions"] == 4
         assert sink.gauges["ratelimit.slab.loss_ppm"] == 0
@@ -632,11 +635,13 @@ class TestSlabHealthStats:
         the gauge — an absolute-counter dashboard can miss that."""
         from api_ratelimit_tpu.backends.tpu import _loss_ppm
 
-        base = {"steals": 10, "drops": 90, "decisions": 1_000_000}
+        base = {"evictions_live": 10, "drops": 90, "decisions": 1_000_000}
         assert _loss_ppm(base) == 100
         tripled = dict(base, drops=270)
         assert _loss_ppm(tripled) == 280
-        assert _loss_ppm({"steals": 0, "drops": 0, "decisions": 0}) == 0
+        assert _loss_ppm(
+            {"evictions_live": 0, "drops": 0, "decisions": 0}
+        ) == 0
 
 
 class TestReadbackWidths:

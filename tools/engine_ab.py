@@ -64,7 +64,7 @@ def main() -> None:
             expand(ids),
             jnp.int32(now_lit),
             jnp.float32(0.8),
-            n_probes=4,
+            ways=128,
             use_pallas=use_pallas,
             count_health=True,
             lean_decide=use_pallas,
@@ -80,7 +80,7 @@ def main() -> None:
             state,
             expand(ids),
             jnp.int32(now_lit),
-            n_probes=4,
+            ways=128,
             count_health=True,
             use_pallas=use_pallas,
         )

@@ -106,7 +106,7 @@ def main() -> None:
     # v0: the bisect's fastest inline program through THIS harness —
     # same probe/sort/permute/update/scatter, no floor_div, no decide,
     # no health, scalar out; rules out harness differences in one number
-    from api_ratelimit_tpu.ops.slab import _choose_slots, _sort_key
+    from api_ratelimit_tpu.ops.slab import _choose_ways, _sort_key
 
     @functools.partial(jax.jit, donate_argnames=("state",))
     def v0(state, ids):
@@ -114,9 +114,9 @@ def main() -> None:
 
         batch = expand(ids)
         now = jnp.int32(now_lit)
-        chosen, stolen, picked_rows = _choose_slots(state, batch, now, 4)
+        chosen, _cls, matched, picked_rows = _choose_ways(state, batch, now, 128)
         bsz = chosen.shape[0]
-        key = _sort_key(chosen, batch.fp_hi, state.n_slots)
+        key = _sort_key(chosen, matched, batch.fp_hi, state.n_slots)
         (_, order) = jax.lax.sort(
             (key, jnp.arange(bsz, dtype=jnp.int32)), num_keys=1, is_stable=True
         )
@@ -166,9 +166,9 @@ def main() -> None:
         st = SlabState(table=table)
         batch = expand(ids)
         now = jnp.int32(now_lit)
-        chosen, stolen, picked_rows = _choose_slots(st, batch, now, 4)
+        chosen, _cls, matched, picked_rows = _choose_ways(st, batch, now, 128)
         bsz = chosen.shape[0]
-        key = _sort_key(chosen, batch.fp_hi, n)
+        key = _sort_key(chosen, matched, batch.fp_hi, n)
         (_, order) = jax.lax.sort(
             (key, jnp.arange(bsz, dtype=jnp.int32)), num_keys=1, is_stable=True
         )
@@ -265,7 +265,7 @@ def main() -> None:
             expand(ids),
             jnp.int32(now_lit),
             jnp.float32(0.8),
-            n_probes=4,
+            ways=128,
             use_pallas=False,
             count_health=True,
         )
@@ -281,7 +281,7 @@ def main() -> None:
             expand(ids),
             jnp.int32(now_lit),
             jnp.float32(0.8),
-            n_probes=4,
+            ways=128,
             use_pallas=False,
             count_health=True,
         )
@@ -302,7 +302,7 @@ def main() -> None:
             expand(ids),
             jnp.int32(now_lit),
             jnp.float32(0.8),
-            n_probes=4,
+            ways=128,
             use_pallas=False,
             count_health=True,
         )
@@ -326,7 +326,7 @@ def main() -> None:
                 expand(ids),
                 jnp.int32(now_lit),
                 jnp.float32(0.8),
-                n_probes=4,
+                ways=128,
                 use_pallas=True,
                 count_health=True,
             )

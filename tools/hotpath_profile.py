@@ -67,6 +67,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="wall-clock sampling profile instead of cProfile",
     )
+    parser.add_argument(
+        "--slab-split",
+        action="store_true",
+        help="print the slab stage-split baseline (set-gather / scan / "
+        "scatter ns per launch, SlabDeviceEngine.profile_slab_split) "
+        "instead of a host profile",
+    )
     args = parser.parse_args(argv)
 
     sys.path.insert(0, REPO)
@@ -87,6 +94,8 @@ def main(argv=None) -> int:
     for request in reqs[:64]:
         service.should_rate_limit(request)
 
+    if args.slab_split:
+        return _run_slab_split(cache, _store)
     if args.dispatch:
         return _run_dispatch_profile(service, cache, reqs, args)
     try:
@@ -107,6 +116,41 @@ def main(argv=None) -> int:
         stats = pstats.Stats(prof, stream=out)
         stats.sort_stats(args.sort).print_stats(args.top)
         print(out.getvalue())
+        return 0
+    finally:
+        cache.close()
+
+
+def _run_slab_split(cache, store) -> int:
+    """The slab_split stage baseline: gather/scan/scatter per-launch ns
+    on this box's geometry, recorded into (and reported from) the same
+    ratelimit.slab.split.* runtime histograms bench.py publishes.
+
+    Output contract (pinned by tests/test_tools_platform.py): one
+    `[slab_split] batch=<N>` line, then `<stage>_ns p50=<N> p99=<N>`
+    per stage."""
+    try:
+        engine = getattr(cache, "engine", None)
+        if engine is None or not hasattr(engine, "profile_slab_split"):
+            print("[slab_split] no slab engine in this build", file=sys.stderr)
+            return 1
+        result = engine.profile_slab_split(
+            scope=store.scope("ratelimit").scope("slab"), iters=30
+        )
+        if not result:
+            print("[slab_split] mesh engine: use tools/profile_engine.py",
+                  file=sys.stderr)
+            return 1
+        import bench
+
+        split = bench._slab_split(store)
+        print(f"[slab_split] batch={result['batch']}")
+        for stage in ("gather_ns", "scan_ns", "scatter_ns"):
+            h = split.get(stage, {})
+            print(
+                f"  {stage:<11} p50={h.get('p50', result[stage])} "
+                f"p99={h.get('p99', result[stage])}"
+            )
         return 0
     finally:
         cache.close()

@@ -43,7 +43,7 @@ def main() -> None:
 
     from api_ratelimit_tpu.ops.slab import (
         SlabBatch,
-        _choose_slots,
+        _choose_ways,
         _slab_step_sorted,
         _slab_update_sorted,
         _unsort,
@@ -111,11 +111,11 @@ def main() -> None:
     def stage_probe(table, ids):
         from api_ratelimit_tpu.ops.slab import SlabState
 
-        return _choose_slots(SlabState(table=table), expand(ids), now, 4)
+        return _choose_ways(SlabState(table=table), expand(ids), now, 128)
 
     results["probe_ms"] = round(timeit(stage_probe, table0, ids), 3)
 
-    # --- stage: probe + packed single-key sort (the shipped _sort_key) ---
+    # --- stage: set scan + packed single-key sort (the shipped _sort_key) ---
     from api_ratelimit_tpu.ops.slab import _sort_key
 
     @jax.jit
@@ -123,8 +123,10 @@ def main() -> None:
         from api_ratelimit_tpu.ops.slab import SlabState
 
         batch = expand(ids)
-        chosen, stolen, rows = _choose_slots(SlabState(table=table), batch, now, 4)
-        key = _sort_key(chosen, batch.fp_hi, table.shape[0])
+        chosen, _cls, matched, rows = _choose_ways(
+            SlabState(table=table), batch, now, 128
+        )
+        key = _sort_key(chosen, matched, batch.fp_hi, table.shape[0])
         b = chosen.shape[0]
         return jax.lax.sort(
             (key, jnp.arange(b, dtype=jnp.int32)), num_keys=1, is_stable=True
@@ -172,7 +174,7 @@ def main() -> None:
             expand(ids),
             now,
             jnp.float32(0.8),
-            n_probes=4,
+            ways=128,
             use_pallas=pallas,
             count_health=True,
         )

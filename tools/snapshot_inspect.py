@@ -37,10 +37,12 @@ from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
     LEASE_COL_EXPIRE,
     LEASE_COL_GRANTED,
     LEASE_COL_SETTLED,
+    SNAPSHOT_VERSION,
     SnapshotError,
     load_snapshot,
     reconcile_leases,
     reconcile_rows,
+    set_occupancy_histogram,
 )
 
 
@@ -81,11 +83,31 @@ def inspect_file(path: str, now: int | None) -> dict:
     live = occupied & (expire_at > at)
     _reconciled, rec = reconcile_rows(table, at)
     counts = table[:, COL_COUNT].astype(np.int64)
+    # per-set occupancy: v2 headers carry the writer's ways; v1 files are
+    # open-addressed, so the set view only applies post-migration — show
+    # the histogram at the default geometry with a migration note instead
+    ways = header.ways or 0
+    set_view = None
+    if ways and header.n_slots % ways == 0:
+        hist = set_occupancy_histogram(table, ways)
+        nonzero = {
+            int(k): int(v) for k, v in enumerate(hist) if v
+        }
+        full_sets = int(hist[ways]) if hist.shape[0] > ways else 0
+        set_view = {
+            "ways": ways,
+            "n_sets": header.n_slots // ways,
+            "occupancy_histogram": nonzero,
+            "full_sets": full_sets,
+            "max_set_occupancy": max(nonzero) if nonzero else 0,
+        }
     report = {
         "path": path,
         "valid": True,
         "kind": "slab",
         "version": header.version,
+        "needs_migration": header.version < SNAPSHOT_VERSION,
+        "sets": set_view,
         "created_at": header.created_at,
         "age_seconds": max(0, at - header.created_at),
         "shard": f"{header.shard_index}/{header.shard_count}",
@@ -160,6 +182,22 @@ def _print_text(report: dict) -> None:
         f"  counts  sum={rows['count_sum']} max={rows['count_max']} "
         f"dividers={rows['dividers']} window_span={rows['window_span_s']}s"
     )
+    if report.get("needs_migration"):
+        print(
+            f"  layout  v{report['version']} open-addressed — boot will "
+            f"rehash rows into the running set geometry (migration path)"
+        )
+    sets = report.get("sets")
+    if sets:
+        hist = sets["occupancy_histogram"]
+        # render a compact k:count line, capped to the busiest entries
+        top = sorted(hist.items())[-8:]
+        body = " ".join(f"{k}:{v}" for k, v in top)
+        print(
+            f"  sets    {sets['n_sets']} x {sets['ways']}-way; "
+            f"occupancy histogram (rows/set: sets) {body}; "
+            f"full={sets['full_sets']} max={sets['max_set_occupancy']}"
+        )
 
 
 def main(argv: list[str] | None = None) -> int:
