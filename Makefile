@@ -8,8 +8,8 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_artifact \
-        tests_integration tests_with_redis tests_tpu bench profile serve \
-        check_config clean docker_image docker_tests
+        tests_chaos tests_integration tests_with_redis tests_tpu bench \
+        profile serve check_config clean docker_image docker_tests
 
 all: compile
 
@@ -43,6 +43,15 @@ tests_unit: native
 # from tests_unit so a wall-clock hiccup can't -x-fail the whole stage.
 tests_artifact:
 	$(PY) -m pytest tests/ -q -m slow
+
+# Failure-injection + failover chaos tier: the degradation ladder, the
+# warm-standby replication suite, and the SIGKILL-the-primary acceptance
+# scenario (zero failed requests, bounded overshoot, split-brain fence)
+# get their own CI entry point so the failover story can gate a release
+# independently of the full unit tier.
+tests_chaos:
+	$(PY) -m pytest tests/test_chaos.py tests/test_replication.py \
+	  tests/test_warm_restart.py -v -m "not slow"
 
 # Full suite; the in-process fake Redis/Memcache servers play the role the
 # reference's local redis fleet plays (Makefile:91-125).

@@ -46,8 +46,27 @@ connection; frontends pool connections for concurrency):
                          across the RPC. Untraced frames carry flags=0
                          and zero extra bytes.
             op 2 PING:   empty
-  response: u8 status (0 ok / 1 error)
+            op 3 REPL_SUBSCRIBE: u32 epoch | u64 last_seq — a warm
+                         standby subscribing (persist/replication.py).
+                         The server acks one status byte, then STREAMS
+                         sequence-numbered replication frames (full
+                         snapshot first, dirty-row deltas on the
+                         REPL_INTERVAL_MS cadence) on this connection.
+                         flags bit 2 (FLAG_EPOCH): a u32 epoch trailer
+                         follows the block (after the lease trailer,
+                         before the trace trailer) — the split-brain
+                         fence. Only multi-address clients
+                         (SIDECAR_ADDRS) set it, so single-address
+                         deployments ship byte-identical legacy frames.
+  response: u8 status (0 ok / 1 error / 2 ok+epoch / 3 stale epoch)
             SUBMIT ok:   u32 n | uint32[n] post-increment counters
+            ok+epoch:    u32 epoch | u32 n | uint32[n] counters — only
+                         ever answers FLAG_EPOCH frames (how a failed-
+                         over client learns the promoted epoch)
+            stale epoch: u32 server_epoch — the frame carried a NEWER
+                         epoch than this owner serves: it is a
+                         resurrected stale primary and the write was
+                         NOT applied (counted repl.stale_epoch_rejected)
             PING ok:     empty
             error:       u32 len | utf-8 message
 
@@ -109,10 +128,27 @@ MAGIC = 0x524C5343  # 'RLSC'
 VERSION = 1
 OP_SUBMIT = 1
 OP_PING = 2
+# warm-standby replication subscribe (persist/replication.py): payload is
+# u32 epoch | u64 last_seq; the server acks with one status byte and then
+# STREAMS replication frames on this connection until it dies — the one
+# op that breaks the request/response rhythm, by design
+OP_REPL_SUBSCRIBE = 3
 # header flags (the u16 after op): bit 0 = B3 trace trailer appended,
-# bit 1 = lease-ops trailer appended (before the trace trailer)
+# bit 1 = lease-ops trailer appended (before the trace trailer),
+# bit 2 = u32 epoch trailer appended (after the lease trailer, before the
+#         trace trailer) — the split-brain fence: set only by multi-address
+#         clients (SIDECAR_ADDRS), so single-address deployments ship
+#         byte-identical frames to the pre-replication protocol
 FLAG_TRACE = 1
 FLAG_LEASE = 2
+FLAG_EPOCH = 4
+
+# response status bytes. 0/1 are the original protocol; 2/3 only ever
+# answer FLAG_EPOCH frames, so legacy clients never see them.
+STATUS_OK = 0
+STATUS_ERROR = 1
+STATUS_OK_EPOCH = 2  # u32 epoch | u32 n | counters
+STATUS_STALE_EPOCH = 3  # u32 server_epoch — the write was NOT applied
 # sanity cap on the trace trailer — B3 TextMap is ~90 bytes
 MAX_TRACE_TRAILER = 1024
 # sanity cap on the lease trailer (a request carries a handful of grant/
@@ -217,8 +253,19 @@ class SlabSidecarServer:
         tls_key: str = "",
         tls_ca: str = "",
         fault_injector=None,
+        repl=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
+
+        repl: optional persist.replication.ReplicationCoordinator. When
+        set, OP_REPL_SUBSCRIBE connections become its ship loops, a
+        standby's first SUBMIT promotes it (epoch bump + reconcile +
+        upload, then the write executes against the promoted slab), and
+        FLAG_EPOCH frames are epoch-fenced: a frame carrying a NEWER
+        epoch than this owner's proves a standby was promoted past it —
+        the write is rejected with STATUS_STALE_EPOCH and never executed
+        (the split-brain guard). None keeps the exact pre-replication
+        behavior.
 
         fault_injector: optional testing.faults.FaultInjector consulted at
         site 'sidecar.server.submit' before each SUBMIT reaches the engine
@@ -238,6 +285,7 @@ class SlabSidecarServer:
         certificate signed by this CA."""
         self._engine = engine
         self._faults = fault_injector
+        self._repl = repl
         self._scheme, target = parse_sidecar_address(address)
         self._path = address
         self._tls_ctx = None
@@ -324,6 +372,21 @@ class SlabSidecarServer:
                     if op == OP_PING:
                         conn.sendall(b"\x00")
                         continue
+                    if op == OP_REPL_SUBSCRIBE:
+                        # u32 epoch | u64 last_seq (diagnostic; the ship
+                        # loop always starts with a full snapshot)
+                        _recv_exact(conn, 12)
+                        if self._repl is None:
+                            conn.sendall(
+                                self._error("replication not configured")
+                            )
+                            return
+                        if net:
+                            conn.settimeout(None)
+                        # the connection becomes this subscriber's ship
+                        # loop; it never returns to request/response
+                        self._repl.serve_subscriber(conn)
+                        return
                     if op != OP_SUBMIT:
                         conn.sendall(self._error(f"bad op {op}"))
                         return
@@ -355,6 +418,13 @@ class SlabSidecarServer:
                             )
                             return
                         lease_blob = _recv_exact(conn, blob_len)
+                    frame_epoch = None
+                    if hdr_flags & FLAG_EPOCH:
+                        # epoch fence trailer (fixed u32): read before any
+                        # fault handling so the frame stays wire-coherent
+                        (frame_epoch,) = _U32.unpack(
+                            _recv_exact(conn, _U32.size)
+                        )
                     wire_ctx = None
                     if hdr_flags & FLAG_TRACE:
                         # B3 trace trailer: read it BEFORE any fault
@@ -390,6 +460,30 @@ class SlabSidecarServer:
                             # the client sees a mid-frame connection loss
                             conn.sendall(b"\x00")
                             return
+                    if self._repl is not None:
+                        # a write reaching a standby IS the failover
+                        # signal: promote (epoch bump + reconcile +
+                        # upload) before executing it. Idempotent and
+                        # thread-safe — concurrent first writes all wait
+                        # on the one transition.
+                        if self._repl.is_standby:
+                            self._repl.promote(
+                                reason="client write reached standby"
+                            )
+                        if (
+                            frame_epoch is not None
+                            and frame_epoch > self._repl.epoch
+                        ):
+                            # the split-brain guard: the client has seen a
+                            # newer epoch than this owner serves — this is
+                            # a resurrected stale primary and the write
+                            # must NOT touch its slab
+                            self._repl.note_stale_write(frame_epoch)
+                            conn.sendall(
+                                bytes([STATUS_STALE_EPOCH])
+                                + _U32.pack(self._repl.epoch)
+                            )
+                            continue
                     # server span parented by the frontend's wire context
                     # (B3 over the sidecar wire), activated so the
                     # dispatch loop's ring ctx and batch-span links see
@@ -455,9 +549,26 @@ class SlabSidecarServer:
                                 journey,
                                 (time.monotonic_ns() - t_req_ns) / 1e6,
                             )
-                        conn.sendall(
-                            b"\x00" + _U32.pack(len(out)) + out.tobytes()
-                        )
+                        if frame_epoch is not None:
+                            # epoch-flagged frames get the epoch-carrying
+                            # reply so failed-over clients learn the
+                            # promoted epoch; repl-less owners answer 0
+                            # (clients ignore it)
+                            my_epoch = (
+                                self._repl.epoch
+                                if self._repl is not None
+                                else 0
+                            )
+                            conn.sendall(
+                                bytes([STATUS_OK_EPOCH])
+                                + _U32.pack(my_epoch)
+                                + _U32.pack(len(out))
+                                + out.tobytes()
+                            )
+                        else:
+                            conn.sendall(
+                                b"\x00" + _U32.pack(len(out)) + out.tobytes()
+                            )
                     except Exception as e:  # noqa: BLE001 - surface to client
                         if server_span is not None:
                             server_span.set_error(e)
@@ -530,7 +641,7 @@ class SidecarEngineClient:
 
     def __init__(
         self,
-        address: str,
+        address,
         pool_size: int = 8,
         timeout: float = 30.0,
         tls_ca: str = "",
@@ -548,7 +659,18 @@ class SidecarEngineClient:
         fault_injector=None,
         sleep=time.sleep,
     ):
-        """address: unix path, tcp://host:port, or tls://host:port.
+        """address: unix path, tcp://host:port, or tls://host:port — or a
+        LIST of them (equivalently one comma-separated string: the
+        SIDECAR_ADDRS form). The first entry is the primary; the rest are
+        warm standbys in failover order. With more than one address the
+        client becomes epoch-aware: every SUBMIT carries a FLAG_EPOCH
+        trailer with the highest epoch it has seen, the breaker opening
+        (or an address's retry budget exhausting, or a stale-epoch reply)
+        fails the client over to the next address — whose first write
+        promotes it — and a resurrected stale primary answering
+        STATUS_STALE_EPOCH is failed away from instead of trusted. A
+        single address keeps the wire format and behavior byte-identical
+        to the pre-replication client (the rollback arm, pinned by test).
         tls_ca: CA bundle the server cert must chain to (defaults to the
         system store when empty). tls_cert/tls_key: client certificate for
         mutual TLS. tls_server_name: SNI/hostname override when the cert CN
@@ -587,17 +709,35 @@ class SidecarEngineClient:
         'sidecar.dial' per dial and 'sidecar.submit' per SUBMIT attempt."""
         self._h_rpc = None
         self._c_retry = self._c_redial = self._c_breaker_open = None
-        self._g_breaker_state = None
+        self._c_failover = None
+        self._g_breaker_state = self._g_active_backend = None
         if scope is not None:
             sc = scope.scope("sidecar")
             self._h_rpc = sc.histogram("rpc_ms")
             self._c_retry = sc.counter("retry")
             self._c_redial = sc.counter("redial")
             self._c_breaker_open = sc.counter("breaker_open")
+            self._c_failover = sc.counter("failover")
             self._g_breaker_state = sc.gauge("breaker_state")
             self._g_breaker_state.set(0)
-        self._path = address
-        self._scheme, self._target = parse_sidecar_address(address)
+            self._g_active_backend = sc.gauge("active_backend")
+            self._g_active_backend.set(0)
+        if isinstance(address, str):
+            addrs = [a.strip() for a in address.split(",") if a.strip()]
+        else:
+            addrs = [str(a) for a in address]
+        if not addrs:
+            raise ValueError("sidecar address list is empty")
+        self._addrs = addrs
+        self._addr_lock = threading.Lock()
+        self._active = 0
+        # epoch awareness exists ONLY with standbys to fail over to; a
+        # single-address client ships the exact legacy frame (flags bit 2
+        # clear, no trailer) — the byte-identical rollback arm
+        self._epoch_aware = len(addrs) > 1
+        self._epoch_known = 0
+        self._path = addrs[0]
+        self._scheme, self._target = parse_sidecar_address(addrs[0])
         self._timeout = timeout
         self._connect_timeout = (
             timeout if connect_timeout is None else float(connect_timeout)
@@ -638,17 +778,34 @@ class SidecarEngineClient:
         # certificate only surfaces on the first read after the handshake.
         # Deliberately not retried and not breaker-counted — a frontend
         # booting against a dark sidecar should fail its boot loudly.
-        conn = self._dial()
-        try:
-            conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
-            ok = _recv_exact(conn, 1) == b"\x00"
-        except (OSError, ConnectionError) as e:
-            conn.close()
-            raise CacheError(f"sidecar ping failed on {address}: {e}") from e
-        if not ok:
-            conn.close()
-            raise CacheError(f"sidecar ping failed on {address}")
-        self._release(conn)
+        # With SIDECAR_ADDRS the ping walks the failover order instead:
+        # a dark primary with a live standby is exactly the redundancy
+        # story, not a boot failure.
+        last_err: CacheError | None = None
+        for _ in range(len(self._addrs)):
+            try:
+                conn = self._dial()
+                try:
+                    conn.sendall(_HDR.pack(MAGIC, VERSION, OP_PING, 0))
+                    ok = _recv_exact(conn, 1) == b"\x00"
+                except (OSError, ConnectionError) as e:
+                    conn.close()
+                    raise CacheError(
+                        f"sidecar ping failed on {self._path}: {e}"
+                    ) from e
+                if not ok:
+                    conn.close()
+                    raise CacheError(f"sidecar ping failed on {self._path}")
+                self._release(conn)
+                last_err = None
+                break
+            except CacheError as e:
+                last_err = e
+                if not self._epoch_aware:
+                    raise
+                self._failover(cause=f"boot ping failed: {e}")
+        if last_err is not None:
+            raise last_err
 
     def _on_breaker_transition(self, prev: str, state: str) -> None:
         if self._g_breaker_state is not None:
@@ -669,42 +826,99 @@ class SidecarEngineClient:
         """The transport circuit breaker (tests/debug observability)."""
         return self._breaker
 
+    @property
+    def active_address(self) -> str:
+        """The address currently being written to (tests/debug)."""
+        with self._addr_lock:
+            return self._addrs[self._active]
+
+    def failover_reason(self) -> str | None:
+        """HealthChecker degraded-probe contract: a reason string while
+        this frontend serves from a non-primary address — the cluster is
+        one more failure from the degradation ladder, which operators
+        should see on /healthcheck while it keeps serving."""
+        with self._addr_lock:
+            if self._active == 0:
+                return None
+            return (
+                f"sidecar.failover: serving from standby "
+                f"{self._addrs[self._active]} (primary {self._addrs[0]} "
+                f"unreachable or stale)"
+            )
+
+    def _failover(self, cause: str, span=None) -> str:
+        """Rotate to the next address in SIDECAR_ADDRS order: evict every
+        pooled connection (they point at the dead/stale owner), reset the
+        breaker for the new target, and mark the moment on the active
+        trace span and journey (FLAG_FAILOVER) so /debug/journeys retains
+        the requests that rode a failover. Returns the new address."""
+        with self._addr_lock:
+            self._active = (self._active + 1) % len(self._addrs)
+            self._path = self._addrs[self._active]
+            self._scheme, self._target = parse_sidecar_address(self._path)
+            new_addr = self._path
+            active = self._active
+        self._evict_pool()
+        # a fresh target deserves a closed breaker: its failure streak
+        # belongs to the address we just left
+        self._breaker.record_success()
+        if self._c_failover is not None:
+            self._c_failover.inc()
+        if self._g_active_backend is not None:
+            self._g_active_backend.set(active)
+        logger.warning(
+            "sidecar FAILOVER to %s (backend %d of %d): %s",
+            new_addr,
+            active + 1,
+            len(self._addrs),
+            cause,
+        )
+        target_span = span if span is not None else active_span()
+        if target_span is not None:
+            target_span.log_kv(
+                event="sidecar.failover", to=new_addr, cause=cause
+            )
+        journeys.note_flag(journeys.FLAG_FAILOVER)
+        return new_addr
+
     def _dial(self) -> socket.socket:
+        with self._addr_lock:
+            scheme, target, path = self._scheme, self._target, self._path
         if self._faults is not None:
             action = self._faults.fire("sidecar.dial")
             if action is not None:
                 raise CacheError(
-                    f"cannot reach slab sidecar at {self._path}: "
+                    f"cannot reach slab sidecar at {path}: "
                     f"injected fault: {action}"
                 )
-        if self._scheme == "unix":
+        if scheme == "unix":
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(self._connect_timeout)
             try:
-                conn.connect(self._target)
+                conn.connect(target)
             except OSError as e:
                 conn.close()
                 raise CacheError(
-                    f"cannot reach slab sidecar at {self._path}: {e}"
+                    f"cannot reach slab sidecar at {path}: {e}"
                 )
             conn.settimeout(self._rpc_deadline)
             return conn
         try:
             conn = socket.create_connection(
-                self._target, timeout=self._connect_timeout
+                target, timeout=self._connect_timeout
             )
         except OSError as e:
-            raise CacheError(f"cannot reach slab sidecar at {self._path}: {e}")
+            raise CacheError(f"cannot reach slab sidecar at {path}: {e}")
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._tls_ctx is not None:
                 conn = self._tls_ctx.wrap_socket(
                     conn,
-                    server_hostname=self._tls_server_name or self._target[0],
+                    server_hostname=self._tls_server_name or target[0],
                 )
         except OSError as e:
             conn.close()
-            raise CacheError(f"sidecar TLS handshake failed on {self._path}: {e}")
+            raise CacheError(f"sidecar TLS handshake failed on {path}: {e}")
         conn.settimeout(self._rpc_deadline)
         return conn
 
@@ -775,9 +989,15 @@ class SidecarEngineClient:
     ) -> np.ndarray:
         t0 = time.perf_counter() if self._h_rpc is not None else 0.0
         if not self._breaker.allow():
-            raise CacheError(
-                f"sidecar circuit open on {self._path}: failing fast"
-            )
+            # the PR-2 breaker opening on the primary IS the failover
+            # trigger: with a standby configured, switch instead of
+            # failing fast — its first write will promote it
+            if self._epoch_aware:
+                self._failover(cause="circuit breaker open")
+            else:
+                raise CacheError(
+                    f"sidecar circuit open on {self._path}: failing fast"
+                )
         # B3 over the sidecar wire: a client child span whose injected
         # context rides the frame as a TextMap trailer, so the device-owner
         # process's spans parent into this request's trace. Retries and
@@ -787,6 +1007,14 @@ class SidecarEngineClient:
         parent = active_span()
         rpc_span = None
         hdr_flags = extra_flags
+        epoch_trailer = b""
+        if self._epoch_aware:
+            # the split-brain fence: carry the highest epoch this client
+            # has seen, so a resurrected stale primary rejects the write
+            # instead of double-serving old counters. Single-address
+            # clients never set this bit — byte-identical legacy frames.
+            hdr_flags |= FLAG_EPOCH
+            epoch_trailer = _U32.pack(self._epoch_known)
         trailer = b""
         if parent is not None and parent.tracer is not None:
             rpc_span = parent.tracer.start_span(
@@ -800,6 +1028,7 @@ class SidecarEngineClient:
         request = (
             _HDR.pack(MAGIC, VERSION, OP_SUBMIT, hdr_flags)
             + payload
+            + epoch_trailer
             + trailer
         )
         try:
@@ -815,6 +1044,24 @@ class SidecarEngineClient:
     def _submit_attempts(self, request: bytes, rpc_span, t0: float) -> np.ndarray:
         attempt = 0
         redialed = False
+        # bounded address rotation per call: once an address's retry
+        # budget exhausts (or it answers stale-epoch), the request moves
+        # to the next SIDECAR_ADDRS entry instead of failing — a primary
+        # crash with a live standby costs zero failed requests. At most
+        # one full pass over the standby list, then the error surfaces to
+        # the FAILURE_MODE_DENY ladder like any exhausted transport.
+        failovers = 0
+
+        def fail_over_or_raise(cause: str) -> bool:
+            nonlocal failovers, attempt, redialed
+            if not self._epoch_aware or failovers >= len(self._addrs) - 1:
+                return False
+            failovers += 1
+            attempt = 0
+            redialed = False
+            self._failover(cause, span=rpc_span)
+            return True
+
         while True:
             try:
                 conn, pooled = self._acquire()
@@ -823,6 +1070,8 @@ class SidecarEngineClient:
                 attempt += 1
                 if attempt > self._retries:
                     self._breaker.record_failure()
+                    if fail_over_or_raise(f"dial failed: {e}"):
+                        continue
                     raise
                 if self._c_retry is not None:
                     self._c_retry.inc()
@@ -835,6 +1084,7 @@ class SidecarEngineClient:
                     )
                 self._sleep(self._backoff(attempt))
                 continue
+            stale_epoch = None
             try:
                 if self._faults is not None:
                     action = self._faults.fire("sidecar.submit")
@@ -857,8 +1107,27 @@ class SidecarEngineClient:
                     # applied), resets the breaker's failure streak
                     self._breaker.record_success()
                     raise CacheError(f"sidecar error: {message}")
-                (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
-                out = np.frombuffer(_recv_exact(conn, 4 * n), dtype=np.uint32)
+                if status == bytes([STATUS_STALE_EPOCH]):
+                    # the owner refused the write: it serves an OLDER
+                    # epoch than this client has seen — a resurrected
+                    # stale primary. The write was NOT applied; fail over
+                    # (safe to re-send) instead of trusting stale state.
+                    (stale_epoch,) = _U32.unpack(
+                        _recv_exact(conn, _U32.size)
+                    )
+                    self._release(conn)
+                    self._breaker.record_success()
+                else:
+                    if status == bytes([STATUS_OK_EPOCH]):
+                        (srv_epoch,) = _U32.unpack(
+                            _recv_exact(conn, _U32.size)
+                        )
+                        if srv_epoch > self._epoch_known:
+                            self._epoch_known = srv_epoch
+                    (n,) = _U32.unpack(_recv_exact(conn, _U32.size))
+                    out = np.frombuffer(
+                        _recv_exact(conn, 4 * n), dtype=np.uint32
+                    )
             except CacheError:
                 raise
             except (OSError, ConnectionError) as e:
@@ -880,6 +1149,8 @@ class SidecarEngineClient:
                 attempt += 1
                 if attempt > self._retries:
                     self._breaker.record_failure()
+                    if fail_over_or_raise(f"transport failure: {e}"):
+                        continue
                     raise CacheError(f"sidecar transport failure: {e}") from e
                 if self._c_retry is not None:
                     self._c_retry.inc()
@@ -892,6 +1163,23 @@ class SidecarEngineClient:
                     )
                 self._sleep(self._backoff(attempt))
                 continue
+            if stale_epoch is not None:
+                if rpc_span is not None:
+                    rpc_span.log_kv(
+                        event="sidecar.stale_epoch",
+                        server_epoch=stale_epoch,
+                        known_epoch=self._epoch_known,
+                    )
+                if fail_over_or_raise(
+                    f"stale primary (epoch {stale_epoch} < "
+                    f"{self._epoch_known})"
+                ):
+                    continue
+                raise CacheError(
+                    f"sidecar at {self._path} is a stale primary "
+                    f"(epoch {stale_epoch}, cluster at "
+                    f"{self._epoch_known}) and no other address answers"
+                )
             self._release(conn)
             self._breaker.record_success()
             if self._h_rpc is not None:
@@ -914,14 +1202,16 @@ def new_sidecar_cache_from_settings(
     lease_table=None,
 ):
     """BACKEND_TYPE=tpu-sidecar factory: a TpuRateLimitCache whose device
-    driver is the remote sidecar (runner.py backend switch)."""
+    driver is the remote sidecar (runner.py backend switch). With
+    SIDECAR_ADDRS set the client gets the whole failover list (primary
+    first); unset, it is exactly the single-address legacy client."""
     from .tpu import TpuRateLimitCache
 
     return TpuRateLimitCache(
         base_limiter,
         lease_table=lease_table,
         engine=SidecarEngineClient(
-            settings.sidecar_socket,
+            settings.sidecar_addresses(),
             tls_ca=settings.sidecar_tls_ca,
             tls_cert=settings.sidecar_tls_cert,
             tls_key=settings.sidecar_tls_key,

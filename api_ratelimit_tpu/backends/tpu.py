@@ -645,6 +645,29 @@ class SlabDeviceEngine:
                 slab_import_rows(rows), self._device
             )
 
+    # -- warm-standby replication (persist/replication.py) --
+
+    def export_for_replication(self) -> tuple[list[np.ndarray], np.ndarray, int]:
+        """One export for the replication ship loop: the slab shard
+        tables (the same quiesce-and-copy path the snapshotter rides —
+        only a device-side copy dispatches under the state lock, the D2H
+        drain happens against the detached copy) plus the live
+        lease-liability rows, stamped with one clock read so the standby
+        reconciles slab and liabilities against the same instant."""
+        tables = self.export_tables()
+        now = int(self._time_source.unix_now())
+        return tables, self.lease_registry.export_rows(now), now
+
+    def apply_replicated(
+        self, tables: list[np.ndarray], lease_rows: np.ndarray
+    ) -> None:
+        """Promotion upload: replace the slab with the reconciled replica
+        tables (the coordinator already ran reconcile_rows + lease
+        floors) and re-seed the liability registry — the same pair of
+        moves the warm-restart boot restore makes."""
+        self.import_tables(tables)
+        self.lease_registry.import_rows(lease_rows)
+
     # -- device execution (dispatcher thread / direct-mode caller only) --
 
     def _bucket_for(self, n: int) -> int:

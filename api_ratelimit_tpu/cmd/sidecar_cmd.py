@@ -6,6 +6,16 @@ serving ports together via SO_REUSEPORT and the kernel load-balances
 connections across them, while every rate-limit increment serializes
 through this process's slab (backends/sidecar.py).
 
+Warm-standby redundancy (--role / REPL_ROLE + SIDECAR_ADDRS;
+persist/replication.py): run a SECOND sidecar with --role standby (or
+auto) pointed at the same SIDECAR_ADDRS list — it subscribes to the
+primary, mirrors the slab through streamed dirty-row deltas, and promotes
+itself (epoch bump + boot-style reconcile) the moment a failed-over
+frontend writes to it. Frontends list both addresses in SIDECAR_ADDRS and
+ride the circuit breaker across the failover with zero failed requests.
+`--role auto` is the restart-friendly choice: a crashed-and-restarted old
+primary finds the promoted standby serving and rejoins as ITS standby.
+
 Honors the same TPU_* env knobs as the in-process backend: TPU_SLAB_SLOTS,
 TPU_BATCH_WINDOW (recommended: 100-500us — the cross-frontend coalescing
 window), TPU_BATCH_LIMIT, TPU_MESH_DEVICES, TPU_USE_PALLAS — and the
@@ -24,6 +34,7 @@ scrapes between the two processes.
 
 from __future__ import annotations
 
+import argparse
 import logging
 import signal
 import threading
@@ -31,7 +42,7 @@ import threading
 from ..backends.sidecar import SlabSidecarServer
 from ..backends.tpu import SlabDeviceEngine, SlabHealthStats
 from ..runner import setup_logging
-from ..server.http_server import new_debug_server
+from ..server.http_server import add_healthcheck, new_debug_server
 from ..settings import new_settings
 from ..stats.sinks import NullSink, StatsdSink
 from ..stats.store import Store
@@ -42,8 +53,21 @@ from ..utils.timeutil import RealTimeSource
 logger = logging.getLogger("ratelimit.sidecar.main")
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="TPU slab device-owner process (sidecar)"
+    )
+    parser.add_argument(
+        "--role",
+        choices=("primary", "standby", "auto"),
+        default=None,
+        help="warm-standby replication role (overrides REPL_ROLE; "
+        "requires SIDECAR_ADDRS to name the peer for standby/auto)",
+    )
+    args = parser.parse_args(argv)
     settings = new_settings()
+    if args.role is not None:
+        settings.repl_role = args.role
     setup_logging(settings)
 
     sink = (
@@ -177,6 +201,29 @@ def main() -> None:
         LeaseRegistryStats(engine.lease_registry, scope.scope("lease"))
     )
 
+    # Warm-standby replication (persist/replication.py): build the
+    # coordinator BEFORE the snapshotter — a standby defers its restore
+    # (the replicated stream supersedes any local snapshot, and
+    # periodically snapshotting an un-promoted standby's empty slab would
+    # clobber good files) and starts snapshotting only at promotion.
+    repl = None
+    repl_role, repl_interval_ms, repl_max_lag_ms = settings.repl_config()
+    on_promote_hooks: list = []
+    if repl_role:
+        from ..persist.replication import ReplicationCoordinator
+
+        repl = ReplicationCoordinator(
+            engine,
+            repl_role,
+            peer_address=settings.repl_peer_address(),
+            interval_ms=repl_interval_ms,
+            max_lag_ms=repl_max_lag_ms,
+            scope=scope.scope("repl"),
+            fault_injector=fault_injector,
+            time_source=RealTimeSource(),
+            on_promote=lambda: [hook() for hook in on_promote_hooks],
+        )
+
     # Warm restart (persist/): the sidecar IS the device owner, so the
     # snapshot/restore cycle lives here — restore the shared slab before
     # accepting the first frontend connection, snapshot on the
@@ -195,8 +242,26 @@ def main() -> None:
             scope=scope,
             fault_injector=fault_injector,
         )
-        snapshotter.restore()
-        snapshotter.start()
+        if repl is None or not repl.is_standby:
+            # explicit primary (or no replication): the original contract
+            # — restore the slab BEFORE the first frontend connection
+            snapshotter.restore()
+            snapshotter.start()
+        # standby/auto: deferred until the role resolves (below) — the
+        # replicated stream supersedes any local snapshot, and snapshotting
+        # an un-promoted standby's empty slab would clobber good files
+
+    # /healthcheck on the debug port, both roles: degraded reasons stack
+    # the same way the frontend's do — replication lag / missing standby
+    # (repl.degraded) next to snapshot staleness. Degraded-only: a
+    # device owner with at-risk durability must keep serving.
+    from ..server.health import HealthChecker
+
+    health = HealthChecker(name="ratelimit-sidecar")
+    if repl is not None:
+        health.add_degraded_probe(repl.degraded_reason)
+    if snapshotter is not None:
+        health.add_degraded_probe(snapshotter.stale_reason)
 
     debug = new_debug_server(
         "",
@@ -205,6 +270,7 @@ def main() -> None:
         enable_metrics=settings.debug_metrics_enabled,
         profile_dir=settings.tpu_profile_dir,
     )
+    add_healthcheck(debug, health)
     debug.serve_background()
     store.start_flushing()
     server = SlabSidecarServer(
@@ -215,7 +281,31 @@ def main() -> None:
         tls_key=settings.sidecar_tls_key,
         tls_ca=settings.sidecar_tls_ca,
         fault_injector=fault_injector,
+        repl=repl,
     )
+    if repl is not None:
+        # resolve the auto role / start the standby subscription only
+        # once our own listener is up (an auto pair booting together must
+        # be able to find each other)
+        was_standby_at_boot = repl.is_standby
+        repl.start()
+        logger.warning(
+            "replication role %s (epoch %d, interval %.0fms)",
+            repl.role,
+            repl.epoch,
+            repl_interval_ms,
+        )
+        if snapshotter is not None and was_standby_at_boot:
+            if repl.is_standby:
+                # promotion turns the standby into the durability owner:
+                # the periodic cycle starts then (no restore — the
+                # replicated state it just uploaded IS newer than any
+                # local snapshot)
+                on_promote_hooks.append(snapshotter.start)
+            else:
+                # auto resolved to primary (peer dark): normal warm boot
+                snapshotter.restore()
+                snapshotter.start()
 
     stop = threading.Event()
 
@@ -227,10 +317,15 @@ def main() -> None:
         signal.signal(sig, on_signal)
     stop.wait()
     server.close()
+    if repl is not None:
+        repl.close()
     if snapshotter is not None:
         # frontends are disconnected; quiesce the batcher and hand the
         # next process a slab with every admitted decision in it
-        snapshotter.drain()
+        # (a never-promoted standby never started the cycle and must not
+        # overwrite the primary's files with its empty slab)
+        if repl is None or not repl.is_standby:
+            snapshotter.drain()
     store.stop_flushing()
     debug.shutdown()
     tracer.close()

@@ -16,28 +16,38 @@ windows fails open for a full window per key).
 snapshot.py holds the file format + reconcile rules (numpy only — the
 offline inspect CLI must not drag jax in); snapshotter.py holds the
 runtime service (periodic thread, boot restore, drain handoff, stats,
-staleness probe).
+staleness probe); replication.py holds the warm-standby subsystem
+(streaming snapshot + dirty-row deltas over the sidecar wire, sequence
+gap -> resync, epoch-fenced promotion) — the availability rung on top of
+this package's durability rung.
 """
 
+from .replication import ReplicationCoordinator, ReplProtocolError
 from .snapshot import (
     SNAPSHOT_VERSION,
     SnapshotError,
     SnapshotHeader,
     load_snapshot,
+    pack_table_bytes,
     read_header,
     reconcile_rows,
+    unpack_table_bytes,
     write_snapshot,
 )
 from .snapshotter import SlabSnapshotter, snapshot_paths
 
 __all__ = [
     "SNAPSHOT_VERSION",
+    "ReplProtocolError",
+    "ReplicationCoordinator",
+    "SlabSnapshotter",
     "SnapshotError",
     "SnapshotHeader",
-    "SlabSnapshotter",
     "load_snapshot",
+    "pack_table_bytes",
     "read_header",
     "reconcile_rows",
     "snapshot_paths",
+    "unpack_table_bytes",
     "write_snapshot",
 ]

@@ -175,6 +175,64 @@ def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
     return header
 
 
+def pack_table_bytes(
+    table: np.ndarray,
+    created_at: int,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    flags: int = 0,
+    ways: int = 0,
+    version: int = SNAPSHOT_VERSION,
+) -> bytes:
+    """One table as a self-describing versioned+CRC section: the exact
+    bytes a snapshot file holds (header.pack() + payload). Shared by the
+    file writer below and the replication stream (persist/replication.py),
+    so a standby's full-sync frame IS the snapshot format — same CRCs,
+    same ways stamp, same validation path."""
+    table = np.ascontiguousarray(table, dtype="<u4")
+    if table.ndim != 2:
+        raise ValueError(f"snapshot table must be 2-D, got {table.shape}")
+    payload = table.tobytes()
+    if ways:
+        flags = int(flags) | (int(ways) << FLAG_WAYS_SHIFT)
+    header = SnapshotHeader(
+        version=int(version),
+        created_at=int(created_at),
+        n_slots=table.shape[0],
+        row_width=table.shape[1],
+        shard_index=int(shard_index),
+        shard_count=int(shard_count),
+        payload_crc=zlib.crc32(payload),
+        payload_len=len(payload),
+        flags=int(flags),
+    )
+    return header.pack() + payload
+
+
+def unpack_table_bytes(
+    buf: bytes, offset: int = 0, what: str = "<buffer>"
+) -> tuple[SnapshotHeader, np.ndarray, int]:
+    """Inverse of pack_table_bytes against a byte buffer: validates the
+    header + payload CRCs exactly like load_snapshot and returns
+    (header, table copy, offset past the section) so concatenated
+    sections parse sequentially."""
+    raw = buf[offset : offset + HEADER_SIZE]
+    header = _unpack_header(raw, what)
+    start = offset + HEADER_SIZE
+    payload = buf[start : start + header.payload_len]
+    if len(payload) != header.payload_len:
+        raise SnapshotError(
+            f"{what}: section payload is {len(payload)} bytes, header "
+            f"says {header.payload_len} (truncated)"
+        )
+    if zlib.crc32(payload) != header.payload_crc:
+        raise SnapshotError(f"{what}: section payload CRC mismatch")
+    table = np.frombuffer(payload, dtype="<u4").reshape(
+        header.n_slots, header.row_width
+    )
+    return header, table.astype(np.uint32), start + header.payload_len
+
+
 def write_snapshot(
     path: str,
     table: np.ndarray,
@@ -203,34 +261,26 @@ def write_snapshot(
         action = fault_injector.fire(FAULT_SITE_WRITE)
         if action == "error":
             raise OSError(f"injected {FAULT_SITE_WRITE} error")
-    table = np.ascontiguousarray(table, dtype="<u4")
-    if table.ndim != 2:
-        raise ValueError(f"snapshot table must be 2-D, got {table.shape}")
-    payload = table.tobytes()
-    if ways:
-        flags = int(flags) | (int(ways) << FLAG_WAYS_SHIFT)
-    header = SnapshotHeader(
-        version=int(version),
-        created_at=int(created_at),
-        n_slots=table.shape[0],
-        row_width=table.shape[1],
-        shard_index=int(shard_index),
-        shard_count=int(shard_count),
-        payload_crc=zlib.crc32(payload),
-        payload_len=len(payload),
-        flags=int(flags),
+    blob = pack_table_bytes(
+        table,
+        created_at,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        flags=flags,
+        ways=ways,
+        version=version,
     )
+    payload_len = len(blob) - HEADER_SIZE
     if action == "corrupt":
-        mutated = bytearray(payload)
-        mutated[len(mutated) // 2] ^= 0xFF
-        payload = bytes(mutated)
+        mutated = bytearray(blob)
+        mutated[HEADER_SIZE + payload_len // 2] ^= 0xFF
+        blob = bytes(mutated)
     elif action == "torn_write":
-        payload = payload[: max(HEADER_SIZE, len(payload) // 2)]
+        blob = blob[: HEADER_SIZE + max(HEADER_SIZE, payload_len // 2)]
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            f.write(header.pack())
-            f.write(payload)
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -246,7 +296,7 @@ def write_snapshot(
         os.fsync(dir_fd)
     finally:
         os.close(dir_fd)
-    return HEADER_SIZE + len(payload)
+    return len(blob)
 
 
 def read_header(path: str) -> SnapshotHeader:

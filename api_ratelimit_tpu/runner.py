@@ -385,6 +385,12 @@ class Runner:
         # the /healthcheck body next to the fallback/overload reasons.
         if engine is not None and hasattr(engine, "watermark_reason"):
             self.server.health.add_degraded_probe(engine.watermark_reason)
+        # Device-owner failover probe (SIDECAR_ADDRS; backends/sidecar.py):
+        # while this frontend serves from a standby address the cluster is
+        # one failure from the degradation ladder — /healthcheck carries
+        # it while the instance keeps serving.
+        if engine is not None and hasattr(engine, "failover_reason"):
+            self.server.health.add_degraded_probe(engine.failover_reason)
 
         # Warm restart (persist/): restore the slab from the last snapshot
         # BEFORE serving, then re-snapshot on a cadence off the hot path;

@@ -165,6 +165,29 @@ class Settings:
     sidecar_tls_key: str = ""
     sidecar_tls_ca: str = ""
     sidecar_tls_server_name: str = ""
+    # --- warm-standby device-owner replication (persist/replication.py) ---
+    # SIDECAR_ADDRS: comma-separated failover list of device-owner
+    # addresses, PRIMARY FIRST. Frontends (tpu-sidecar) get the whole list
+    # and fail over down it when the circuit breaker opens on the active
+    # entry; sidecar processes use it to find their replication peer (the
+    # first entry that is not their own SIDECAR_SOCKET). Empty (the
+    # default) keeps the single-address legacy client — byte-identical
+    # wire frames, the rollback arm.
+    sidecar_addrs: str = ""
+    # REPL_ROLE (sidecar_cmd only): "primary" serves and streams state to
+    # subscribed standbys; "standby" subscribes to the peer, mirrors the
+    # slab host-side, and PROMOTES itself on the first client write (epoch
+    # bump + boot-style reconcile); "auto" becomes standby when the peer
+    # answers the subscribe and primary otherwise — the restart-friendly
+    # choice. Empty (the default) disables replication entirely.
+    repl_role: str = ""
+    # delta ship cadence: the dirty-set diff ships every REPL_INTERVAL_MS,
+    # so a primary crash loses at most this much admitted traffic (plus
+    # outstanding lease budgets) — the documented overshoot bound
+    repl_interval_ms: float = 100.0
+    # replication lag past this raises the sticky repl.degraded health
+    # probe on both roles (0 = five intervals)
+    repl_max_lag_ms: float = 0.0
     # --- resilience ladder (this framework; FAILURE_MODE_DENY keeps the
     # upstream knob name) ---
     # What the service answers when the backend raises CacheError (dead
@@ -437,6 +460,74 @@ class Settings:
             near_ratio,
         )
 
+    def sidecar_addresses(self) -> list[str]:
+        """The frontend's device-owner failover list: parsed SIDECAR_ADDRS
+        (primary first), or [SIDECAR_SOCKET] when unset — the single-
+        address legacy client, byte-identical on the wire. Junk (empty
+        entries only, malformed tcp://tls:// authorities) fails the boot
+        like every other knob."""
+        raw = self.sidecar_addrs.strip()
+        if not raw:
+            return [self.sidecar_socket]
+        from .backends.sidecar import parse_sidecar_address
+
+        addrs = [a.strip() for a in raw.split(",") if a.strip()]
+        if not addrs:
+            raise ValueError(
+                f"SIDECAR_ADDRS must hold at least one address, "
+                f"got {self.sidecar_addrs!r}"
+            )
+        for addr in addrs:
+            try:
+                parse_sidecar_address(addr)
+            except ValueError as e:
+                raise ValueError(f"bad SIDECAR_ADDRS entry {addr!r}: {e}") from e
+        return addrs
+
+    def repl_peer_address(self) -> str | None:
+        """The replication peer a sidecar process subscribes to: the first
+        SIDECAR_ADDRS entry that is not its own SIDECAR_SOCKET, or None
+        when the list names nobody else."""
+        for addr in self.sidecar_addresses():
+            if addr != self.sidecar_socket:
+                return addr
+        return None
+
+    def repl_config(self) -> tuple[str, float, float]:
+        """Validated (role, interval_ms, max_lag_ms) for warm-standby
+        replication; role == "" disables. Junk fails the boot like every
+        other knob — a typo'd role must not silently become 'no standby',
+        and a lag bound below the ship cadence would flap the health
+        probe every interval. max_lag 0 defaults to five intervals."""
+        role = self.repl_role.strip().lower()
+        if role not in ("", "primary", "standby", "auto"):
+            raise ValueError(
+                f"REPL_ROLE must be primary, standby, auto, or empty, "
+                f"got {self.repl_role!r}"
+            )
+        interval = float(self.repl_interval_ms)
+        max_lag = float(self.repl_max_lag_ms)
+        if interval <= 0:
+            raise ValueError(
+                f"REPL_INTERVAL_MS must be > 0, got {interval}"
+            )
+        if max_lag < 0:
+            raise ValueError(
+                f"REPL_MAX_LAG_MS must be >= 0, got {max_lag}"
+            )
+        if 0 < max_lag < interval:
+            raise ValueError(
+                f"REPL_MAX_LAG_MS ({max_lag}) must not sit below "
+                f"REPL_INTERVAL_MS ({interval})"
+            )
+        if role in ("standby", "auto") and self.repl_peer_address() is None:
+            raise ValueError(
+                f"REPL_ROLE={role} needs SIDECAR_ADDRS to name a peer "
+                f"other than this process's SIDECAR_SOCKET "
+                f"({self.sidecar_socket!r})"
+            )
+        return role, interval, max_lag if max_lag > 0 else 5.0 * interval
+
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
         ValueError on junk — a typo'd chaos spec must fail the boot, not
@@ -520,6 +611,10 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("sidecar_tls_key", "SIDECAR_TLS_KEY", str),
     ("sidecar_tls_ca", "SIDECAR_TLS_CA", str),
     ("sidecar_tls_server_name", "SIDECAR_TLS_SERVER_NAME", str),
+    ("sidecar_addrs", "SIDECAR_ADDRS", str),
+    ("repl_role", "REPL_ROLE", str),
+    ("repl_interval_ms", "REPL_INTERVAL_MS", float),
+    ("repl_max_lag_ms", "REPL_MAX_LAG_MS", float),
     ("failure_mode_deny", "FAILURE_MODE_DENY", str),
     ("sidecar_connect_timeout", "SIDECAR_CONNECT_TIMEOUT", _parse_duration_seconds),
     ("sidecar_rpc_deadline", "SIDECAR_RPC_DEADLINE", _parse_duration_seconds),

@@ -55,6 +55,23 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
                             in memory before validation; either way the
                             restore must count snapshot.load_rejected and
                             boot a cold slab instead of crashing
+    repl.ship               warm-standby replication, PRIMARY side
+                            (persist/replication.py): before each frame
+                            send — delay_ms models a slow/partitioned
+                            link (replication lag -> the repl.degraded
+                            probe), drop consumes the sequence number
+                            without sending (the standby must detect the
+                            gap and resync), torn_write sends half a
+                            frame then kills the connection, error fails
+                            the ship loop (subscriber re-subscribes)
+    repl.apply              warm-standby replication, STANDBY side:
+                            before each received frame applies —
+                            delay_ms stalls the apply loop (standby
+                            staleness), drop loses the frame pre-apply
+                            (the NEXT frame's sequence gap forces a
+                            resync), error/torn_write/corrupt poison the
+                            frame so the standby must resync off a fresh
+                            snapshot, never apply suspect bytes
 
 The injector is mutable at runtime (configure()/clear()) so chaos tests can
 clear faults mid-scenario — e.g. to watch a circuit breaker's half-open

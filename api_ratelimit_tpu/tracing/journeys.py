@@ -62,6 +62,10 @@ FLAG_SHED = "shed"
 FLAG_DEADLINE = "deadline"
 FLAG_FAULT = "fault"
 FLAG_OVER_LIMIT = "over_limit"
+# the request rode a device-owner failover: the sidecar client switched
+# to a standby address (backends/sidecar.py), or this request's write
+# promoted a standby (persist/replication.py) — always tail-worthy
+FLAG_FAILOVER = "failover"
 
 
 class Journey:

@@ -74,6 +74,19 @@ Artifact field guide (round 5 additions):
                                   the lease-off A/B arm
                                   (lease_overhead_pct; negative = the
                                   leased arm is faster)
+  failover_blip                   the warm-standby story (round 10),
+                                  measured: closed-loop load through a
+                                  primary+standby device-owner pair
+                                  (persist/replication.py), SIGKILL the
+                                  primary mid-run — failed (must be 0),
+                                  p99_failover_ms / blip_max_ms inside
+                                  the 1s failover window vs p99_steady_ms
+                                  before the kill, promotion confirmed
+                                  via the standby's epoch, plus the
+                                  replication-off A/B arm
+                                  (repl_overhead_pct: steady-state rate
+                                  with the delta stream on vs a lone
+                                  owner with no subscriber)
 """
 
 from __future__ import annotations
@@ -1812,6 +1825,300 @@ def bench_sidecar(
     return results
 
 
+# Device-owner child for the failover_blip tier: one sidecar-served slab
+# engine, optionally wrapped in a ReplicationCoordinator (role 'none' is
+# the replication-off A/B arm). Publishes {role, epoch, promotions,
+# frames_shipped} to <ctl>.stats on a 20ms cadence so the parent can
+# confirm the standby promoted; runs until the parent kills it.
+_REPL_OWNER_SRC = """\
+import json, os, sys, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+
+import numpy as np
+
+from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+sock, role, peer, ctl, interval_ms = (
+    sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4], float(sys.argv[5])
+)
+engine = SlabDeviceEngine(
+    RealTimeSource(),
+    n_slots=1 << 14,
+    use_pallas=False,
+    buckets=(128,),
+    batch_window_seconds=0.0005,
+    max_batch=4096,
+    block_mode=True,
+)
+# warm the device path BEFORE reporting ready: a standby must not pay its
+# first jit compile inside the measured failover window (promotion
+# replaces the slab with the reconciled replica, so the warm row never
+# survives into serving state)
+warm = np.array([[1], [0], [1], [1 << 30], [60], [0]], dtype=np.uint32)
+engine.submit_block(warm)
+coord = None
+if role != "none":
+    from api_ratelimit_tpu.persist.replication import ReplicationCoordinator
+
+    coord = ReplicationCoordinator(
+        engine,
+        role,
+        peer_address=(peer if peer != "-" else None),
+        interval_ms=interval_ms,
+    )
+server = SlabSidecarServer(sock, engine, repl=coord)
+if coord is not None:
+    coord.start()
+with open(ctl + ".ready", "w") as f:
+    f.write("ok")
+while True:
+    stats = {{"role": "none", "epoch": 0, "promotions": 0, "frames_shipped": 0}}
+    if coord is not None:
+        stats = {{
+            "role": coord.role,
+            "epoch": coord.epoch,
+            "promotions": coord.promotions_total,
+            "frames_shipped": coord.frames_shipped_total,
+        }}
+    with open(ctl + ".stats.tmp", "w") as f:
+        json.dump(stats, f)
+    os.replace(ctl + ".stats.tmp", ctl + ".stats")
+    time.sleep(0.02)
+"""
+
+
+def _spawn_repl_owner(sock: str, role: str, peer: str, ctl: str, interval_ms: float):
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            _REPL_OWNER_SRC.format(repo=repo),
+            sock,
+            role,
+            peer,
+            ctl,
+            str(interval_ms),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    deadline = time.monotonic() + 90
+    while not os.path.exists(ctl + ".ready"):
+        if proc.poll() is not None or time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError(f"device owner ({role}) never came up")
+        time.sleep(0.02)
+    return proc
+
+
+def _read_owner_stats(ctl: str) -> dict:
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            with open(ctl + ".stats") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.02)
+    return {}
+
+
+def _drive_closed_loop_until(service, reqs, n_threads: int, t_end: float):
+    """Closed-loop drive to a wall deadline, stamping each completion:
+    returns (samples [(monotonic_done, latency_ms)], errors). Unlike
+    _drive_service this is deadline- not count-based, so the mid-run
+    SIGKILL lands at a fixed wall offset regardless of box speed."""
+    samples: list[tuple[float, float]] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        my = reqs[tid::n_threads]
+        local: list[tuple[float, float]] = []
+        i = 0
+        while time.monotonic() < t_end:
+            r = my[i % len(my)]
+            i += 1
+            s = time.perf_counter()
+            try:
+                service.should_rate_limit(r)
+            except Exception as e:  # noqa: BLE001 - failed request IS the metric
+                with lock:
+                    errors.append(repr(e)[-200:])
+                continue
+            local.append((time.monotonic(), (time.perf_counter() - s) * 1e3))
+        with lock:
+            samples.extend(local)
+
+    with ThreadPoolExecutor(n_threads) as ex:
+        list(ex.map(worker, range(n_threads)))
+    return samples, errors
+
+
+def bench_failover_blip(on_tpu: bool, left=lambda: 1e9) -> dict:
+    """The warm-standby acceptance story with numbers attached
+    (persist/replication.py): closed-loop load through the full service
+    path against a primary+standby device-owner pair, SIGKILL the primary
+    mid-run, and report the p99 INSIDE the failover window next to the
+    steady-state p99 — plus the replication-off A/B arm (one lone owner,
+    no subscriber, no kill) for repl_overhead_pct: what the delta stream
+    costs the serving path (expected ~0: the ship loop diffs a detached
+    quiesce-and-copy export, never the launch pipeline)."""
+    import random
+    import signal
+    import tempfile
+
+    from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
+    from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+    from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+    from api_ratelimit_tpu.service.ratelimit import RateLimitService
+    from api_ratelimit_tpu.stats.sinks import NullSink
+    from api_ratelimit_tpu.stats.store import Store
+    from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+    interval_ms = 100.0
+    n_threads = 4
+    steady_s = 3.0  # pre-kill segment (the steady-state + repl-on rate)
+    blip_s = 1.0  # failover window the blip p99 is reported over
+    tail_s = 2.0  # post-window segment proving the promoted owner serves
+    result: dict = {"repl_interval_ms": interval_ms, "host_cpus": os.cpu_count()}
+    reqs = _requests_for("flat_per_second", 1024)
+
+    def build_service(addrs):
+        store = Store(NullSink())
+        base = BaseRateLimiter(
+            time_source=RealTimeSource(),
+            jitter_rand=random.Random(0),
+            expiration_jitter_max_seconds=0,
+        )
+        cache = TpuRateLimitCache(
+            base,
+            engine=SidecarEngineClient(
+                addrs,
+                pool_size=n_threads,
+                retries=6,
+                retry_backoff=0.02,
+                retry_backoff_max=0.2,
+                breaker_threshold=3,
+                breaker_reset=0.1,
+            ),
+        )
+        service = RateLimitService(
+            runtime=_StaticRuntime(_FLAT),
+            cache=cache,
+            stats_scope=store.scope("ratelimit").scope("service"),
+            time_source=RealTimeSource(),
+        )
+        for r in reqs[:16]:
+            service.should_rate_limit(r)
+        return service, cache
+
+    with tempfile.TemporaryDirectory() as td:
+        # --- A/B arm first (cheap, no kill): one lone owner, repl off ---
+        o_sock = os.path.join(td, "o.sock")
+        o_ctl = os.path.join(td, "o_ctl")
+        owner = _spawn_repl_owner(o_sock, "none", "-", o_ctl, interval_ms)
+        try:
+            service, cache = build_service([o_sock])
+            samples, errors = _drive_closed_loop_until(
+                service, reqs, n_threads, time.monotonic() + steady_s
+            )
+            cache.close()
+            if samples:
+                elapsed = max(t for t, _ in samples) - min(t for t, _ in samples)
+                result["rate_repl_off"] = round(len(samples) / max(elapsed, 1e-9))
+        finally:
+            owner.kill()
+            owner.wait()
+
+        if left() < 30:
+            result["failover"] = {"skipped": "budget"}
+            return result
+
+        # --- the main arm: primary + subscribed standby, SIGKILL mid-run ---
+        p_sock = os.path.join(td, "p.sock")
+        s_sock = os.path.join(td, "s.sock")
+        p_ctl = os.path.join(td, "p_ctl")
+        s_ctl = os.path.join(td, "s_ctl")
+        primary = _spawn_repl_owner(p_sock, "primary", "-", p_ctl, interval_ms)
+        standby = None
+        try:
+            standby = _spawn_repl_owner(
+                s_sock, "standby", p_sock, s_ctl, interval_ms
+            )
+            service, cache = build_service([p_sock, s_sock])
+            t_kill_at = time.monotonic() + steady_s
+            t_kill = [0.0]
+
+            def killer():
+                time.sleep(max(0.0, t_kill_at - time.monotonic()))
+                t_kill[0] = time.monotonic()
+                os.kill(primary.pid, signal.SIGKILL)
+
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+            samples, errors = _drive_closed_loop_until(
+                service,
+                reqs,
+                n_threads,
+                t_kill_at + blip_s + tail_s,
+            )
+            kt.join(timeout=10)
+            cache.close()
+
+            lat = np.array([l for _, l in samples])
+            stamps = np.array([t for t, _ in samples])
+            kill = t_kill[0]
+            steady = lat[stamps < kill]
+            blip = lat[(stamps >= kill) & (stamps < kill + blip_s)]
+            after = lat[stamps >= kill + blip_s]
+            result["failed"] = len(errors)
+            if errors:
+                result["errors"] = errors[:4]
+            result["n"] = int(len(samples))
+            if steady.size:
+                steady_elapsed = float(steady.size) / max(
+                    kill - stamps.min(), 1e-9
+                )
+                result["rate_repl_on"] = round(steady_elapsed)
+                result["p99_steady_ms"] = round(
+                    float(np.percentile(steady, 99)), 3
+                )
+                if result.get("rate_repl_off"):
+                    result["repl_overhead_pct"] = round(
+                        100.0
+                        * (result["rate_repl_off"] - result["rate_repl_on"])
+                        / result["rate_repl_off"],
+                        2,
+                    )
+            if blip.size:
+                result["p99_failover_ms"] = round(
+                    float(np.percentile(blip, 99)), 3
+                )
+                result["blip_max_ms"] = round(float(blip.max()), 3)
+            if after.size:
+                result["p99_after_ms"] = round(
+                    float(np.percentile(after, 99)), 3
+                )
+            s_stats = _read_owner_stats(s_ctl)
+            result["standby_promoted"] = bool(s_stats.get("promotions"))
+            result["epoch_after"] = int(s_stats.get("epoch", 0))
+        finally:
+            for proc in (primary, standby):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+    return result
+
+
 def _sharded_in_subprocess(n_mesh: int) -> dict:
     """Run the sharded engine bench on a virtual CPU mesh in a subprocess so
     the forced device split never touches this process's backend (the
@@ -2099,6 +2406,18 @@ def main() -> None:
             bench_sidecar(on_tpu, left, sidecar_results, emit)
         except Exception as e:
             sidecar_results["error"] = str(e)[-300:]
+    emit()
+
+    # warm-standby failover (round 10): SIGKILL the primary device owner
+    # under closed-loop load, report the blip p99 + the replication-off
+    # A/B arm — the availability claim stays a measurement, not a promise
+    if left() < 60:
+        configs["failover_blip"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["failover_blip"] = bench_failover_blip(on_tpu, left)
+        except Exception as e:
+            configs["failover_blip"] = {"error": str(e)[-300:]}
     emit()
 
     # engine comparison rows (kernel twin, after-mode), deferred from the

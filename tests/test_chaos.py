@@ -911,6 +911,439 @@ class TestLeaseFailureLadder:
         assert snap["ratelimit.lease.fallback_hits"] == 1
 
 
+# ---------------------------------------------------------------------------
+# Warm-standby replication chaos (persist/replication.py): each injectable
+# failure — replication lag, a partitioned standby, a corrupt delta frame —
+# exercised through live traffic, then the SIGKILL acceptance scenario.
+# ---------------------------------------------------------------------------
+
+
+class TestReplicationChaos:
+    def _cluster(self, tmp_path, interval_ms=20.0, faults_p=None, faults_s=None):
+        from api_ratelimit_tpu.persist.replication import (
+            ReplicationCoordinator,
+        )
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        def make_engine():
+            return SlabDeviceEngine(
+                time_source=RealTimeSource(),
+                n_slots=1 << 10,
+                buckets=(128,),
+                max_batch=1024,
+                use_pallas=False,
+                block_mode=True,
+            )
+
+        p_sock = str(tmp_path / "p.sock")
+        s_sock = str(tmp_path / "s.sock")
+        p_engine = make_engine()
+        p_coord = ReplicationCoordinator(
+            p_engine, "primary", interval_ms=interval_ms, fault_injector=faults_p
+        )
+        p_server = SlabSidecarServer(p_sock, p_engine, repl=p_coord)
+        p_coord.start()
+        s_engine = make_engine()
+        s_coord = ReplicationCoordinator(
+            s_engine,
+            "standby",
+            peer_address=p_sock,
+            interval_ms=interval_ms,
+            fault_injector=faults_s,
+        )
+        s_server = SlabSidecarServer(s_sock, s_engine, repl=s_coord)
+        s_coord.start()
+        return p_sock, s_sock, p_server, p_coord, s_server, s_coord
+
+    def test_replication_lag_raises_degraded_while_serving(self, tmp_path):
+        """repl.ship delay_ms (a slow/partitioned link): the primary's
+        repl.degraded probe fires while client traffic keeps flowing
+        un-degraded — replication is never on the serving path."""
+        from api_ratelimit_tpu.testing.faults import FaultInjector
+
+        faults = FaultInjector(
+            parse_fault_spec("repl.ship:delay_ms:500"), seed=1
+        )
+        p_sock, s_sock, p_srv, p_coord, s_srv, s_coord = self._cluster(
+            tmp_path, interval_ms=20.0, faults_p=faults
+        )
+        client = SidecarEngineClient(
+            [p_sock, s_sock], retries=2, breaker_threshold=0
+        )
+        try:
+            for _ in range(10):
+                client.submit(_item())  # serving is unaffected
+            time.sleep(0.2)
+            reason = p_coord.degraded_reason()
+            assert reason is not None and "repl.degraded" in reason
+        finally:
+            faults.clear()
+            client.close()
+            p_srv.close()
+            p_coord.close()
+            s_srv.close()
+            s_coord.close()
+
+    def test_partitioned_standby_resyncs_when_the_link_heals(self, tmp_path):
+        """repl.ship drop (a partition that eats frames): sequence gaps
+        force full resyncs, and once the partition heals the standby
+        converges on the primary's true counters."""
+        from api_ratelimit_tpu.testing.faults import FaultInjector
+
+        faults = FaultInjector(parse_fault_spec("repl.ship:drop:0.4"), seed=5)
+        p_sock, s_sock, p_srv, p_coord, s_srv, s_coord = self._cluster(
+            tmp_path, interval_ms=15.0, faults_p=faults
+        )
+        client = SidecarEngineClient(
+            [p_sock, s_sock], retries=2, breaker_threshold=0
+        )
+        try:
+            for _ in range(15):
+                client.submit(_item(fp=77))
+            deadline = time.monotonic() + 10.0
+            while s_coord.resyncs_total < 1:
+                assert time.monotonic() < deadline, "gap never forced a resync"
+                time.sleep(0.01)
+            faults.clear()  # partition heals
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                tables, _, _ = s_coord.replica_state()
+                if tables is not None:
+                    rows = tables[0]
+                    hit = rows[rows[:, 0] == 77]
+                    if hit.shape[0] and int(hit[0, 2]) == 15:
+                        break
+                time.sleep(0.02)
+            else:
+                pytest.fail("standby never converged after the partition")
+        finally:
+            client.close()
+            p_srv.close()
+            p_coord.close()
+            s_srv.close()
+            s_coord.close()
+
+    def test_corrupt_delta_frame_forces_resync_never_divergence(self, tmp_path):
+        """repl.apply torn_write (a corrupt frame): the standby must
+        refuse to apply it, resync, and land on the true counter — a
+        corrupt delta can delay convergence but never skew it."""
+        from api_ratelimit_tpu.testing.faults import FaultInjector
+
+        class _OneShot(FaultInjector):
+            def __init__(self):
+                super().__init__(
+                    parse_fault_spec("repl.apply:torn_write:1.0")
+                )
+                self.shots = 2
+
+            def fire(self, site):
+                if self.shots <= 0:
+                    return None
+                action = super().fire(site)
+                if action is not None:
+                    self.shots -= 1
+                return action
+
+        faults = _OneShot()
+        p_sock, s_sock, p_srv, p_coord, s_srv, s_coord = self._cluster(
+            tmp_path, interval_ms=15.0, faults_s=faults
+        )
+        client = SidecarEngineClient(
+            [p_sock, s_sock], retries=2, breaker_threshold=0
+        )
+        try:
+            for _ in range(9):
+                client.submit(_item(fp=88))
+            deadline = time.monotonic() + 10.0
+            while s_coord.resyncs_total < 1:
+                assert time.monotonic() < deadline, "corruption never resynced"
+                time.sleep(0.01)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                tables, _, _ = s_coord.replica_state()
+                if tables is not None:
+                    rows = tables[0]
+                    hit = rows[rows[:, 0] == 88]
+                    if hit.shape[0] and int(hit[0, 2]) == 9:
+                        break
+                time.sleep(0.02)
+            else:
+                pytest.fail("standby never converged after corruption")
+        finally:
+            client.close()
+            p_srv.close()
+            p_coord.close()
+            s_srv.close()
+            s_coord.close()
+
+
+_REPL_OWNER_CHILD = """\
+import json, os, sys, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+
+from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+from api_ratelimit_tpu.persist.replication import ReplicationCoordinator
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+sock, role, peer, ctl, interval_ms = (
+    sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4], float(sys.argv[5])
+)
+engine = SlabDeviceEngine(
+    RealTimeSource(),
+    n_slots=1 << 12,
+    use_pallas=False,
+    buckets=(128,),
+    block_mode=True,
+)
+coord = ReplicationCoordinator(
+    engine,
+    role,
+    peer_address=(peer if peer != "-" else None),
+    interval_ms=interval_ms,
+)
+server = SlabSidecarServer(sock, engine, repl=coord)
+coord.start()
+with open(ctl + ".ready", "w") as f:
+    f.write("ok")
+while True:  # runs until SIGKILLed / SIGTERMed by the parent
+    with open(ctl + ".stats.tmp", "w") as f:
+        json.dump(
+            {{
+                "role": coord.role,
+                "epoch": coord.epoch,
+                "stale_epoch_rejected": coord.stale_epoch_rejected_total,
+                "frames_shipped": coord.frames_shipped_total,
+                "frames_applied": coord.frames_applied_total,
+                "promotions": coord.promotions_total,
+            }},
+            f,
+        )
+    os.replace(ctl + ".stats.tmp", ctl + ".stats")
+    time.sleep(0.02)
+"""
+
+
+class TestSigkillFailoverAcceptance:
+    """The acceptance scenario: SIGKILL the primary device-owner
+    SUBPROCESS under closed-loop load with a live standby. Zero failed
+    requests (the client rides retries + failover while the standby
+    promotes), counter overshoot bounded by one REPL_INTERVAL_MS of
+    admitted traffic (differential vs the exact oracle), and a
+    resurrected stale primary's write is rejected with a pinned
+    stale_epoch_rejected count."""
+
+    INTERVAL_MS = 50.0
+
+    def _spawn(self, sock, role, peer, ctl):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                _REPL_OWNER_CHILD.format(repo=repo),
+                sock,
+                role,
+                peer,
+                ctl,
+                str(self.INTERVAL_MS),
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    @staticmethod
+    def _wait_ready(ctl, timeout=60.0):
+        import os
+
+        deadline = time.time() + timeout
+        while not os.path.exists(ctl + ".ready"):
+            assert time.time() < deadline, "device owner never came up"
+            time.sleep(0.05)
+        os.unlink(ctl + ".ready")
+
+    @staticmethod
+    def _child_stats(ctl, timeout=30.0):
+        import json as json_mod
+        import os
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with open(ctl + ".stats") as f:
+                    return json_mod.load(f)
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        raise AssertionError("child never published stats")
+
+    def test_kill9_primary_under_closed_loop_load(self, tmp_path):
+        import os
+        import random
+        import signal
+        import struct as struct_mod
+
+        import numpy as np
+
+        from api_ratelimit_tpu.backends.sidecar import (
+            FLAG_EPOCH,
+            MAGIC,
+            OP_SUBMIT,
+            STATUS_STALE_EPOCH,
+            VERSION,
+            SidecarEngineClient,
+            _HDR,
+            _recv_exact,
+            encode_items,
+        )
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+        from api_ratelimit_tpu.testing.oracle import occurrence_rank
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        p_sock = str(tmp_path / "p.sock")
+        s_sock = str(tmp_path / "s.sock")
+        p_ctl = str(tmp_path / "p_ctl")
+        s_ctl = str(tmp_path / "s_ctl")
+
+        primary = self._spawn(p_sock, "primary", "-", p_ctl)
+        standby = None
+        try:
+            self._wait_ready(p_ctl)
+            standby = self._spawn(s_sock, "standby", p_sock, s_ctl)
+            self._wait_ready(s_ctl)
+
+            # hour window: no window roll mid-test; limit 50 so the run
+            # crosses it and the oracle comparison bites
+            yaml_text = (
+                "domain: chaos\n"
+                "descriptors:\n"
+                "  - key: k\n"
+                "    rate_limit: {unit: hour, requests_per_unit: 50}\n"
+            )
+            from api_ratelimit_tpu.stats import Store, TestSink
+
+            store = Store(TestSink())
+            base = BaseRateLimiter(
+                RealTimeSource(),
+                jitter_rand=random.Random(0),
+                expiration_jitter_max_seconds=0,
+            )
+            client = SidecarEngineClient(
+                [p_sock, s_sock],
+                retries=6,
+                retry_backoff=0.02,
+                retry_backoff_max=0.2,
+                breaker_threshold=3,
+                breaker_reset=0.1,
+            )
+            cache = TpuRateLimitCache(base, engine=client)
+            svc = RateLimitService(
+                runtime=_FakeRuntime({"config.chaos": yaml_text}),
+                cache=cache,
+                stats_scope=store.scope("ratelimit").scope("service"),
+                time_source=RealTimeSource(),
+            )
+
+            errors: list[Exception] = []
+            admits: list[float] = []  # monotonic stamp per admitted req
+            total = [0]
+
+            def drive(n):
+                for _ in range(n):
+                    total[0] += 1
+                    try:
+                        code, _, _ = svc.should_rate_limit(
+                            _lease_req("hot")
+                        )
+                    except Exception as e:  # noqa: BLE001 - the assert
+                        errors.append(e)
+                    else:
+                        if code == Code.OK:
+                            admits.append(time.monotonic())
+                    time.sleep(0.002)  # ~500/s closed loop
+
+            drive(30)
+            # let at least two replication intervals ship
+            time.sleep(3.0 * self.INTERVAL_MS / 1e3)
+            p_stats = self._child_stats(p_ctl)
+            assert p_stats["frames_shipped"] >= 2
+
+            t_kill = time.monotonic()
+            os.kill(primary.pid, signal.SIGKILL)
+            primary.wait(timeout=10)
+
+            drive(60)  # rides failover + promotion
+
+            # 1) zero failed requests through the crash
+            assert errors == [], errors[:3]
+
+            # 2) the standby promoted
+            s_stats = self._child_stats(s_ctl)
+            assert s_stats["role"] == "primary"
+            assert s_stats["promotions"] == 1
+            assert s_stats["epoch"] >= 2
+
+            # 3) overshoot vs the exact oracle bounded by one replication
+            # interval of admitted traffic (+ scheduling slack; no leases
+            # in this run, so the lease term is 0)
+            ids = np.zeros(total[0], dtype=np.int64)
+            oracle_admitted = int(np.sum(occurrence_rank(ids) + 1 <= 50))
+            overshoot = len(admits) - oracle_admitted
+            window_s = 3.0 * self.INTERVAL_MS / 1e3  # interval + slack
+            lost_window = sum(
+                1 for t in admits if t_kill - window_s < t <= t_kill
+            )
+            assert overshoot <= lost_window + 2, (
+                f"overshoot {overshoot} exceeds one replication interval "
+                f"of admitted traffic ({lost_window})"
+            )
+
+            # 4) the split-brain guard: resurrect the old primary fresh
+            # (epoch 1) and fence a write on the promoted epoch
+            primary = self._spawn(p_sock, "primary", "-", p_ctl)
+            self._wait_ready(p_ctl)
+            conn = __import__("socket").socket(
+                __import__("socket").AF_UNIX,
+                __import__("socket").SOCK_STREAM,
+            )
+            conn.connect(p_sock)
+            from api_ratelimit_tpu.backends.tpu import _Item
+
+            payload = encode_items(
+                [_Item(fp=7, hits=1, limit=50, divider=3600, jitter=0)]
+            )
+            conn.sendall(
+                _HDR.pack(MAGIC, VERSION, OP_SUBMIT, FLAG_EPOCH)
+                + payload
+                + struct_mod.pack("<I", client._epoch_known)
+            )
+            assert _recv_exact(conn, 1) == bytes([STATUS_STALE_EPOCH])
+            conn.close()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if self._child_stats(p_ctl)["stale_epoch_rejected"] > 0:
+                    break
+                time.sleep(0.05)
+            assert self._child_stats(p_ctl)["stale_epoch_rejected"] > 0
+
+            client.close()
+            cache.close()
+        finally:
+            for proc in (primary, standby):
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except Exception:
+                        proc.kill()
+
+
 _LEASE_OWNER_CHILD = """\
 import os, sys, time
 

@@ -871,3 +871,219 @@ class TestDispatchLoopArm:
             assert engine.lease_registry.outstanding()[0] == 1
         finally:
             cache.close()
+
+
+# ---------------------------------------------------------------------------
+# Leases x warm-standby failover (persist/replication.py): grants made by
+# the old primary stay locally servable through a promotion, liabilities
+# replicate so the promoted standby's floors prevent double-granting,
+# settles land against the new epoch, and lease.degraded clears once the
+# standby is serving.
+# ---------------------------------------------------------------------------
+
+FAILOVER_YAML = """\
+domain: lease
+descriptors:
+  - key: api_key
+    rate_limit: {unit: hour, requests_per_unit: 50}
+"""
+
+
+class TestLeaseAcrossFailover:
+    INTERVAL_MS = 20.0
+
+    def _owner(self, sock, role, peer=None, start_server=True):
+        from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+        from api_ratelimit_tpu.persist.replication import (
+            ReplicationCoordinator,
+        )
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        engine = SlabDeviceEngine(
+            time_source=RealTimeSource(),
+            n_slots=1 << 10,
+            use_pallas=False,
+            buckets=(128,),
+            block_mode=True,
+        )
+        coord = ReplicationCoordinator(
+            engine,
+            role,
+            peer_address=peer,
+            interval_ms=self.INTERVAL_MS,
+        )
+        server = (
+            SlabSidecarServer(sock, engine, repl=coord)
+            if start_server
+            else None
+        )
+        coord.start()
+        return engine, coord, server
+
+    def _frontend(self, addrs, **client_kw):
+        import time as time_mod
+
+        from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
+        from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+        client_kw.setdefault("retries", 2)
+        client_kw.setdefault("retry_backoff", 0.002)
+        client_kw.setdefault("retry_backoff_max", 0.02)
+        client_kw.setdefault("breaker_threshold", 2)
+        client_kw.setdefault("breaker_reset", 0.05)
+        client = SidecarEngineClient(addrs, **client_kw)
+        store = Store(TestSink())
+        base = BaseRateLimiter(
+            time_source=RealTimeSource(),
+            jitter_rand=random.Random(0),
+            expiration_jitter_max_seconds=0,
+        )
+        table = LeaseTable(
+            base,
+            min_size=4,
+            max_size=16,
+            scope=store.scope("ratelimit").scope("lease"),
+        )
+        cache = TpuRateLimitCache(base, engine=client, lease_table=table)
+        svc = RateLimitService(
+            runtime=_StaticRuntime(FAILOVER_YAML),
+            cache=cache,
+            stats_scope=store.scope("ratelimit").scope("service"),
+            time_source=RealTimeSource(),
+            lease=table,
+        )
+        return svc, cache, client, table, store, time_mod
+
+    @staticmethod
+    def _wait(cond, timeout=10.0, what="condition"):
+        import time as time_mod
+
+        deadline = time_mod.monotonic() + timeout
+        while not cond():
+            assert time_mod.monotonic() < deadline, f"timed out: {what}"
+            time_mod.sleep(0.01)
+
+    def test_leases_survive_promotion_with_replicated_floors(self, tmp_path):
+        """Grants from the old primary keep answering locally through the
+        crash; the promoted standby's replicated liability floors mean
+        total admitted NEVER exceeds the limit (no double-grant), and
+        settles land in the NEW primary's registry."""
+        p_sock = str(tmp_path / "p.sock")
+        s_sock = str(tmp_path / "s.sock")
+        p_engine, p_coord, p_server = self._owner(p_sock, "primary")
+        s_engine, s_coord, s_server = self._owner(
+            s_sock, "standby", peer=p_sock
+        )
+        svc, cache, client, table, store, time_mod = self._frontend(
+            [p_sock, s_sock]
+        )
+        errors: list[Exception] = []
+        admitted = [0]
+
+        def drive(n):
+            for _ in range(n):
+                try:
+                    code, _, _ = svc.should_rate_limit(_req())
+                except Exception as e:  # noqa: BLE001 - asserted empty
+                    errors.append(e)
+                else:
+                    if code == Code.OK:
+                        admitted[0] += 1
+
+        try:
+            drive(20)
+            held, outstanding = table.outstanding()
+            assert held == 1 and outstanding > 0
+            # quiesce until the liability AND the slab have replicated
+            self._wait(
+                lambda: s_coord.replica_state()[1].shape[0] >= 1,
+                what="liability replication",
+            )
+            time_mod.sleep(3.0 * self.INTERVAL_MS / 1e3)
+
+            p_server.close()
+            p_coord.close()
+
+            # the outstanding lease answers locally with the owner DEAD
+            budget = outstanding
+            before_local = admitted[0]
+            drive(min(budget, 4))
+            assert errors == []
+            assert admitted[0] == before_local + min(budget, 4)
+
+            # past the budget: renewal fails over, the standby promotes
+            # with the replicated floors, traffic continues
+            drive(60)
+            assert errors == [], errors[:3]
+            assert s_coord.role == "primary"
+            assert s_coord.promotions_total == 1
+
+            # never over-admit: floors make the failover invisible to the
+            # limit (50/hour; 80 requests sent; burn only under-admits)
+            assert admitted[0] <= 50
+            assert admitted[0] >= 45  # and burn stays small
+
+            # settles land against the new epoch's registry
+            self._wait(
+                lambda: s_engine.lease_registry.settles_total > 0,
+                what="settles on the new primary",
+            )
+        finally:
+            client.close()
+            for closer in (s_server.close, s_coord.close):
+                closer()
+
+    def test_lease_degraded_clears_once_standby_serves(self, tmp_path):
+        """The sticky lease.degraded probe: raised while BOTH owners are
+        unreachable and the frontend serves from outstanding leases,
+        cleared by the first successful device interaction after the
+        standby comes up and promotes."""
+        from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+
+        p_sock = str(tmp_path / "p.sock")
+        s_sock = str(tmp_path / "s.sock")
+        p_engine, p_coord, p_server = self._owner(p_sock, "primary")
+        # the standby COORDINATOR subscribes, but its server is not up
+        # yet — so after the primary dies there is nowhere to fail over
+        s_engine, s_coord, _ = self._owner(
+            s_sock, "standby", peer=p_sock, start_server=False
+        )
+        svc, cache, client, table, store, time_mod = self._frontend(
+            [p_sock, s_sock], retries=0, breaker_threshold=0
+        )
+        try:
+            assert svc.should_rate_limit(_req())[0] == Code.OK  # grant
+            self._wait(
+                lambda: s_coord.replica_state()[0] is not None,
+                what="standby sync",
+            )
+            p_server.close()
+            p_coord.close()
+
+            # budget answers locally; exhausting it needs the device ->
+            # CacheError (no fallback configured) + sticky lease.degraded
+            saw_error = False
+            for _ in range(12):
+                try:
+                    svc.should_rate_limit(_req())
+                except Exception:  # noqa: BLE001 - expected while dark
+                    saw_error = True
+                    break
+            assert saw_error
+            assert table.degraded
+            assert "lease.degraded" in table.degraded_reason()
+
+            # the standby's server comes up; the next device write fails
+            # over, promotes it, succeeds — and the probe clears
+            s_server = SlabSidecarServer(s_sock, s_engine, repl=s_coord)
+            try:
+                code, _, _ = svc.should_rate_limit(_req())
+                assert code in (Code.OK, Code.OVER_LIMIT)
+                assert s_coord.role == "primary"
+                assert not table.degraded
+                assert table.degraded_reason() is None
+            finally:
+                s_server.close()
+        finally:
+            client.close()
+            s_coord.close()

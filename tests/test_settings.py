@@ -369,3 +369,84 @@ class TestLeaseSettings:
             new_settings(
                 {"LEASE_NEAR_LIMIT_RATIO": "-0.1"}
             ).lease_config()
+
+
+class TestReplicationSettings:
+    """SIDECAR_ADDRS / REPL_* knobs (persist/replication.py), following
+    the lease_config() junk-rejection pattern: a typo'd knob fails the
+    boot, never silently becomes a different redundancy posture."""
+
+    def test_defaults_disable_replication(self):
+        s = new_settings({})
+        assert s.repl_config() == ("", 100.0, 500.0)
+        assert s.sidecar_addresses() == [s.sidecar_socket]
+        assert s.repl_peer_address() is None
+
+    def test_addrs_parse_and_order_preserved(self):
+        s = new_settings(
+            {"SIDECAR_ADDRS": " /a.sock , tcp://h:9000 ,tls://x:1 "}
+        )
+        assert s.sidecar_addresses() == [
+            "/a.sock",
+            "tcp://h:9000",
+            "tls://x:1",
+        ]
+
+    def test_peer_is_first_entry_that_is_not_self(self):
+        s = new_settings(
+            {
+                "SIDECAR_SOCKET": "/b.sock",
+                "SIDECAR_ADDRS": "/a.sock,/b.sock",
+            }
+        )
+        assert s.repl_peer_address() == "/a.sock"
+
+    def test_roles_accepted(self):
+        for role in ("primary", "standby", "auto"):
+            s = new_settings(
+                {
+                    "REPL_ROLE": role,
+                    "SIDECAR_SOCKET": "/me.sock",
+                    "SIDECAR_ADDRS": "/me.sock,/peer.sock",
+                }
+            )
+            assert s.repl_config()[0] == role
+
+    def test_junk_role_fails_boot(self):
+        s = new_settings({"REPL_ROLE": "leader"})
+        with pytest.raises(ValueError, match="REPL_ROLE"):
+            s.repl_config()
+
+    def test_junk_interval_fails_boot(self):
+        s = new_settings({"REPL_INTERVAL_MS": "0"})
+        with pytest.raises(ValueError, match="REPL_INTERVAL_MS"):
+            s.repl_config()
+        with pytest.raises(ValueError, match="REPL_INTERVAL_MS"):
+            new_settings({"REPL_INTERVAL_MS": "soon"})
+
+    def test_max_lag_below_interval_fails_boot(self):
+        s = new_settings(
+            {"REPL_INTERVAL_MS": "100", "REPL_MAX_LAG_MS": "50"}
+        )
+        with pytest.raises(ValueError, match="REPL_MAX_LAG_MS"):
+            s.repl_config()
+
+    def test_max_lag_defaults_to_five_intervals(self):
+        s = new_settings({"REPL_INTERVAL_MS": "40"})
+        assert s.repl_config() == ("", 40.0, 200.0)
+
+    def test_standby_without_peer_fails_boot(self):
+        s = new_settings(
+            {
+                "REPL_ROLE": "standby",
+                "SIDECAR_SOCKET": "/me.sock",
+                "SIDECAR_ADDRS": "/me.sock",
+            }
+        )
+        with pytest.raises(ValueError, match="peer"):
+            s.repl_config()
+
+    def test_malformed_addr_entry_fails_boot(self):
+        s = new_settings({"SIDECAR_ADDRS": "tcp://nohost"})
+        with pytest.raises(ValueError, match="SIDECAR_ADDRS"):
+            s.sidecar_addresses()
