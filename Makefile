@@ -8,8 +8,8 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_artifact \
-        tests_chaos tests_integration tests_with_redis tests_tpu bench \
-        profile serve check_config clean docker_image docker_tests
+        tests_chaos tests_integration tests_mp tests_with_redis tests_tpu \
+        bench profile serve check_config clean docker_image docker_tests
 
 all: compile
 
@@ -43,6 +43,15 @@ tests_unit: native
 # from tests_unit so a wall-clock hiccup can't -x-fail the whole stage.
 tests_artifact:
 	$(PY) -m pytest tests/ -q -m slow
+
+# Multi-process frontend tier (shm submit rings + the FRONTEND_PROCS
+# fleet; backends/shm_ring.py, cmd/service_cmd.py): real frontend
+# PROCESSES publishing into one device owner over shared memory,
+# including the SIGKILL-mid-publish chaos story and the full
+# service_cmd fleet boot. Slower than tests_unit (it boots worker
+# interpreters), so it gets its own CI entry point.
+tests_mp: native
+	$(PY) -m pytest tests/ -v -m mp
 
 # Failure-injection + failover chaos tier: the degradation ladder, the
 # warm-standby replication suite, and the SIGKILL-the-primary acceptance

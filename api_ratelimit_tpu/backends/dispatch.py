@@ -140,7 +140,7 @@ class SubmitRing:
     __slots__ = (
         "slots", "mask", "arena", "ctx", "cursor", "tail", "head",
         "rows_in", "rows_out", "items_in", "items_out", "lock",
-        "closed", "ticket",
+        "closed", "ticket", "overflow_count", "arena_hwm", "dead",
     )
 
     def __init__(self, slots: int = 128, arena_rows: int = 4096):
@@ -149,6 +149,17 @@ class SubmitRing:
         self.slots: list = [None] * slots
         self.mask = slots - 1
         self.arena = np.empty((6, arena_rows), dtype=np.uint32)
+        # arena pressure telemetry (producer-only writes): owned-copy
+        # fallbacks under sustained backlog used to be silent — they are
+        # one allocation per frame exactly when the system is busiest.
+        # DispatchStats aggregates these into ratelimit.dispatch.
+        # arena_overflow (counter) and ring.arena_hwm (gauge).
+        self.overflow_count = 0
+        self.arena_hwm = 0
+        # shm parity: the owner loop skips rings whose producer process
+        # died (ShmRingConsumer flips this on control-socket EOF);
+        # in-process rings never die independently of the loop
+        self.dead = False
         # trace-context sidecar, one fixed-width row per slot (trace_id
         # hi/lo, span_id, flags) — published with the frame under the same
         # seqno discipline, so span identity rides the ring next to the
@@ -201,9 +212,15 @@ class SubmitRing:
                 self.cursor = cursor + count
                 arena_used = waste + count
                 self.rows_in += arena_used
+                used_rows = self.rows_in - self.rows_out
+                if used_rows > self.arena_hwm:
+                    self.arena_hwm = used_rows
             else:
                 # arena exhausted under sustained backlog: decouple from
-                # the caller's scratch with an owned copy
+                # the caller's scratch with an owned copy — counted, so
+                # the silent-allocation regime is visible in /metrics
+                # (ratelimit.dispatch.arena_overflow)
+                self.overflow_count += 1
                 rows = np.array(block[:, :count], dtype=np.uint32)
         idx = tail & self.mask
         if ctx is not None:
@@ -224,18 +241,33 @@ class DispatchStats:
     """StatGenerator exporting the loop's instantaneous backlog at every
     stats flush / metrics scrape:
 
-        <scope>.queue_depth   items published to rings awaiting a take
-        <scope>.inflight      launches not yet redeemed
+        <scope>.queue_depth     items published to rings awaiting a take
+        <scope>.inflight        launches not yet redeemed
+        <scope>.arena_overflow  frames that missed the ring arena (owned
+                                copy on in-process rings; QueueFullError
+                                shed on shm rings) — the silent-backlog
+                                signal
+        <scope>.ring.arena_hwm  high-water mark of arena rows in use
+                                across every ring (how close the arenas
+                                run to the overflow regime)
     """
 
     def __init__(self, loop: "DispatchLoop", scope):
         self._loop = loop
         self._queue_depth = scope.gauge("queue_depth")
         self._inflight = scope.gauge("inflight")
+        self._arena_overflow = scope.counter("arena_overflow")
+        self._arena_hwm = scope.gauge("ring.arena_hwm")
+        self._overflow_seen = 0
 
     def generate_stats(self) -> None:
         self._queue_depth.set(self._loop.queue_depth)
         self._inflight.set(self._loop.inflight)
+        overflow, hwm = self._loop.arena_pressure()
+        if overflow > self._overflow_seen:
+            self._arena_overflow.add(overflow - self._overflow_seen)
+            self._overflow_seen = overflow
+        self._arena_hwm.set(hwm)
 
 
 class DispatchLoop:
@@ -281,6 +313,16 @@ class DispatchLoop:
         self._ring_rows = int(ring_rows)
         self._rings: list[SubmitRing] = []
         self._rings_lock = threading.Lock()  # ring registration only
+        # cross-process rings (backends/shm_ring.py ShmRingConsumer):
+        # attached by the control server, drained by the SAME _take the
+        # in-process rings ride; listed separately only for the doorbell
+        # protocol
+        self._ext_rings: list = []
+        self._detach_pending: list = []
+        # dead shm rings whose mapping couldn't close yet (frames of
+        # theirs still riding an in-flight batch); retried as batches
+        # drain and once more at loop close
+        self._ring_graveyard: list = []
         self._tls = threading.local()
         self._work = threading.Event()
         self._idle = threading.Event()
@@ -346,6 +388,54 @@ class DispatchLoop:
                 self._rings.append(ring)
             self._tls.ring = ring
         return ring
+
+    # -- cross-process rings (backends/shm_ring.py) --
+
+    def kick(self) -> None:
+        """Doorbell from the shm control server: a frontend process
+        published into a ring while the owner was parked."""
+        self._idle.clear()
+        self._work.set()
+
+    def attach_ring(self, ring) -> None:
+        """Register an external (shm consumer) ring with the drain loop.
+        The ring must speak the SubmitRing slot protocol; the owner
+        thread starts taking its frames on the next cycle."""
+        with self._rings_lock:
+            if self._closed:
+                with ring.lock:
+                    ring.closed = True
+                raise CacheError("dispatch loop is closed")
+            self._rings.append(ring)
+            self._ext_rings.append(ring)
+        self._work.set()
+
+    def detach_rings(self, rings) -> None:
+        """Mark external rings dead (their producer process is gone) and
+        hand them to the owner thread for removal: pending frames are
+        dropped — nobody is parked on them — their segments unlinked,
+        and every other ring's traffic continues untouched."""
+        for ring in rings:
+            ring.dead = True
+        with self._rings_lock:
+            self._detach_pending.extend(rings)
+        self._work.set()
+        if not self._thread.is_alive():
+            # owner already exited (shutdown ordering): nobody will run
+            # the loop-side removal, so do it here — single-threaded now
+            self._process_detach()
+
+    def arena_pressure(self) -> tuple[int, int]:
+        """(total overflow count, max arena rows high-water) across every
+        live ring — racy reads, stats cadence only."""
+        overflow = 0
+        hwm = 0
+        for ring in self._rings:
+            overflow += ring.overflow_count
+            h = ring.arena_hwm
+            if h > hwm:
+                hwm = h
+        return overflow, hwm
 
     def submit(
         self,
@@ -461,6 +551,23 @@ class DispatchLoop:
         self._close_rings()
         self._work.set()
         self._thread.join(timeout=5.0)
+        if self._detach_pending:
+            self._process_detach()
+        # shm teardown: unlink any still-attached external segments (the
+        # closed flag in each header tells their producers to stop) and
+        # drain the graveyard of mappings that were pinned by in-flight
+        # batches — all best-effort, the process is going away
+        with self._rings_lock:
+            ext, self._ext_rings = self._ext_rings, []
+            self._rings = [r for r in self._rings if r not in ext]
+        for ring in ext:
+            ring.dead = True
+            release = getattr(ring, "release", None)
+            if release is not None and not release():
+                self._ring_graveyard.append(ring)
+        self._ring_graveyard = [
+            r for r in self._ring_graveyard if not r.release()
+        ]
 
     def _close_rings(self) -> None:
         with self._rings_lock:
@@ -505,9 +612,60 @@ class DispatchLoop:
             ring.head = head
         self._idle.set()
 
+    def _process_detach(self) -> None:
+        """Owner thread: remove rings whose producer process died (the
+        control connection's EOF). Untaken frames are dropped with the
+        ring — their producers are gone, and the seqno discipline already
+        hid any torn frame — and the segment name is unlinked; the
+        owner's mapping stays alive until process exit because frames
+        already taken may still hold arena views in an in-flight batch."""
+        with self._rings_lock:
+            pending, self._detach_pending = self._detach_pending, []
+            for ring in pending:
+                if ring in self._rings:
+                    self._rings.remove(ring)
+                if ring in self._ext_rings:
+                    self._ext_rings.remove(ring)
+        for ring in pending:
+            dropped = ring.tail - ring.head
+            if dropped:
+                logger.warning(
+                    "dead shm ring %s: dropping %d untaken frame(s)",
+                    getattr(ring, "name", "?"),
+                    dropped,
+                )
+            release = getattr(ring, "release", None)
+            if release is not None and not release():
+                self._ring_graveyard.append(ring)
+        self._ring_graveyard = [
+            r for r in self._ring_graveyard if not r.release()
+        ]
+
+    def _wait_work(self, timeout: float) -> None:
+        """Park on the work event with the shm doorbell raised: external
+        producers see the doorbell and kick the control socket, whose
+        reader sets the event. The depth re-check after raising closes
+        the publish-before-doorbell race; the timeout backstops the one
+        architecturally possible store-load reorder (worst case: one
+        timeout tick of added latency, never a lost frame)."""
+        ext = self._ext_rings
+        if ext:
+            for ring in tuple(ext):
+                ring.set_doorbell(True)
+            if self.queue_depth:
+                for ring in tuple(ext):
+                    ring.set_doorbell(False)
+                return
+        self._work.wait(timeout=timeout)
+        if ext:
+            for ring in tuple(self._ext_rings):
+                ring.set_doorbell(False)
+
     def _run(self) -> None:
         inflight: deque = deque()  # (token, frames, n_items, stages, span)
         while True:
+            if self._detach_pending:
+                self._process_detach()
             if not inflight and not self._closed:
                 # cold pipeline: wait out the straggler train before the
                 # take so concurrent submitters share one launch (the
@@ -577,10 +735,10 @@ class DispatchLoop:
             # last take and the clear
             if self.queue_depth:
                 continue
-            self._work.wait(timeout=0.05)
+            self._wait_work(0.05)
 
     def _pending_frames(self) -> int:
-        return sum(r.tail - r.head for r in self._rings)
+        return sum(r.tail - r.head for r in self._rings if not r.dead)
 
     def _await_work_or_ready(self, token) -> bool:
         """With one launch in flight, a free buffer, and empty rings: park
@@ -604,7 +762,7 @@ class DispatchLoop:
             self._work.clear()
             if self.queue_depth:
                 return False
-            self._work.wait(timeout=delay)
+            self._wait_work(delay)
             delay = min(delay * 2, 1e-3)
         return True
 
@@ -641,7 +799,7 @@ class DispatchLoop:
             self._work.clear()
             # a publish may have landed before the clear: re-check via the
             # depth comparison at the top rather than trusting the event
-            self._work.wait(timeout=min(deadline - now, lull))
+            self._wait_work(min(deadline - now, lull))
 
     def _take(self):
         """Drain every ring. Returns (frames, pending_free, expired,
@@ -663,6 +821,8 @@ class DispatchLoop:
         seq = self._take_seq
         active = 0
         for ring in self._rings:
+            if ring.dead:
+                continue
             entry = self._ring_activity.get(id(ring))
             if entry is None:
                 entry = self._ring_activity[id(ring)] = [ring.items_in, seq]
@@ -673,6 +833,11 @@ class DispatchLoop:
                 active += 1
         self._expect_frames = max(1, active)
         for ring in self._rings:
+            if ring.dead:
+                # producer process gone (shm control EOF): its published-
+                # but-untaken frames are dropped at detach; taking them
+                # here would launch work nobody redeems
+                continue
             tail = ring.tail
             head = ring.head
             if head == tail:

@@ -254,6 +254,7 @@ class SlabSidecarServer:
         tls_ca: str = "",
         fault_injector=None,
         repl=None,
+        shm_control_path: str = "",
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
 
@@ -286,6 +287,28 @@ class SlabSidecarServer:
         self._engine = engine
         self._faults = fault_injector
         self._repl = repl
+        # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
+        # frontend PROCESSES publish row blocks straight into this
+        # engine's dispatch loop through shared-memory rings registered
+        # over this control socket — the socket RPC below stays the
+        # fallback (lease trailers, cross-host frontends) and the
+        # rollback arm. Requires the dispatch loop (windowed mode);
+        # engines without one keep the socket-only contract.
+        self._shm_control = None
+        if shm_control_path:
+            loop = getattr(engine, "dispatch_loop", None)
+            if loop is None:
+                logger.warning(
+                    "SHM_RINGS requested but the engine has no dispatch "
+                    "loop (direct mode / DISPATCH_LOOP=false): shm "
+                    "control socket NOT started, socket RPC only"
+                )
+            else:
+                from .shm_ring import ShmControlServer
+
+                self._shm_control = ShmControlServer(
+                    loop, shm_control_path, socket_mode=socket_mode
+                )
         self._scheme, target = parse_sidecar_address(address)
         self._path = address
         self._tls_ctx = None
@@ -613,6 +636,8 @@ class SlabSidecarServer:
 
     def close(self) -> None:
         self._stop.set()
+        if self._shm_control is not None:
+            self._shm_control.close()
         # shutdown BEFORE close: a thread blocked in accept() does not
         # reliably wake on close() alone (Linux), which leaves the kernel
         # socket held and a restart on the same port failing EADDRINUSE.
@@ -658,6 +683,8 @@ class SidecarEngineClient:
         breaker_reset: float = 5.0,
         fault_injector=None,
         sleep=time.sleep,
+        shm_control_path: str = "",
+        shm_ring_rows: int = 4096,
     ):
         """address: unix path, tcp://host:port, or tls://host:port — or a
         LIST of them (equivalently one comma-separated string: the
@@ -706,22 +733,39 @@ class SidecarEngineClient:
         ladder answers instead of every request eating a timeout.
 
         fault_injector: optional testing.faults.FaultInjector; consulted at
-        'sidecar.dial' per dial and 'sidecar.submit' per SUBMIT attempt."""
+        'sidecar.dial' per dial and 'sidecar.submit' per SUBMIT attempt.
+
+        shm_control_path (SHM_RINGS; backends/shm_ring.py): when set and
+        this is a SINGLE-address client, plain row-block submits publish
+        through a shared-memory ring straight into the device owner's
+        dispatch loop instead of the socket RPC — the per-request hot
+        path crosses no sockets. Frames that need wire trailers (lease
+        ops) and multi-address epoch-fenced clients stay on the socket
+        path, and any shm TRANSPORT failure falls back to the socket RPC
+        per call (counted in <scope>.sidecar.shm_fallback) so a dying
+        owner degrades through the existing retry/breaker/failover
+        ladder, never a new one."""
         self._h_rpc = None
+        self._h_shm = None
         self._c_retry = self._c_redial = self._c_breaker_open = None
-        self._c_failover = None
+        self._c_failover = self._c_shm_fallback = None
         self._g_breaker_state = self._g_active_backend = None
+        self._g_shm_active = None
         if scope is not None:
             sc = scope.scope("sidecar")
             self._h_rpc = sc.histogram("rpc_ms")
+            self._h_shm = sc.histogram("shm_ms")
             self._c_retry = sc.counter("retry")
             self._c_redial = sc.counter("redial")
             self._c_breaker_open = sc.counter("breaker_open")
             self._c_failover = sc.counter("failover")
+            self._c_shm_fallback = sc.counter("shm_fallback")
             self._g_breaker_state = sc.gauge("breaker_state")
             self._g_breaker_state.set(0)
             self._g_active_backend = sc.gauge("active_backend")
             self._g_active_backend.set(0)
+            self._g_shm_active = sc.gauge("shm_active")
+            self._g_shm_active.set(0)
         if isinstance(address, str):
             addrs = [a.strip() for a in address.split(",") if a.strip()]
         else:
@@ -806,6 +850,41 @@ class SidecarEngineClient:
                 self._failover(cause=f"boot ping failed: {e}")
         if last_err is not None:
             raise last_err
+        # shm submit rings — attached AFTER the boot ping proved the
+        # owner up. Best-effort: a missing control socket (owner built
+        # without SHM_RINGS, older owner) logs once and leaves the
+        # socket RPC path as the only path. Multi-address clients never
+        # attach: shm frames carry no epoch fence, so the failover
+        # story stays on the wire where it is enforced.
+        self._shm = None
+        if shm_control_path and not self._epoch_aware:
+            try:
+                from .shm_ring import ShmRingClient, ShmUnavailable
+
+                try:
+                    self._shm = ShmRingClient(
+                        shm_control_path,
+                        arena_rows=int(shm_ring_rows),
+                        submit_timeout=self._rpc_deadline,
+                        fault_injector=fault_injector,
+                    )
+                    if self._g_shm_active is not None:
+                        self._g_shm_active.set(1)
+                    logger.info(
+                        "shm submit rings active via %s", shm_control_path
+                    )
+                except ShmUnavailable as e:
+                    # an owner without SHM_RINGS simply has no control
+                    # socket — expected, not alarming
+                    logger.info(
+                        "shm submit rings not offered by the owner (%s): "
+                        "socket RPC only",
+                        e,
+                    )
+            except Exception as e:  # noqa: BLE001 - strictly optional
+                logger.warning(
+                    "shm submit rings unavailable (%s): socket RPC only", e
+                )
 
     def _on_breaker_transition(self, prev: str, state: str) -> None:
         if self._g_breaker_state is not None:
@@ -973,11 +1052,36 @@ class SidecarEngineClient:
         n = block.shape[1]
         if n == 0:
             return np.empty(0, dtype=np.uint32)
+        has_lease = lease_ops is not None and (
+            lease_ops.grants or lease_ops.settles
+        )
+        # shm fast path: plain frames publish straight into the owner's
+        # dispatch loop. Lease-carrying frames need the wire trailer and
+        # ride the socket; shm transport death falls back per call and
+        # the socket ladder (retry/breaker) takes it from there.
+        shm = self._shm
+        if shm is not None and not has_lease and not shm.dead:
+            from .shm_ring import ShmUnavailable
+
+            t0 = time.perf_counter() if self._h_shm is not None else 0.0
+            try:
+                out = shm.submit(block)
+                if self._h_shm is not None:
+                    self._h_shm.record((time.perf_counter() - t0) * 1e3)
+                return out
+            except ShmUnavailable as e:
+                if self._c_shm_fallback is not None:
+                    self._c_shm_fallback.inc()
+                if self._g_shm_active is not None and shm.dead:
+                    self._g_shm_active.set(0)
+                logger.warning(
+                    "shm submit unavailable (%s): falling back to socket", e
+                )
         payload = _U32.pack(n) + np.ascontiguousarray(
             block, dtype=np.uint32
         ).tobytes()
         extra_flags = 0
-        if lease_ops is not None and (lease_ops.grants or lease_ops.settles):
+        if has_lease:
             from .lease import encode_lease_ops
 
             payload += encode_lease_ops(lease_ops)
@@ -1190,6 +1294,8 @@ class SidecarEngineClient:
         pass  # submits are synchronous end to end
 
     def close(self) -> None:
+        if self._shm is not None:
+            self._shm.close()
         with self._pool_lock:
             self._closed = True
             for conn in self._pool:
@@ -1225,5 +1331,7 @@ def new_sidecar_cache_from_settings(
             breaker_threshold=settings.sidecar_breaker_threshold,
             breaker_reset=settings.sidecar_breaker_reset,
             fault_injector=fault_injector,
+            shm_control_path=settings.shm_control_path(),
+            shm_ring_rows=settings.shm_ring_rows_count(),
         ),
     )

@@ -569,6 +569,14 @@ class SlabDeviceEngine:
             int(self._time_source.unix_now()),
         )
 
+    @property
+    def dispatch_loop(self):
+        """The device-owner dispatch loop, or None (direct mode /
+        DISPATCH_LOOP=false). The shm-ring control server
+        (backends/shm_ring.py) attaches cross-process frontend rings
+        here."""
+        return self._dispatch
+
     def flush(self) -> None:
         if self._dispatch is not None:
             self._dispatch.flush()

@@ -1,15 +1,172 @@
 """Server binary entry point (src/service_cmd/main.go:5-8).
 
     python -m api_ratelimit_tpu.cmd.service_cmd
+
+FRONTEND_PROCS=N turns the single server into a process fleet: N frontend
+server PROCESSES — each a full Runner with its own interpreter (its own
+GIL), sharing the serving ports via SO_REUSEPORT so the kernel
+load-balances connections — all feeding ONE device-owner process through
+the sidecar socket and, with SHM_RINGS (the default), through
+shared-memory submit rings (backends/shm_ring.py) so the per-request
+submit path crosses no sockets. This is the deployment shape the
+reference runs as 2-3 stateless replicas against one Redis
+(nomad/apigw-ratelimit/common.hcl) and the split PAPERS' "Designing
+Scalable Rate Limiting Systems" prescribes: many cheap stateless
+frontends, one small stateful decision core.
+
+With BACKEND_TYPE=tpu the master spawns the device owner itself
+(cmd/sidecar_cmd.py inherits the TPU_* knobs) and rewrites the workers to
+BACKEND_TYPE=tpu-sidecar pointed at SIDECAR_SOCKET; with
+BACKEND_TYPE=tpu-sidecar an external owner is already running and only
+the workers spawn. Worker debug ports are offset by worker index (debug
+scrapes must not SO_REUSEPORT-split across processes); dead workers are
+restarted with a 1 s backoff; SIGTERM/SIGINT tears the fleet down
+workers-first so the owner drains last. FRONTEND_PROCS=1 (the default)
+is the byte-identical single-process legacy boot.
 """
 
 from __future__ import annotations
 
-from ..runner import Runner
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ..runner import Runner, setup_logging
+from ..settings import Settings, new_settings
+
+logger = logging.getLogger("ratelimit.service_cmd")
 
 
 def main() -> None:
-    Runner().run()
+    settings = new_settings()
+    n = settings.frontend_procs_count()
+    if n <= 1:
+        Runner(settings).run()
+        return
+    run_frontend_fleet(settings, n)
+
+
+def _wait_for_unix_socket(path: str, proc, timeout: float = 180.0) -> None:
+    """Block until the device owner's unix socket exists (precompile can
+    take a while on a cold XLA cache) or its process dies."""
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"device owner exited with {proc.returncode} before "
+                f"its socket {path} appeared"
+            )
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"device owner socket {path} never appeared"
+            )
+        time.sleep(0.1)
+
+
+def run_frontend_fleet(settings: Settings, n: int) -> None:
+    """Master process: spawn (owner +) N workers, supervise, tear down."""
+    setup_logging(settings)
+    stop = threading.Event()
+
+    worker_env = dict(os.environ)
+    worker_env["FRONTEND_PROCS"] = "1"
+    owner = None
+    if settings.backend_type == "tpu":
+        owner_env = dict(os.environ)
+        owner_env["FRONTEND_PROCS"] = "1"
+        owner = subprocess.Popen(
+            [sys.executable, "-m", "api_ratelimit_tpu.cmd.sidecar_cmd"],
+            env=owner_env,
+        )
+        logger.warning(
+            "FRONTEND_PROCS=%d: spawned device owner pid %d on %s",
+            n,
+            owner.pid,
+            settings.sidecar_socket,
+        )
+        worker_env["BACKEND_TYPE"] = "tpu-sidecar"
+        # frontends must never grab the accelerator the owner serves
+        worker_env.setdefault("JAX_PLATFORMS", "cpu")
+        if "://" not in settings.sidecar_socket:
+            _wait_for_unix_socket(settings.sidecar_socket, owner)
+
+    def spawn_worker(i: int) -> subprocess.Popen:
+        env = dict(worker_env)
+        # gRPC/HTTP serve through SO_REUSEPORT on the SHARED ports; the
+        # debug listener must stay per-process or scrapes would split
+        env["DEBUG_PORT"] = str(settings.debug_port + i)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "api_ratelimit_tpu.cmd.service_cmd"],
+            env=env,
+        )
+        logger.warning(
+            "spawned frontend worker %d/%d pid %d (debug port %s)",
+            i + 1,
+            n,
+            proc.pid,
+            env["DEBUG_PORT"],
+        )
+        return proc
+
+    workers = [spawn_worker(i) for i in range(n)]
+
+    def on_signal(signum, frame):
+        logger.warning("got signal %s, tearing down the fleet", signum)
+        stop.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+        signal.signal(sig, on_signal)
+
+    try:
+        while not stop.is_set():
+            for i, proc in enumerate(workers):
+                rc = proc.poll()
+                if rc is not None and not stop.is_set():
+                    logger.error(
+                        "frontend worker %d (pid %d) exited with %s; "
+                        "restarting in 1s",
+                        i + 1,
+                        proc.pid,
+                        rc,
+                    )
+                    time.sleep(1.0)
+                    workers[i] = spawn_worker(i)
+            if owner is not None and owner.poll() is not None:
+                # the owner IS the slab: without it the workers can only
+                # serve their degradation ladders — bring it back
+                logger.error(
+                    "device owner (pid %d) exited with %s; restarting in 1s",
+                    owner.pid,
+                    owner.returncode,
+                )
+                time.sleep(1.0)
+                owner = subprocess.Popen(
+                    [sys.executable, "-m", "api_ratelimit_tpu.cmd.sidecar_cmd"],
+                    env={**os.environ, "FRONTEND_PROCS": "1"},
+                )
+            stop.wait(0.5)
+    finally:
+        # workers first (they drain their in-flight requests against a
+        # live owner), owner last
+        for proc in workers:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 15.0
+        for proc in workers:
+            try:
+                proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if owner is not None and owner.poll() is None:
+            owner.terminate()
+            try:
+                owner.wait(15.0)
+            except subprocess.TimeoutExpired:
+                owner.kill()
 
 
 if __name__ == "__main__":

@@ -273,6 +273,19 @@ def main(argv=None) -> None:
     add_healthcheck(debug, health)
     debug.serve_background()
     store.start_flushing()
+    # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
+    # frontend processes publish straight into this owner's dispatch
+    # loop. Replicated deployments keep the socket path — shm frames
+    # bypass the promote-on-write / epoch-fence interception that lives
+    # in the wire handler, so the two features are mutually exclusive
+    # until the fence moves engine-side.
+    shm_control = settings.shm_control_path()
+    if shm_control and repl is not None:
+        logger.warning(
+            "SHM_RINGS disabled: REPL_ROLE is set and shm frames would "
+            "bypass the epoch fence (socket RPC only on this owner)"
+        )
+        shm_control = ""
     server = SlabSidecarServer(
         settings.sidecar_socket,
         engine,
@@ -282,6 +295,7 @@ def main(argv=None) -> None:
         tls_ca=settings.sidecar_tls_ca,
         fault_injector=fault_injector,
         repl=repl,
+        shm_control_path=shm_control,
     )
     if repl is not None:
         # resolve the auto role / start the standby subscription only

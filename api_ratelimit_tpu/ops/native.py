@@ -57,6 +57,16 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rl_compose_keys.argtypes = [
         u8p, u64p, u64p, i64p, ctypes.c_uint64, u8p, ctypes.c_uint64, u64p,
     ]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.rl_match_batch.restype = None
+    lib.rl_match_batch.argtypes = [
+        u64p, ctypes.c_uint64,  # ht, ht_mask
+        u32p, u32p, u64p, u32p, u8p,  # e_parent, e_node, key off/len, blob
+        i32p, u8p,  # n_limit, n_children
+        u8p, u64p, u64p,  # request blob, str_off, rec_off
+        ctypes.c_uint64, u8p, i32p,  # n_records, scratch, out
+    ]
     vpp = ctypes.POINTER(ctypes.c_void_p)
     lib.rl_pack_rows.restype = None
     lib.rl_pack_rows.argtypes = [
@@ -112,7 +122,10 @@ def lib() -> ctypes.CDLL | None:
             return None
         try:
             _lib = _configure(ctypes.CDLL(_SO_PATH))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError = a stale .so missing a newer entry point
+            # (RL_NATIVE_LIB pinned to an old build): fall back rather
+            # than crash the boot
             logger.warning("native codec load failed (%s); using Python path", e)
             _load_failed = True
     return _lib
@@ -209,6 +222,65 @@ def fingerprint_batch(records, seeds) -> np.ndarray:
         n,
         _as_u8p(scratch),
         _as_u64p(out),
+    )
+    return out
+
+
+class MatcherTable:
+    """The flattened rule trie rl_match_batch walks (built by
+    config/compiled.py at load/hot-reload; see host_codec.cpp for the
+    layout contract). Holds the numpy arrays alive for the C side."""
+
+    __slots__ = (
+        "ht", "ht_mask", "e_parent", "e_node", "e_key_off", "e_key_len",
+        "key_blob", "n_limit", "n_children",
+    )
+
+    def __init__(self, ht, e_parent, e_node, e_key_off, e_key_len,
+                 key_blob, n_limit, n_children):
+        self.ht = np.ascontiguousarray(ht, dtype=np.uint64)
+        self.ht_mask = self.ht.size - 1
+        self.e_parent = np.ascontiguousarray(e_parent, dtype=np.uint32)
+        self.e_node = np.ascontiguousarray(e_node, dtype=np.uint32)
+        self.e_key_off = np.ascontiguousarray(e_key_off, dtype=np.uint64)
+        self.e_key_len = np.ascontiguousarray(e_key_len, dtype=np.uint32)
+        self.key_blob = np.ascontiguousarray(key_blob, dtype=np.uint8)
+        self.n_limit = np.ascontiguousarray(n_limit, dtype=np.int32)
+        self.n_children = np.ascontiguousarray(n_children, dtype=np.uint8)
+
+
+def match_batch(table: MatcherTable, records) -> np.ndarray:
+    """Batched rule matching: records are record_strings-style string
+    sequences (domain, k1, v1, ...); returns int32[n] of matched rule
+    indices (-1 = no rule). Exact tree-walker semantics, pinned by the
+    differential fuzz in tests/test_compiled_matcher.py."""
+    native = lib()
+    flat = _Flattened(records)
+    n = len(flat.rec_off) - 1
+    out = np.empty(n, dtype=np.int32)
+    if n == 0:
+        return out
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    # compose scratch: one "key_value" join is bounded by the record's
+    # total string bytes plus the separator
+    scratch = np.empty(max(2, flat.max_record_bytes + 2), dtype=np.uint8)
+    native.rl_match_batch(
+        _as_u64p(table.ht),
+        table.ht_mask,
+        table.e_parent.ctypes.data_as(u32p),
+        table.e_node.ctypes.data_as(u32p),
+        _as_u64p(table.e_key_off),
+        table.e_key_len.ctypes.data_as(u32p),
+        _as_u8p(table.key_blob),
+        table.n_limit.ctypes.data_as(i32p),
+        _as_u8p(table.n_children),
+        _as_u8p(flat.blob),
+        _as_u64p(flat.str_off),
+        _as_u64p(flat.rec_off),
+        n,
+        _as_u8p(scratch),
+        out.ctypes.data_as(i32p),
     )
     return out
 

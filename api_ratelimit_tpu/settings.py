@@ -271,6 +271,35 @@ class Settings:
     # (min(size, headroom/2)) so accuracy degrades smoothly near the edge
     # instead of reserving past the limit
     lease_near_limit_ratio: float = 0.9
+    # --- cross-process frontends (backends/shm_ring.py) ---
+    # SHM_RINGS: back the dispatch submit rings with shared-memory
+    # segments so FRONTEND PROCESSES (each with its own GIL) publish row
+    # blocks straight into the device owner's drain loop — no socket RPC
+    # on the submit hot path. The device owner (sidecar_cmd / the
+    # FRONTEND_PROCS master) opens a small unix control socket for ring
+    # registration + doorbell kicks; frontends with a same-host unix
+    # sidecar address attach to it and fall back to the socket RPC path
+    # per call when shm is unavailable (lease trailers, multi-address
+    # failover clients, dead owner). false is the byte-identical
+    # rollback arm — the wire and submit paths are exactly PR-10's
+    # (pinned by test, same discipline as HOST_FAST_PATH/DISPATCH_LOOP).
+    shm_rings: bool = True
+    # control socket path; empty derives <SIDECAR_SOCKET>.shmctl for
+    # unix sidecar addresses and disables shm for tcp://tls:// (no
+    # same-host guarantee)
+    shm_control_sock: str = ""
+    # per-ring arena capacity in rows (one ring per frontend thread);
+    # a frame larger than the arena sheds with QueueFullError
+    shm_ring_rows: int = 4096
+    # FRONTEND_PROCS (cmd/service_cmd.py): run N frontend server
+    # PROCESSES sharing the serving ports via SO_REUSEPORT, all feeding
+    # one device-owner process. With BACKEND_TYPE=tpu the master spawns
+    # the device owner (sidecar_cmd) itself and the workers attach to it
+    # over SIDECAR_SOCKET (+ shm rings per SHM_RINGS); with
+    # BACKEND_TYPE=tpu-sidecar the owner is external and only workers
+    # spawn. 1 (the default) is the single-process legacy boot,
+    # byte-identical to PR-10.
+    frontend_procs: int = 1
     # fault injection (testing/faults.py): comma-separated
     # site:kind:value rules, e.g.
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -528,6 +557,45 @@ class Settings:
             )
         return role, interval, max_lag if max_lag > 0 else 5.0 * interval
 
+    def shm_control_path(self) -> str:
+        """The shm-ring control socket path, or "" when shm rings are
+        off/underivable. Explicit SHM_CONTROL_SOCK wins; otherwise a unix
+        SIDECAR_SOCKET derives <socket>.shmctl (same host by
+        construction), and tcp://tls:// sidecar addresses disable shm —
+        shared memory cannot cross hosts."""
+        if not self.shm_rings:
+            return ""
+        explicit = self.shm_control_sock.strip()
+        if explicit:
+            return explicit
+        if "://" in self.sidecar_socket:
+            return ""
+        return self.sidecar_socket + ".shmctl"
+
+    def shm_ring_rows_count(self) -> int:
+        """Validated SHM_RING_ROWS arena capacity. Junk fails the boot
+        like every other knob — a typo'd arena size must not silently
+        become a shed-everything ring."""
+        rows = int(self.shm_ring_rows)
+        if rows < 64:
+            raise ValueError(
+                f"SHM_RING_ROWS must be >= 64, got {rows}"
+            )
+        return rows
+
+    def frontend_procs_count(self) -> int:
+        """Validated FRONTEND_PROCS worker count (1 = single-process
+        legacy boot). Junk fails the boot like every other knob."""
+        n = int(self.frontend_procs)
+        if n < 1:
+            raise ValueError(f"FRONTEND_PROCS must be >= 1, got {n}")
+        if n > 1 and self.backend_type not in ("tpu", "tpu-sidecar"):
+            raise ValueError(
+                f"FRONTEND_PROCS={n} requires BACKEND_TYPE tpu or "
+                f"tpu-sidecar, got {self.backend_type!r}"
+            )
+        return n
+
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
         ValueError on junk — a typo'd chaos spec must fail the boot, not
@@ -660,6 +728,10 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("lease_max", "LEASE_MAX", int),
     ("lease_ttl_fraction", "LEASE_TTL_FRACTION", float),
     ("lease_near_limit_ratio", "LEASE_NEAR_LIMIT_RATIO", float),
+    ("shm_rings", "SHM_RINGS", _parse_bool),
+    ("shm_control_sock", "SHM_CONTROL_SOCK", str),
+    ("shm_ring_rows", "SHM_RING_ROWS", int),
+    ("frontend_procs", "FRONTEND_PROCS", int),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]

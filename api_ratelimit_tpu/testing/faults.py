@@ -43,6 +43,14 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
                             error fails the whole batch's tickets with
                             CacheError so the breaker/fallback ladder
                             answers
+    dispatch.ring_publish   shm submit ring (backends/shm_ring.py): fires
+                            in the FRONTEND process between the arena row
+                            copy and the seqno store — the torn-frame
+                            window. delay_ms parks the publish there so a
+                            chaos test can SIGKILL the frontend process
+                            mid-publish (the owner must never see the
+                            frame: seqno discipline); error abandons the
+                            publish with CacheError
     snapshot.write          warm-restart snapshotter: each shard-file write
                             (persist/snapshot.py) — error fails the write,
                             torn_write truncates the payload mid-row,

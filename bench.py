@@ -2119,6 +2119,273 @@ def bench_failover_blip(on_tpu: bool, left=lambda: 1e9) -> dict:
     return result
 
 
+# Device-owner child for the service_mp tier: one sidecar-served slab
+# engine with (or without) the shm-ring control socket. Fresh per arm so
+# every arm starts from an empty slab.
+_MP_OWNER_SRC = """\
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+import numpy as np
+from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+sock, ctl, shm = sys.argv[1], sys.argv[2], sys.argv[3]
+engine = SlabDeviceEngine(
+    RealTimeSource(), n_slots=1 << 16, use_pallas=False,
+    buckets=(128, 1024), batch_window_seconds=0.0005, max_batch=8192,
+    block_mode=True,
+)
+warm = np.array([[1], [0], [1], [1 << 30], [60], [0]], dtype=np.uint32)
+engine.submit_block(warm)
+server = SlabSidecarServer(
+    sock, engine, shm_control_path=(sock + ".shmctl" if shm == "1" else "")
+)
+with open(ctl + ".ready", "w") as f:
+    f.write("ok")
+while True:
+    time.sleep(1)
+"""
+
+# Frontend worker child: a full service stack in its OWN interpreter
+# (own GIL) driving closed-loop against the shared owner — the
+# FRONTEND_PROCS deployment shape with the bench driver inlined. Reports
+# raw latencies + the native-loop flags so host_split comes from the
+# worker that actually ran the requests.
+_MP_WORKER_SRC = """\
+import json, os, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+import random
+from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
+from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+from api_ratelimit_tpu.service.ratelimit import RateLimitService
+from api_ratelimit_tpu.stats.sinks import NullSink
+from api_ratelimit_tpu.stats.store import Store
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+import bench
+
+sock, shm, n_threads, dur, go_path, out_path = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), float(sys.argv[4]),
+    sys.argv[5], sys.argv[6],
+)
+store = Store(NullSink())
+scope = store.scope("ratelimit")
+client = SidecarEngineClient(
+    sock, pool_size=max(2, n_threads), scope=scope,
+    shm_control_path=(sock + ".shmctl" if shm == "1" else ""),
+)
+cache = TpuRateLimitCache(
+    BaseRateLimiter(
+        RealTimeSource(), jitter_rand=random.Random(0),
+        expiration_jitter_max_seconds=0,
+    ),
+    engine=client,
+)
+service = RateLimitService(
+    runtime=bench._StaticRuntime(bench._FLAT), cache=cache,
+    stats_scope=scope.scope("service"), time_source=RealTimeSource(),
+)
+reqs = bench._requests_for("flat_per_second", 1024)
+for r in reqs[:32]:
+    service.should_rate_limit(r)
+with open(out_path + ".ready", "w") as f:
+    f.write("ok")
+while not os.path.exists(go_path):
+    time.sleep(0.005)
+t_end = time.monotonic() + dur
+lats = []
+lock = threading.Lock()
+
+def worker(tid):
+    my = reqs[tid::n_threads]
+    local = []
+    i = 0
+    while time.monotonic() < t_end:
+        r = my[i % len(my)]
+        i += 1
+        t0 = time.perf_counter()
+        service.should_rate_limit(r)
+        local.append((time.perf_counter() - t0) * 1e3)
+    with lock:
+        lats.extend(local)
+
+threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+t0 = time.monotonic()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+elapsed = time.monotonic() - t0
+snap = store.debug_snapshot()
+cfg = service.get_current_config()
+out = {{
+    "n": len(lats),
+    "elapsed": elapsed,
+    "lats": [round(x, 3) for x in lats],
+    "shm_used": bool(client._shm is not None and not client._shm.dead),
+    "shm_fallbacks": snap.get("ratelimit.sidecar.shm_fallback", 0),
+    "matcher_native": bool(
+        cfg is not None and getattr(cfg.compiled, "native_active", False)
+    ),
+    "matcher_p50_ms": snap.get("ratelimit.service.host.matcher_ms.p50", 0),
+    "shm_p50_ms": snap.get("ratelimit.sidecar.shm_ms.p50", 0),
+    "rpc_p50_ms": snap.get("ratelimit.sidecar.rpc_ms.p50", 0),
+}}
+with open(out_path + ".tmp", "w") as f:
+    json.dump(out, f)
+os.replace(out_path + ".tmp", out_path)
+cache.close()
+"""
+
+
+def _run_mp_arm(td: str, tag: str, procs: int, n_threads: int, shm: bool,
+                duration_s: float) -> dict:
+    """One service_mp arm: fresh owner subprocess + `procs` worker
+    subprocesses, all released by one go-file so the measured windows
+    line up. Returns pooled rate/percentiles plus the native-loop flags
+    from worker 0 (the host_split source)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    sock = os.path.join(td, f"{tag}.sock")
+    ctl = os.path.join(td, f"{tag}_ctl")
+    go_path = os.path.join(td, f"{tag}.go")
+    owner = subprocess.Popen(
+        [sys.executable, "-c", _MP_OWNER_SRC.format(repo=repo), sock, ctl,
+         "1" if shm else "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    workers = []
+    outs = [os.path.join(td, f"{tag}_w{i}.json") for i in range(procs)]
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(ctl + ".ready"):
+            if owner.poll() is not None or time.monotonic() > deadline:
+                raise TimeoutError("mp owner never came up")
+            time.sleep(0.02)
+        for i in range(procs):
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _MP_WORKER_SRC.format(repo=repo),
+                 sock, "1" if shm else "0", str(n_threads),
+                 str(duration_s), go_path, outs[i]],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env,
+            ))
+        deadline = time.monotonic() + 240
+        while not all(os.path.exists(o + ".ready") for o in outs):
+            for w in workers:
+                if w.poll() is not None:
+                    raise RuntimeError(f"mp worker exited rc={w.returncode}")
+            if time.monotonic() > deadline:
+                raise TimeoutError("mp workers never became ready")
+            time.sleep(0.02)
+        with open(go_path, "w") as f:
+            f.write("go")
+        reports = []
+        deadline = time.monotonic() + duration_s + 120
+        for w, out_path in zip(workers, outs):
+            while not os.path.exists(out_path):
+                if w.poll() is not None and not os.path.exists(out_path):
+                    raise RuntimeError(
+                        f"mp worker exited rc={w.returncode} without report"
+                    )
+                if time.monotonic() > deadline:
+                    raise TimeoutError("mp worker report timed out")
+                time.sleep(0.02)
+            with open(out_path) as f:
+                reports.append(json.load(f))
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        owner.kill()
+        owner.wait()
+    lats = np.array([x for r in reports for x in r["lats"]])
+    elapsed = max(r["elapsed"] for r in reports)
+    row = {
+        "procs": procs,
+        "threads_per_proc": n_threads,
+        "n": int(lats.size),
+        "rate": round(float(lats.size) / max(elapsed, 1e-9)),
+        "p50_ms": round(float(np.percentile(lats, 50)), 3) if lats.size else 0,
+        "p99_ms": round(float(np.percentile(lats, 99)), 3) if lats.size else 0,
+        "shm_used": all(r["shm_used"] for r in reports) if shm else False,
+        "shm_fallbacks": int(sum(r["shm_fallbacks"] for r in reports)),
+    }
+    # host_split from the worker that ran the loop: which stages were
+    # native, and the per-stage p50s straight from its runtime histograms
+    r0 = reports[0]
+    row["host_split"] = {
+        "matcher_native": r0["matcher_native"],
+        "matcher_ns": round(r0["matcher_p50_ms"] * 1e6),
+        "submit_ns": round(
+            (r0["shm_p50_ms"] if shm else r0["rpc_p50_ms"]) * 1e6
+        ),
+    }
+    return row
+
+
+def bench_service_mp(on_tpu: bool, left=lambda: 1e9) -> dict:
+    """Cross-process frontend tier (round 11): the closed-loop service
+    tier at FRONTEND_PROCS ∈ {1, 2, 4} — real worker PROCESSES, each
+    with its own GIL, feeding one device-owner process — with the
+    shm-ring and socket-RPC arms interleaved per level
+    (shm_overhead_pct; negative = shm is faster). Total closed-loop
+    concurrency is held at 4 across levels (threads_per_proc = 4/procs)
+    so the sweep isolates what splitting the GIL buys at constant load.
+    The 1-proc row IS the single-process arm the acceptance criterion
+    compares against."""
+    import tempfile
+
+    result: dict = {
+        "host_cpus": os.cpu_count(),
+        "duration_s": 3.0,
+        "total_threads": 4,
+        "rows": {},
+    }
+    rows = result["rows"]
+    with tempfile.TemporaryDirectory() as td:
+        for procs in (1, 2, 4):
+            if left() < 90:
+                rows[f"procs_{procs}"] = {"skipped": "budget"}
+                continue
+            n_threads = max(1, 4 // procs)
+            row: dict = {}
+            try:
+                # interleaved A/B: shm then socket, same fresh-owner
+                # recipe, back to back at each level
+                row["shm"] = _run_mp_arm(
+                    td, f"p{procs}s", procs, n_threads, True, 3.0
+                )
+                row["socket"] = _run_mp_arm(
+                    td, f"p{procs}w", procs, n_threads, False, 3.0
+                )
+                if row["shm"].get("rate") and row["socket"].get("rate"):
+                    row["shm_overhead_pct"] = round(
+                        100.0
+                        * (row["socket"]["rate"] - row["shm"]["rate"])
+                        / row["socket"]["rate"],
+                        2,
+                    )
+            except Exception as e:  # noqa: BLE001 - keep completed levels
+                row["error"] = str(e)[-200:]
+            rows[f"procs_{procs}"] = row
+    base = rows.get("procs_1", {}).get("shm", {}).get("rate")
+    for procs in (2, 4):
+        rate = rows.get(f"procs_{procs}", {}).get("shm", {}).get("rate")
+        if base and rate:
+            rows[f"procs_{procs}"]["speedup_vs_1proc"] = round(
+                rate / base, 2
+            )
+    return result
+
+
 def _sharded_in_subprocess(n_mesh: int) -> dict:
     """Run the sharded engine bench on a virtual CPU mesh in a subprocess so
     the forced device split never touches this process's backend (the
@@ -2418,6 +2685,18 @@ def main() -> None:
             configs["failover_blip"] = bench_failover_blip(on_tpu, left)
         except Exception as e:
             configs["failover_blip"] = {"error": str(e)[-300:]}
+    emit()
+
+    # cross-process frontends (round 11): the FRONTEND_PROCS sweep with
+    # the shm-ring vs socket-RPC arms interleaved at each level — the
+    # GIL-split claim stays a measurement
+    if left() < 120:
+        configs["service_mp"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["service_mp"] = bench_service_mp(on_tpu, left)
+        except Exception as e:
+            configs["service_mp"] = {"error": str(e)[-300:]}
     emit()
 
     # engine comparison rows (kernel twin, after-mode), deferred from the

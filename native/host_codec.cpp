@@ -188,6 +188,86 @@ void rl_scatter_rows(const uint32_t* src, const uint64_t* counts,
   }
 }
 
+// Batched rule-tree matching over a flattened trie (the native half of
+// config/compiled.py's CompiledMatcher — the memo-miss path).
+//
+// The loaded YAML rule trie is flattened at config load/hot-reload into:
+//   * one open-addressed hash table `ht` (power-of-two, linear probing)
+//     whose non-zero values are entry_index + 1;
+//   * parallel entry arrays: e_parent (owning node id), e_node (child
+//     node id), e_key_off/e_key_len into `key_blob` (the child's map key
+//     bytes — "key" or "key_value", exactly the loader's composite);
+//   * parallel node arrays: n_limit (rule index, -1 when the node holds
+//     no rate_limit) and n_children (non-zero when the node has children).
+// Node 0 is a virtual root whose children are the domains, so the domain
+// lookup is just the first probe. Probes hash the key bytes with the
+// parent node id as the xxh64 seed, then verify parent + full key bytes —
+// hash collisions can slow a probe, never corrupt a match.
+//
+// Request records use the rl_fingerprint_batch framing: record i's first
+// string is the domain, followed by alternating entry key/value strings.
+// The walk mirrors config_impl.go:293-319 (and the Python tree walker)
+// EXACTLY: at each level probe "key_value" first ("key" + '_' + value,
+// composed into `scratch` — even for empty values, so the reference's
+// underscore-aliasing quirk is reproduced), then the bare "key" wildcard;
+// a limit only matches when config depth equals request depth; descent
+// stops at the first level without children. out[i] is the matched rule
+// index or -1.
+//
+// `scratch` must hold the longest composed key+value+1 of the batch (the
+// caller sizes it from the flattened record bytes).
+void rl_match_batch(const uint64_t* ht, uint64_t ht_mask,
+                    const uint32_t* e_parent, const uint32_t* e_node,
+                    const uint64_t* e_key_off, const uint32_t* e_key_len,
+                    const uint8_t* key_blob, const int32_t* n_limit,
+                    const uint8_t* n_children, const uint8_t* blob,
+                    const uint64_t* str_off, const uint64_t* rec_off,
+                    uint64_t n_records, uint8_t* scratch, int32_t* out) {
+  auto probe = [&](uint32_t parent, const uint8_t* key,
+                   uint64_t len) -> int64_t {
+    uint64_t i = xxh64(key, len, parent) & ht_mask;
+    for (;;) {
+      const uint64_t v = ht[i];
+      if (v == 0) return -1;
+      const uint64_t e = v - 1;
+      if (e_parent[e] == parent && e_key_len[e] == len &&
+          std::memcmp(key_blob + e_key_off[e], key, len) == 0)
+        return static_cast<int64_t>(e_node[e]);
+      i = (i + 1) & ht_mask;
+    }
+  };
+  for (uint64_t r = 0; r < n_records; ++r) {
+    const uint64_t s0 = rec_off[r];
+    const uint64_t s_end = rec_off[r + 1];
+    int32_t found = -1;
+    const int64_t dom = probe(0, blob + str_off[s0],
+                              str_off[s0 + 1] - str_off[s0]);
+    if (dom >= 0 && s_end > s0 + 1) {
+      const uint64_t n_pairs = (s_end - s0 - 1) / 2;
+      uint32_t parent = static_cast<uint32_t>(dom);
+      for (uint64_t p = 0; p < n_pairs; ++p) {
+        const uint64_t ks = s0 + 1 + 2 * p;
+        const uint8_t* k = blob + str_off[ks];
+        const uint64_t klen = str_off[ks + 1] - str_off[ks];
+        const uint8_t* v = blob + str_off[ks + 1];
+        const uint64_t vlen = str_off[ks + 2] - str_off[ks + 1];
+        std::memcpy(scratch, k, klen);
+        scratch[klen] = '_';
+        std::memcpy(scratch + klen + 1, v, vlen);
+        int64_t child = probe(parent, scratch, klen + 1 + vlen);
+        if (child < 0) child = probe(parent, k, klen);
+        if (child >= 0 && n_limit[child] >= 0 && p == n_pairs - 1)
+          found = n_limit[child];
+        if (child >= 0 && n_children[child])
+          parent = static_cast<uint32_t>(child);
+        else
+          break;
+      }
+    }
+    out[r] = found;
+  }
+}
+
 // Batched fixed-window cache-key composition (cache_key.go:43-73 layout):
 //   "<domain>_<k1>_<v1>_..._<window_start>"
 // Same record framing as rl_fingerprint_batch; window_starts[i] is the

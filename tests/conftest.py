@@ -59,6 +59,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-second subprocess tests (bench artifact)"
     )
+    config.addinivalue_line(
+        "markers",
+        "mp: multi-process frontend tests (shm rings / FRONTEND_PROCS; "
+        "`make tests_mp`)",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

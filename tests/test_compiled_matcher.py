@@ -187,6 +187,108 @@ class TestDifferentialFuzz:
                     assert got is want
 
 
+def _native_or_skip():
+    """The native matcher gate: with a g++ toolchain present the codec
+    MUST build (same hygiene bar as the dispatch codec test); without
+    one, the pure-Python tree walker is the expected path and the
+    native-differential suite skips cleanly."""
+    import shutil
+
+    from api_ratelimit_tpu.ops import native
+
+    if not native.available():
+        if shutil.which("g++") is None:
+            pytest.skip(
+                "no g++ toolchain: tree-walker fallback is the expected path"
+            )
+        info = native.build_info()
+        pytest.fail(
+            f"g++ present but native codec unavailable (so={info['so_path']})"
+        )
+    return native
+
+
+class TestNativeMatcherFuzz:
+    """rl_match_batch (native/host_codec.cpp) vs the tree walker: the
+    flattened-trie walk is the memo-miss path of every frontend, so it
+    gets its own differential campaign on top of the resolve-level fuzz
+    above — driven through match_uncached so every example exercises the
+    matcher, never the memo."""
+
+    def test_native_active_when_toolchain_present(self):
+        _native_or_skip()
+        cfg = None
+        rng = random.Random(7)
+        while cfg is None:
+            cfg = _random_config(rng)
+        assert cfg.compiled.native_active
+
+    def test_native_matches_tree_walker(self):
+        _native_or_skip()
+        rng = random.Random(4321)
+        configs = []
+        while len(configs) < 40:
+            cfg = _random_config(rng)
+            if cfg is not None:
+                configs.append(cfg)
+        assert all(c.compiled.native_active for c in configs)
+        checked = 0
+        while checked < N_EXAMPLES:
+            cfg = rng.choice(configs)
+            domain = rng.choice(["d1", "d2", "dom_x", "missing"])
+            descriptor = _random_request_descriptor(rng)
+            if descriptor.limit is not None:
+                continue  # overrides never reach the matcher
+            want = cfg.get_limit_tree(domain, descriptor)
+            got = cfg.compiled.match_uncached(domain, descriptor)
+            # identity, not equality: the native index must map back to
+            # the very RateLimit object the trie holds (stats identity)
+            assert got is want, (domain, descriptor)
+            checked += 1
+        assert checked >= N_EXAMPLES
+
+    def test_native_survives_hot_reload_under_threaded_traffic(self):
+        """Config swaps mid-stream while worker threads resolve: each
+        generation's native table must agree with THAT generation's
+        walker — a reload builds a fresh flattened table, and no thread
+        may ever observe a hybrid."""
+        _native_or_skip()
+        rng = random.Random(77)
+        stream = [
+            d
+            for d in (_random_request_descriptor(rng) for _ in range(400))
+            if d.limit is None
+        ]
+        configs = []
+        while len(configs) < 6:
+            cfg = _random_config(rng)
+            if cfg is not None:
+                configs.append(cfg)
+        live = {"cfg": configs[0]}
+        errors: list = []
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                cfg = live["cfg"]  # one generation per iteration
+                for d in stream[:50]:
+                    want = cfg.get_limit_tree("d1", d)
+                    got = cfg.compiled.match_uncached("d1", d)
+                    if got is not want:
+                        errors.append((d, got, want))
+                        return
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(40):
+            live["cfg"] = configs[_ % len(configs)]
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        assert not errors, errors[:3]
+
+
 @pytest.fixture
 def flip_service():
     """A RateLimitService over the TPU cache whose runtime can flip

@@ -450,3 +450,63 @@ class TestReplicationSettings:
         s = new_settings({"SIDECAR_ADDRS": "tcp://nohost"})
         with pytest.raises(ValueError, match="SIDECAR_ADDRS"):
             s.sidecar_addresses()
+
+
+class TestShmRingSettings:
+    """SHM_RINGS / FRONTEND_PROCS knobs (backends/shm_ring.py +
+    cmd/service_cmd.py): derivation rules for the control socket and the
+    junk-fails-boot discipline every other knob follows."""
+
+    def test_defaults(self):
+        s = Settings()
+        assert s.shm_rings is True
+        assert s.frontend_procs == 1  # single-process legacy boot
+        assert s.shm_ring_rows == 4096
+        assert s.frontend_procs_count() == 1
+        assert s.shm_ring_rows_count() == 4096
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "SHM_RINGS": "false",
+                "SHM_CONTROL_SOCK": "/tmp/ctl.sock",
+                "SHM_RING_ROWS": "8192",
+                "FRONTEND_PROCS": "4",
+            }
+        )
+        assert s.shm_rings is False
+        assert s.shm_control_sock == "/tmp/ctl.sock"
+        assert s.shm_ring_rows_count() == 8192
+        assert s.frontend_procs_count() == 4
+
+    def test_control_path_derivation(self):
+        s = Settings()
+        s.sidecar_socket = "/run/rl/owner.sock"
+        assert s.shm_control_path() == "/run/rl/owner.sock.shmctl"
+        # explicit path wins
+        s.shm_control_sock = "/tmp/x.sock"
+        assert s.shm_control_path() == "/tmp/x.sock"
+        # rollback arm derives nothing
+        s.shm_rings = False
+        assert s.shm_control_path() == ""
+        # shared memory cannot cross hosts: tcp/tls sidecars disable shm
+        s.shm_rings = True
+        s.shm_control_sock = ""
+        s.sidecar_socket = "tcp://owner:7070"
+        assert s.shm_control_path() == ""
+        s.sidecar_socket = "tls://owner:7070"
+        assert s.shm_control_path() == ""
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="SHM_RINGS"):
+            new_settings({"SHM_RINGS": "sideways"})
+        with pytest.raises(ValueError, match="FRONTEND_PROCS"):
+            new_settings({"FRONTEND_PROCS": "two"})
+        with pytest.raises(ValueError, match="FRONTEND_PROCS"):
+            new_settings({"FRONTEND_PROCS": "0"}).frontend_procs_count()
+        with pytest.raises(ValueError, match="BACKEND_TYPE"):
+            new_settings(
+                {"FRONTEND_PROCS": "2", "BACKEND_TYPE": "memory"}
+            ).frontend_procs_count()
+        with pytest.raises(ValueError, match="SHM_RING_ROWS"):
+            new_settings({"SHM_RING_ROWS": "8"}).shm_ring_rows_count()

@@ -1,0 +1,587 @@
+"""Cross-process shm submit rings (backends/shm_ring.py): publish/redeem
+round trips through the unchanged dispatch drain loop, verdict error
+codes, arena exhaustion shedding, the arena-pressure telemetry satellite
+(dispatch.arena_overflow / ring.arena_hwm), the SHM_RINGS=false
+byte-identical rollback arm, the SIGKILL-a-frontend-mid-publish chaos
+story (seqno torn-frame skip + zero failed requests for the survivors),
+and the real multi-process end-to-end path against a live engine.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.dispatch import DispatchLoop, SubmitRing, _Ticket
+from api_ratelimit_tpu.backends.overload import QueueFullError
+from api_ratelimit_tpu.backends.shm_ring import (
+    FAULT_SITE_PUBLISH,
+    ShmControlServer,
+    ShmRingClient,
+    ShmRingProducer,
+    ShmUnavailable,
+)
+from api_ratelimit_tpu.limiter.cache import CacheError, DeadlineExceededError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _block(values):
+    b = np.zeros((6, len(values)), dtype=np.uint32)
+    b[2] = values
+    return b
+
+
+def _echo_loop(**kwargs):
+    def launch(blocks):
+        return [np.array(b[2]) for b in blocks]
+
+    def collect(token):
+        return np.concatenate(token)
+
+    return DispatchLoop(launch, collect, **kwargs)
+
+
+@pytest.fixture
+def shm_stack():
+    """(loop, control server, client) over fake echo executors, torn
+    down in order (client -> server -> loop) so segments unlink."""
+    loop = _echo_loop(window_seconds=0.002)
+    td = tempfile.mkdtemp()
+    path = os.path.join(td, "ctl.sock")
+    server = ShmControlServer(loop, path)
+    client = ShmRingClient(path, arena_rows=256)
+    yield loop, server, client, path
+    client.close()
+    server.close()
+    loop.close()
+
+
+class TestShmRoundTrip:
+    def test_single_frame(self, shm_stack):
+        _loop, _srv, client, _path = shm_stack
+        assert client.submit(_block([7, 8, 9])).tolist() == [7, 8, 9]
+
+    def test_many_frames_wrap_slots_and_arena(self, shm_stack):
+        """Far more frames than slots and rows than the arena: the
+        cursor wraps and every verdict still lands on its own frame."""
+        _loop, _srv, client, _path = shm_stack
+        for i in range(300):
+            vals = [i * 7 + j for j in range(1 + i % 5)]
+            assert client.submit(_block(vals)).tolist() == vals
+
+    def test_threads_get_their_own_rings(self, shm_stack):
+        loop, _srv, client, _path = shm_stack
+        results: dict = {}
+
+        def worker(tid):
+            for _ in range(30):
+                results[tid] = client.submit(
+                    _block([tid * 100, tid * 100 + 1])
+                ).tolist()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+        assert results == {
+            t: [t * 100, t * 100 + 1] for t in range(4)
+        }
+        # one shm ring per frontend thread, all on the one loop
+        assert len(loop._ext_rings) == 4
+
+    def test_mixed_with_in_process_rings(self, shm_stack):
+        """shm frames and the owner process's own in-process submits
+        coalesce through the same drain loop."""
+        loop, _srv, client, _path = shm_stack
+        assert client.submit(_block([5])).tolist() == [5]
+        assert loop.submit(_block([6])).tolist() == [6]
+        assert client.submit(_block([7])).tolist() == [7]
+
+    def test_owner_launch_error_maps_to_cache_error(self):
+        calls = []
+
+        def launch(blocks):
+            calls.append(1)
+            if len(calls) == 1:
+                raise CacheError("device on fire")
+            return [np.array(b[2]) for b in blocks]
+
+        loop = DispatchLoop(launch, lambda token: np.concatenate(token))
+        td = tempfile.mkdtemp()
+        path = os.path.join(td, "ctl.sock")
+        server = ShmControlServer(loop, path)
+        client = ShmRingClient(path, arena_rows=64)
+        try:
+            with pytest.raises(CacheError):
+                client.submit(_block([1]))
+            assert client.submit(_block([2])).tolist() == [2]
+        finally:
+            client.close()
+            server.close()
+            loop.close()
+
+    def test_expired_deadline_dropped_at_take(self):
+        """A frame whose propagated deadline lapses in the ring comes
+        back as DeadlineExceededError — same take-time drop as the
+        in-process arm, now across the process boundary."""
+        from api_ratelimit_tpu.utils.deadline import deadline_scope
+
+        gate = threading.Event()
+        launched = []
+
+        def launch(blocks):
+            launched.extend(int(b[2][0]) for b in blocks)
+            return [np.array(b[2]) for b in blocks]
+
+        def collect(token):
+            gate.wait(5.0)
+            return np.concatenate(token)
+
+        loop = DispatchLoop(launch, collect)
+        td = tempfile.mkdtemp()
+        path = os.path.join(td, "ctl.sock")
+        server = ShmControlServer(loop, path)
+        client = ShmRingClient(path, arena_rows=64)
+        try:
+            # occupy the owner with a gated readback
+            t1 = threading.Thread(target=lambda: loop.submit(_block([1])))
+            t1.start()
+            deadline = time.monotonic() + 2.0
+            while not launched and time.monotonic() < deadline:
+                time.sleep(0.005)
+            errors = []
+
+            def expiring():
+                with deadline_scope(0.05):
+                    try:
+                        client.submit(_block([99]))
+                    except DeadlineExceededError as e:
+                        errors.append(e)
+
+            t2 = threading.Thread(target=expiring)
+            t2.start()
+            time.sleep(0.15)
+            gate.set()
+            t1.join(5.0)
+            t2.join(5.0)
+            assert len(errors) == 1
+            assert 99 not in launched
+        finally:
+            client.close()
+            server.close()
+            loop.close()
+
+    def test_oversized_frame_sheds_queue_full(self, shm_stack):
+        _loop, _srv, client, _path = shm_stack
+        with pytest.raises(QueueFullError):
+            client.submit(_block(list(range(300))))  # arena_rows=256
+        # the ring survives the shed
+        assert client.submit(_block([1])).tolist() == [1]
+
+    def test_dead_owner_raises_shm_unavailable(self):
+        loop = _echo_loop()
+        td = tempfile.mkdtemp()
+        path = os.path.join(td, "ctl.sock")
+        server = ShmControlServer(loop, path)
+        client = ShmRingClient(path, arena_rows=64)
+        try:
+            assert client.submit(_block([1])).tolist() == [1]
+            server.close()
+            loop.close()
+            time.sleep(0.1)
+            with pytest.raises(ShmUnavailable):
+                client.submit(_block([2]))
+            assert client.dead
+        finally:
+            client.close()
+
+
+class TestArenaPressureTelemetry:
+    def test_in_process_owned_copy_counted(self):
+        """The in-process ring's owned-copy fallback is no longer
+        silent: overflow_count and the arena high-water mark move."""
+        ring = SubmitRing(slots=64, arena_rows=4)
+        ticket = _Ticket()
+        src = _block([7, 8, 9])
+        ring.publish(src, 3, None, 0.0, ticket, False)  # arena
+        assert ring.overflow_count == 0
+        assert ring.arena_hwm == 3
+        ring.publish(src, 3, None, 0.0, ticket, False)  # overflow copy
+        assert ring.overflow_count == 1
+
+    def test_stats_exported_via_dispatch_scope(self):
+        from api_ratelimit_tpu.stats.sinks import NullSink
+        from api_ratelimit_tpu.stats.store import Store
+
+        store = Store(NullSink())
+        loop = _echo_loop(
+            scope=store.scope("ratelimit"), ring_rows=4, window_seconds=0.0
+        )
+        try:
+            loop.submit(_block([1, 2, 3]))
+            loop.submit(_block([4, 5, 6]))
+            snap = store.debug_snapshot()
+            assert "ratelimit.dispatch.arena_overflow" in snap
+            assert "ratelimit.dispatch.ring.arena_hwm" in snap
+            assert snap["ratelimit.dispatch.ring.arena_hwm"] >= 3
+        finally:
+            loop.close()
+
+    def test_shm_overflow_visible_to_owner(self, shm_stack):
+        loop, _srv, client, _path = shm_stack
+        with pytest.raises(QueueFullError):
+            client.submit(_block(list(range(300))))
+        overflow, hwm = loop.arena_pressure()
+        assert overflow >= 1
+
+
+class TestByteIdenticalRollback:
+    """SHM_RINGS=false must leave the PR-10 submit path untouched: no
+    control socket derivation, no shm client construction, and the
+    socket frames (already pinned byte-for-byte by test_sidecar) as the
+    only path."""
+
+    def test_settings_gate(self):
+        from api_ratelimit_tpu.settings import Settings
+
+        s = Settings()
+        s.sidecar_socket = "/tmp/x.sock"
+        assert s.shm_control_path() == "/tmp/x.sock.shmctl"
+        s.shm_rings = False
+        assert s.shm_control_path() == ""
+        s.shm_rings = True
+        s.sidecar_socket = "tcp://host:1"
+        assert s.shm_control_path() == ""  # shared memory can't cross hosts
+        s.shm_control_sock = "/tmp/ctl.sock"
+        assert s.shm_control_path() == "/tmp/ctl.sock"
+
+    def test_rollback_arm_builds_no_shm_and_matches_results(self, monkeypatch):
+        """Same request stream through an shm-on owner/client pair and a
+        rollback pair: identical verdict bytes; the rollback client must
+        never even construct an ShmRingClient."""
+        from api_ratelimit_tpu.backends import shm_ring as shm_mod
+        from api_ratelimit_tpu.backends.sidecar import (
+            SidecarEngineClient,
+            SlabSidecarServer,
+        )
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+        from api_ratelimit_tpu.utils import FakeTimeSource
+
+        def stream():
+            import random
+
+            rng = random.Random(11)
+            for _ in range(30):
+                n = rng.randrange(1, 6)
+                b = np.zeros((6, n), dtype=np.uint32)
+                b[0] = [rng.randrange(1, 40) for _ in range(n)]
+                b[2] = 1
+                b[3] = rng.randrange(2, 30)
+                b[4] = 60
+                yield b
+
+        results = {}
+        for arm in ("shm", "rollback"):
+            td = tempfile.mkdtemp()
+            sock = os.path.join(td, "s.sock")
+            ctl = sock + ".shmctl"
+            engine = SlabDeviceEngine(
+                FakeTimeSource(700_000),
+                n_slots=1 << 10,
+                use_pallas=False,
+                buckets=(8, 128),
+                batch_window_seconds=0.0005,
+                max_batch=512,
+                block_mode=True,
+            )
+            server = SlabSidecarServer(
+                sock, engine, shm_control_path=ctl if arm == "shm" else ""
+            )
+            if arm == "rollback":
+                def boom(*a, **k):
+                    raise AssertionError(
+                        "rollback arm constructed an ShmRingClient"
+                    )
+
+                monkeypatch.setattr(shm_mod, "ShmRingClient", boom)
+            client = SidecarEngineClient(
+                sock,
+                shm_control_path=ctl if arm == "shm" else "",
+            )
+            if arm == "shm":
+                assert client._shm is not None
+            else:
+                assert client._shm is None
+            got = []
+            try:
+                for b in stream():
+                    got.append(client.submit_rows(b).tobytes())
+            finally:
+                client.close()
+                server.close()
+            results[arm] = got
+            monkeypatch.undo()
+        assert results["shm"] == results["rollback"]
+
+
+_KILL_CHILD = """\
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from api_ratelimit_tpu.backends.shm_ring import ShmRingClient
+from api_ratelimit_tpu.testing.faults import FaultInjector
+
+inj = FaultInjector.from_spec("{site}:delay_ms:30000")
+client = ShmRingClient({path!r}, arena_rows=64, fault_injector=inj)
+b = np.zeros((6, 2), dtype=np.uint32)
+b[2] = [41, 42]
+print("publishing", flush=True)
+client.submit(b)  # parks 30s in the torn-frame window; parent SIGKILLs
+"""
+
+
+class TestChaosSigkillMidPublish:
+    def test_owner_skips_torn_frame_and_survivors_see_zero_failures(self):
+        """SIGKILL a frontend PROCESS exactly between its arena copy and
+        its seqno store (the dispatch.ring_publish fault site holds it
+        there): the owner must never launch the torn frame, must detach
+        the dead ring on the control socket's EOF, must unlink the
+        segment, and every other frontend's requests keep succeeding."""
+        launched: list[int] = []
+
+        def launch(blocks):
+            launched.extend(int(v) for b in blocks for v in b[2])
+            return [np.array(b[2]) for b in blocks]
+
+        loop = DispatchLoop(launch, lambda token: np.concatenate(token))
+        td = tempfile.mkdtemp()
+        path = os.path.join(td, "ctl.sock")
+        server = ShmControlServer(loop, path)
+        survivor = ShmRingClient(path, arena_rows=64)
+        try:
+            # survivor traffic before, during, and after the kill
+            assert survivor.submit(_block([1])).tolist() == [1]
+            child = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _KILL_CHILD.format(
+                        repo=REPO, site=FAULT_SITE_PUBLISH, path=path
+                    ),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            assert child.stdout.readline().strip() == "publishing"
+            time.sleep(0.4)  # child is parked inside the fault delay
+            n_ext_before = len(loop._ext_rings)
+            assert n_ext_before >= 2  # survivor + child rings attached
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait(10.0)
+            # control EOF -> detach; survivor unaffected throughout
+            failures = 0
+            deadline = time.monotonic() + 10.0
+            while len(loop._ext_rings) > 1 and time.monotonic() < deadline:
+                assert survivor.submit(_block([2, 3])).tolist() == [2, 3]
+            assert len(loop._ext_rings) == 1, "dead ring never detached"
+            for _ in range(20):
+                assert survivor.submit(_block([4])).tolist() == [4]
+            assert failures == 0
+            # the torn frame ([41, 42]) must never have launched
+            assert 41 not in launched and 42 not in launched
+        finally:
+            survivor.close()
+            server.close()
+            loop.close()
+        # the dead child's segment was unlinked by the owner
+        import glob
+
+        assert not glob.glob(f"/dev/shm/rlring_{child.pid}_*")
+
+
+_MP_CHILD = """\
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from api_ratelimit_tpu.backends.shm_ring import ShmRingClient
+
+client = ShmRingClient({path!r}, arena_rows=512)
+total = 0
+for i in range(200):
+    b = np.zeros((6, 1), dtype=np.uint32)
+    b[0] = 4242
+    b[2] = 1
+    b[3] = 1 << 30
+    b[4] = 60
+    total = int(client.submit(b)[0])
+print("TOTAL", total, flush=True)
+client.close()
+"""
+
+
+@pytest.mark.mp
+class TestMultiProcessEndToEnd:
+    def test_two_frontend_processes_share_one_exact_counter(self):
+        """Two real frontend PROCESSES increment one key through shm
+        rings into one live engine: the post-increment counters must
+        partition 1..400 exactly — global exactness across processes,
+        the property the whole split exists to keep."""
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+        from api_ratelimit_tpu.utils import FakeTimeSource
+
+        engine = SlabDeviceEngine(
+            FakeTimeSource(700_000),
+            n_slots=1 << 10,
+            use_pallas=False,
+            buckets=(8, 128),
+            batch_window_seconds=0.0005,
+            max_batch=512,
+        )
+        td = tempfile.mkdtemp()
+        path = os.path.join(td, "ctl.sock")
+        server = ShmControlServer(engine.dispatch_loop, path)
+        procs = []
+        try:
+            for _ in range(2):
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-c",
+                            _MP_CHILD.format(repo=REPO, path=path),
+                        ],
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL,
+                        text=True,
+                    )
+                )
+            totals = []
+            for proc in procs:
+                out, _ = proc.communicate(timeout=120)
+                assert proc.returncode == 0, out
+                totals.append(int(out.split()[-1]))
+            # each child's LAST counter: the max must be exactly 400
+            # (200 + 200 increments, no loss, no double count)
+            assert max(totals) == 400, totals
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            server.close()
+            engine.close()
+
+
+@pytest.mark.mp
+@pytest.mark.slow
+class TestFrontendProcessFleet:
+    def test_service_cmd_fleet_serves_and_tears_down(self, tmp_path):
+        """FRONTEND_PROCS=2 through the real entry point: the master
+        spawns a device owner + two frontend worker processes sharing
+        one HTTP port (SO_REUSEPORT); /json answers from the shared
+        slab (counters exact across workers via the one owner), and
+        SIGTERM tears the fleet down cleanly."""
+        import json
+        import socket
+        import urllib.error
+        import urllib.request
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        http_port, grpc_port, debug_port = (
+            free_port(),
+            free_port(),
+            free_port(),
+        )
+        env = dict(os.environ)
+        env.update(
+            {
+                "FRONTEND_PROCS": "2",
+                "BACKEND_TYPE": "tpu",
+                "JAX_PLATFORMS": "cpu",
+                "RUNTIME_ROOT": os.path.join(REPO, "examples", "ratelimit"),
+                "RUNTIME_SUBDIRECTORY": "",
+                "RUNTIME_WATCH_ROOT": "false",
+                "USE_STATSD": "false",
+                "LOG_LEVEL": "WARN",
+                "PORT": str(http_port),
+                "GRPC_PORT": str(grpc_port),
+                "DEBUG_PORT": str(debug_port),
+                "SIDECAR_SOCKET": str(tmp_path / "owner.sock"),
+                "TPU_BATCH_WINDOW": "0.0005",
+                "TPU_SLAB_SLOTS": str(1 << 12),
+                "TPU_BUCKETS": "8,128",
+                "TPU_PRECOMPILE": "false",
+            }
+        )
+        env.pop("XLA_FLAGS", None)
+        master = subprocess.Popen(
+            [sys.executable, "-m", "api_ratelimit_tpu.cmd.service_cmd"],
+            env=env,
+        )
+        url = f"http://localhost:{http_port}/json"
+        body = json.dumps(
+            {
+                "domain": "mongo_cps",
+                "descriptors": [
+                    {"entries": [{"key": "database", "value": "users"}]}
+                ],
+            }
+        ).encode()
+
+        def post():
+            req = urllib.request.Request(
+                url,
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+
+        try:
+            deadline = time.monotonic() + 240.0
+            last_err = None
+            while True:
+                try:
+                    status, out = post()
+                    break
+                except (urllib.error.URLError, ConnectionError, OSError) as e:
+                    last_err = e
+                    assert master.poll() is None, "fleet master died"
+                    assert time.monotonic() < deadline, f"fleet never served: {last_err}"
+                    time.sleep(0.5)
+            assert status == 200
+            assert out["overallCode"] == "OK"
+            # a burst across the shared port: every answer OK, the fleet
+            # stays alive (whichever worker the kernel picks, the slab
+            # behind them is the one device owner)
+            for _ in range(30):
+                status, out = post()
+                assert status == 200, out
+                assert out["overallCode"] == "OK"
+            assert master.poll() is None
+        finally:
+            master.terminate()
+            try:
+                master.wait(30.0)
+            except subprocess.TimeoutExpired:
+                master.kill()
+                master.wait()
+        assert master.returncode is not None

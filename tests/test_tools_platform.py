@@ -169,3 +169,24 @@ class TestHotpathProfile:
         assert header, "pstats table header missing"
         # the profiled thread is the OWNER loop, not the request thread
         assert any("dispatch.py" in ln and "_run" in ln for ln in lines)
+
+    def test_frontend_arm_reports_native_split(self):
+        """--frontend: one worker's decode→match→compose→publish loop
+        over shm rings to a local owner, with the [native_split] line
+        naming which stages ran native."""
+        proc = _run_tool(
+            "tools.hotpath_profile", ("-n", "120", "--top", "8", "--frontend")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "path=frontend-shm" in proc.stdout
+        lines = proc.stdout.splitlines()
+        split = [ln for ln in lines if ln.startswith("[native_split]")]
+        assert split, proc.stdout[-300:]
+        # with the toolchain baked into this image the whole loop is
+        # native end to end: codec + matcher + shm submit
+        assert "codec=native" in split[0]
+        assert "matcher=native" in split[0]
+        assert "submit=shm" in split[0]
+        header = [ln for ln in lines if "ncalls" in ln and "tottime" in ln]
+        assert header, "pstats table header missing"
+        assert any("shm_ring.py" in ln for ln in lines)

@@ -63,6 +63,13 @@ def main(argv=None) -> int:
         "the request thread (DISPATCH_PROFILE=1)",
     )
     parser.add_argument(
+        "--frontend",
+        action="store_true",
+        help="profile one FRONTEND WORKER's hot loop end to end "
+        "(decode -> match -> compose -> publish over shm rings to a "
+        "local device owner) and print the native-vs-python split",
+    )
+    parser.add_argument(
         "--pyinstrument",
         action="store_true",
         help="wall-clock sampling profile instead of cProfile",
@@ -77,6 +84,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     sys.path.insert(0, REPO)
+    if args.frontend:
+        return _run_frontend_profile(args)
     if args.dispatch:
         # must be set BEFORE the service (and its DispatchLoop thread)
         # is built: the owner thread reads it once at startup
@@ -193,6 +202,111 @@ def _run_dispatch_profile(service, cache, reqs, args) -> int:
     stats.sort_stats(args.sort).print_stats(args.top)
     print(out.getvalue())
     return 0
+
+
+def _run_frontend_profile(args) -> int:
+    """The FRONTEND_PROCS worker's view: a sidecar-backed service whose
+    submits publish over shm rings to a device owner (running here on
+    background threads, so the profiled REQUEST thread sees exactly what
+    a worker process's handler thread sees: transport decode -> compiled
+    matcher -> key compose -> row write -> shm publish -> verdict spin).
+    Prints the standard pstats table plus a [native_split] block: which
+    hot-loop stages run native and the per-stage ns from the runtime
+    histograms.
+
+    Output contract (pinned by tests/test_tools_platform.py): the
+    `[hotpath] ... path=frontend-shm` line, a `[native_split]` line, then
+    the pstats header row."""
+    import tempfile
+
+    import numpy as np  # noqa: F401 - bench pulls it anyway
+
+    import bench
+    from api_ratelimit_tpu.backends.sidecar import (
+        SidecarEngineClient,
+        SlabSidecarServer,
+    )
+    from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, TpuRateLimitCache
+    from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+    from api_ratelimit_tpu.ops import native
+    from api_ratelimit_tpu.service.ratelimit import RateLimitService
+    from api_ratelimit_tpu.stats.sinks import NullSink
+    from api_ratelimit_tpu.stats.store import Store
+    from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+    td = tempfile.mkdtemp()
+    sock = os.path.join(td, "owner.sock")
+    ctl = sock + ".shmctl"
+    engine = SlabDeviceEngine(
+        RealTimeSource(),
+        n_slots=1 << 16,
+        use_pallas=False,
+        buckets=(8, 128, 1024),
+        batch_window_seconds=0.0005,
+        max_batch=8192,
+        block_mode=True,
+    )
+    server = SlabSidecarServer(sock, engine, shm_control_path=ctl)
+    store = Store(NullSink())
+    scope = store.scope("ratelimit")
+    client = SidecarEngineClient(sock, scope=scope, shm_control_path=ctl)
+    cache = TpuRateLimitCache(
+        BaseRateLimiter(RealTimeSource()), engine=client
+    )
+    service = RateLimitService(
+        runtime=bench._StaticRuntime(bench._FLAT),
+        cache=cache,
+        stats_scope=scope.scope("service"),
+        time_source=RealTimeSource(),
+    )
+    reqs = bench._requests_for("flat_per_second", 2048)
+    for request in reqs[:64]:
+        service.should_rate_limit(request)
+    try:
+        prof = cProfile.Profile()
+        t0 = time.perf_counter()
+        prof.enable()
+        for i in range(args.n):
+            service.should_rate_limit(reqs[i % len(reqs)])
+        prof.disable()
+        elapsed = time.perf_counter() - t0
+        print(
+            f"[hotpath] rate={round(args.n / elapsed)}/s "
+            f"requests={args.n} path=frontend-shm"
+        )
+        config = service.get_current_config()
+        matcher_native = bool(
+            config is not None
+            and getattr(config.compiled, "native_active", False)
+        )
+        shm_active = client._shm is not None and not client._shm.dead
+        print(
+            f"[native_split] codec={'native' if native.available() else 'python'} "
+            f"matcher={'native' if matcher_native else 'python'} "
+            f"submit={'shm' if shm_active else 'socket'}"
+        )
+        snap = store.debug_snapshot()
+        for label, key in (
+            ("matcher_ns", "ratelimit.service.host.matcher_ms"),
+            ("key_compose_ns", "ratelimit.host.key_compose_ms"),
+            ("pack_ns", "ratelimit.host.pack_ms"),
+            ("shm_submit_ns", "ratelimit.sidecar.shm_ms"),
+        ):
+            p50 = snap.get(f"{key}.p50")
+            p99 = snap.get(f"{key}.p99")
+            if p50 is None:
+                continue
+            print(
+                f"  {label:<15} p50={round(p50 * 1e6)} p99={round(p99 * 1e6)}"
+            )
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats(args.sort).print_stats(args.top)
+        print(out.getvalue())
+        return 0
+    finally:
+        cache.close()
+        server.close()
 
 
 def _run_pyinstrument(service, reqs, args) -> int:
