@@ -485,7 +485,19 @@ class LeaseTable:
 
     def plan_grant(self, rec, hits_addend: int, now: int) -> PlannedGrant | None:
         """Decide whether this descriptor's device row should carry a lease
-        INCRBY rider, and how big. Returns None for no grant."""
+        INCRBY rider, and how big. Returns None for no grant.
+
+        Per-algorithm lease story: fixed/sliding-window leases are counter
+        slices of the current window (the original semantics). A GCRA
+        lease is a TAT SLICE — the rider's extra hits advance the
+        theoretical arrival time by size*T, reserving that many emissions
+        for frontend-local admission (a denied rider reserved nothing and
+        is aborted by the caller, backends/tpu.py). CONCURRENCY is never
+        leased: in-flight slots must be released, and a frontend-local
+        slot could never observe another frontend's Release — every
+        acquire/release goes to the device."""
+        if getattr(rec, "algorithm", 0) == 3:  # ALGO_ID_CONCURRENCY
+            return None
         divider = rec.divider
         window = (now // divider) * divider
         limit = rec.requests_per_unit

@@ -29,6 +29,12 @@ connection; frontends pool connections for concurrency):
   request:  u32 magic 'RLSC' | u8 version=1 | u8 op | u16 flags
             op 1 SUBMIT: u32 n | uint32[6, n] C-order
                          rows: fp_lo, fp_hi, hits, limit, divider, jitter
+                         (the divider word carries the rule's decision-
+                         algorithm id in bits 28-30 — ops/slab.py ALGO_* —
+                         including concurrency Release riders (id 4), so
+                         the algorithm subsystem rides this wire with
+                         ZERO format change; fixed_window is id 0 and
+                         pre-algorithm frames are bit-identical)
                          flags bit 1 (FLAG_LEASE): a lease-ops trailer
                          follows the block — u32 len | the LeaseOps body
                          (backends/lease.py encode_lease_ops: grant/renew

@@ -42,12 +42,20 @@ from ..assertx import assert_
 from ..limiter.base_limiter import BaseRateLimiter, LimitInfo
 from ..limiter.cache import CacheError
 from ..limiter.cache_key import generate_cache_key
-from ..models.config import RateLimit
+from ..models.config import (
+    ALGO_ID_CONCURRENCY,
+    ALGO_ID_GCRA,
+    RateLimit,
+)
+from ..tracing import journeys
 from ..models.descriptors import RateLimitRequest
 from ..models.response import DoLimitResponse
 from ..models.units import unit_to_divider
 from ..ops.hashing import fingerprint_many, split_fingerprints
 from ..ops.slab import (
+    ALGO_CONC_RELEASE,
+    ALGO_SHIFT,
+    HEALTH_ALGO_RESETS,
     HEALTH_DROPS,
     HEALTH_EVICT_EXPIRED,
     HEALTH_EVICT_LIVE,
@@ -83,6 +91,17 @@ def _loss_ppm(snap: dict) -> int:
     return round(
         (snap["evictions_live"] + snap["drops"]) / decisions * 1_000_000
     )
+
+
+# journey stage tags: which decision algorithm denied/decided a request —
+# the flight recorder renders these so a slow or shed journey shows the
+# algorithm class it hit (tracing/journeys.py)
+ALGO_JOURNEY_STAGES = {
+    0: "algo_fixed_window",
+    1: "algo_sliding_window",
+    2: "algo_gcra",
+    3: "algo_concurrency",
+}
 
 
 @dataclasses.dataclass(slots=True)
@@ -123,6 +142,7 @@ class SlabDeviceEngine:
         fault_injector=None,
         precompile: bool = False,
         dispatch_loop: bool = True,
+        gcra_burst_ratio: float = 1.0,
     ):
         """scope: optional stats Scope rooted at the service prefix (e.g.
         the runner's `ratelimit` scope). When set, the engine records the
@@ -165,6 +185,15 @@ class SlabDeviceEngine:
         warning.)"""
         self._time_source = time_source
         self._near_limit_ratio = float(near_limit_ratio)
+        # GCRA burst tolerance knob (GCRA_BURST_RATIO): tau =
+        # ratio * window_ms - T. Rides launch-operand scalar slot 2.
+        self._gcra_burst_ratio = float(gcra_burst_ratio)
+        # Sticky algorithms guard: the Mosaic kernels implement
+        # fixed_window only, so the FIRST launch (or restored table) that
+        # carries a non-fixed algorithm id flips this engine's launches to
+        # the XLA twin permanently — an all-fixed config never flips it,
+        # keeping the pallas rollback arm bit-identical.
+        self._algos_seen = False
         if device is None:
             device = jax.devices()[0]
         # placement invariant: the slab state is committed to `device` once
@@ -356,6 +385,7 @@ class SlabDeviceEngine:
                 "evictions_window": self._health_totals[HEALTH_EVICT_WINDOW],
                 "evictions_live": self._health_totals[HEALTH_EVICT_LIVE],
                 "drops": self._health_totals[HEALTH_DROPS],
+                "algo_resets": self._health_totals[HEALTH_ALGO_RESETS],
                 "decisions": self._decisions_total,
                 "live_slots": live,
                 "occupancy": live / self._n_slots,
@@ -648,6 +678,12 @@ class SlabDeviceEngine:
                 f"snapshot table shape {rows.shape} does not match the "
                 f"configured slab ({self._n_slots}, {ROW_WIDTH})"
             )
+        if not self._algos_seen and int(rows[:, 5].max(initial=0)) >= (
+            1 << ALGO_SHIFT
+        ):
+            # restored rows carry non-fixed algorithms: the table is no
+            # longer pallas-safe even before the first such launch
+            self._algos_seen = True
         with self._state_lock:
             self._state = jax.device_put(
                 slab_import_rows(rows), self._device
@@ -700,6 +736,20 @@ class SlabDeviceEngine:
         t_launch = time.perf_counter() if self._h_launch is not None else 0.0
         if n:  # precompile dispatches empty warmers; keep the ring honest
             self.launch_sizes.append(n)
+            if not self._algos_seen and int(packed[4, :n].max()) >= (
+                1 << ALGO_SHIFT
+            ):
+                # first non-fixed algorithm: route every launch from here
+                # on through the XLA twin (the Mosaic kernels are
+                # fixed_window-only). One .max() over a row slice — no
+                # temporaries, sub-microsecond at any bucket size.
+                self._algos_seen = True
+                if self._use_pallas:
+                    _log.info(
+                        "non-fixed rate-limit algorithm on the wire: "
+                        "launches now run the XLA kernels (the pallas "
+                        "fixed-window kernels stay for all-fixed configs)"
+                    )
         if self._engine is not None:
             token = self._engine.launch_after_compact(packed, cap)
             # counted after the launch returns, like the single-device path:
@@ -714,6 +764,7 @@ class SlabDeviceEngine:
             if cap == 0xFF
             else jnp.uint16 if cap == 0xFFFF else jnp.uint32
         )
+        use_pallas = self._use_pallas and not self._algos_seen
         with self._state_lock:
             # the numpy block rides the jit call directly — the committed
             # state array pins placement, and skipping the separate
@@ -725,12 +776,16 @@ class SlabDeviceEngine:
                     packed,
                     ways=self._ways,
                     out_dtype=dtype,
-                    use_pallas=self._use_pallas,
+                    use_pallas=use_pallas,
+                    # static: until a non-fixed row appears, compile the
+                    # exact pre-algorithm program (zero added compute on
+                    # the all-fixed arm); the sticky flip recompiles once
+                    multi_algo=self._algos_seen,
                 )
-                if self._use_pallas:
+                if use_pallas:
                     self._pallas_proven = True
             except Exception as e:
-                if not self._use_pallas or self._pallas_proven:
+                if not use_pallas or self._pallas_proven:
                     raise
                 # Mosaic rejected the kernel (or Pallas is unavailable on
                 # this platform): flip to the XLA twin permanently instead
@@ -750,6 +805,7 @@ class SlabDeviceEngine:
                     ways=self._ways,
                     out_dtype=dtype,
                     use_pallas=False,
+                    multi_algo=self._algos_seen,
                 )
             self._pending_health.append(health)
             self._decisions_total += n
@@ -867,11 +923,13 @@ class SlabDeviceEngine:
                 chunks.append((packed, n))
         now = np.uint32(self._time_source.unix_now())
         ratio = np.float32(self._near_limit_ratio).view(np.uint32)
+        burst = np.float32(self._gcra_burst_ratio).view(np.uint32)
         for packed, n in chunks:
             maxv = int(packed[2, :n].max()) + int(packed[3, :n].max())
             cap = 0xFF if maxv < 255 else 0xFFFF if maxv < 65535 else 0xFFFFFFFF
             packed[6, 0] = now
             packed[6, 1] = ratio
+            packed[6, 2] = burst  # GCRA burst-ratio scalar (ops/slab.py)
             yield packed, n, cap
 
     def _execute_blocks(self, blocks: list[np.ndarray]) -> np.ndarray:
@@ -948,6 +1006,9 @@ class SlabHealthStats:
                                           — the ONLY lossy tier (the
                                           evicted key fails open)
         ratelimit.slab.drops       cumulative in-batch contention drops
+        ratelimit.slab.algo_resets rows reset because a config reload
+                                   changed their rule's ALGORITHM mid-
+                                   flight (fp matched, semantics did not)
         ratelimit.slab.decisions   cumulative decisions submitted on-device
         ratelimit.slab.loss_ppm    (evictions.live + drops) per million
                                    decisions over the window SINCE THE
@@ -988,6 +1049,7 @@ class SlabHealthStats:
             "evictions_window": scope.gauge("evictions.window"),
             "evictions_live": scope.gauge("evictions.live"),
             "drops": scope.gauge("drops"),
+            "algo_resets": scope.gauge("algo_resets"),
             "decisions": scope.gauge("decisions"),
             "loss_ppm": scope.gauge("loss_ppm"),
             "live_slots": scope.gauge("live_slots"),
@@ -1002,8 +1064,9 @@ class SlabHealthStats:
             "evictions_window",
             "evictions_live",
             "drops",
+            "algo_resets",
         ):
-            self._gauges[k].set(snap[k])
+            self._gauges[k].set(snap.get(k, 0))
         self._gauges["decisions"].set(snap.get("decisions", 0))
         delta = {k: snap.get(k, 0) - v for k, v in self._last.items()}
         self._last = {k: snap.get(k, 0) for k in self._last}
@@ -1036,6 +1099,7 @@ class TpuRateLimitCache:
         precompile: bool = False,
         dispatch_loop: bool = True,
         lease_table=None,
+        gcra_burst_ratio: float = 1.0,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -1089,8 +1153,34 @@ class TpuRateLimitCache:
                 fault_injector=fault_injector,
                 precompile=precompile,
                 dispatch_loop=dispatch_loop,
+                gcra_burst_ratio=gcra_burst_ratio,
             )
         self._engine_core = engine
+        # per-algorithm decision stats (ratelimit.algo.<name>.{decisions,
+        # over_limit}): which decision kernel is carrying the traffic, and
+        # which one is denying it — the per-rule stats can't answer that
+        # without knowing every rule's algorithm by heart
+        self._algo_stats = None
+        if stats_scope is not None:
+            algo_scope = stats_scope.scope("algo")
+            self._algo_stats = {
+                0: (
+                    algo_scope.counter("fixed_window.decisions"),
+                    algo_scope.counter("fixed_window.over_limit"),
+                ),
+                1: (
+                    algo_scope.counter("sliding_window.decisions"),
+                    algo_scope.counter("sliding_window.over_limit"),
+                ),
+                2: (
+                    algo_scope.counter("gcra.decisions"),
+                    algo_scope.counter("gcra.over_limit"),
+                ),
+                3: (
+                    algo_scope.counter("concurrency.decisions"),
+                    algo_scope.counter("concurrency.over_limit"),
+                ),
+            }
         # zero-object row verb when the engine has one (the in-process
         # engine and the sidecar client both do; exotic test engines fall
         # back to the _Item conversion)
@@ -1301,8 +1391,14 @@ class TpuRateLimitCache:
                 key = rec.key_prefix + str((now // divider) * divider)
                 keys[i] = key
                 # shadow rules never consult the over-limit cache
-                # (base_limiter.is_over_limit_with_local_cache rationale)
-                if not rec.shadow_mode and local_cache.contains(key):
+                # (base_limiter.is_over_limit_with_local_cache rationale);
+                # neither do concurrency caps — a denial is not sticky for
+                # a window there: the next Release can free a slot
+                if (
+                    not rec.shadow_mode
+                    and rec.algorithm != ALGO_ID_CONCURRENCY
+                    and local_cache.contains(key)
+                ):
                     if over_local is None:
                         over_local = [False] * n
                     over_local[i] = True
@@ -1312,7 +1408,10 @@ class TpuRateLimitCache:
                 rec.fp_hi,
                 hits_addend,
                 rec.requests_per_unit,
-                divider,
+                # window length + algorithm id in one word (precomposed;
+                # == divider for fixed_window, so the default config's
+                # wire frames are byte-identical)
+                rec.wire_divider,
                 base.expiration_seconds(divider) - divider,
             )
             if lease is not None:
@@ -1379,7 +1478,19 @@ class TpuRateLimitCache:
             # install each granted lease and strip its rider from the
             # caller's own post-increment position (after - lease_n)
             for pos, planned in grants:
-                afters[pos] = lease.register_grant(planned, afters[pos])
+                after_total = afters[pos]
+                if (
+                    int(block[4, pos]) >> ALGO_SHIFT
+                ) == ALGO_ID_GCRA and after_total > int(block[3, pos]):
+                    # a DENIED GCRA rider reserved nothing: denials never
+                    # advance the TAT, so the slice does not exist —
+                    # installing it would serve denials locally until its
+                    # TTL even after the TAT drains. Abort instead; the
+                    # next miss plans a fresh slice.
+                    lease.abort_grant(planned)
+                    afters[pos] = after_total - planned.size
+                else:
+                    afters[pos] = lease.register_grant(planned, after_total)
         if span is not None:
             span.log_kv(event="tpu.lookup.done", client="slab")
 
@@ -1387,6 +1498,7 @@ class TpuRateLimitCache:
         response = DoLimitResponse()
         statuses = response.descriptor_statuses
         get_status = base.get_response_descriptor_status
+        algo_stats = self._algo_stats
         pos = 0
         for i in range(n):
             rec = resolved[i]
@@ -1397,6 +1509,10 @@ class TpuRateLimitCache:
                 continue
             limit = rec.limit
             if over_local is not None and over_local[i]:
+                if algo_stats is not None:
+                    dec_c, over_c = algo_stats[rec.algorithm]
+                    dec_c.add(1)
+                    over_c.add(1)
                 statuses.append(
                     get_status(
                         keys[i],
@@ -1409,6 +1525,14 @@ class TpuRateLimitCache:
                 continue
             after = afters[pos]
             pos += 1
+            if algo_stats is not None:
+                dec_c, over_c = algo_stats[rec.algorithm]
+                dec_c.add(1)
+                if after > rec.requests_per_unit:
+                    over_c.add(1)
+                    # flight-recorder breadcrumb: which algorithm decided
+                    # this (possibly slow/shed) request's denial
+                    journeys.mark(ALGO_JOURNEY_STAGES[rec.algorithm])
             info = LimitInfo(limit, after - hits_addend, after)
             if local_cache is not None:
                 key = keys[i]
@@ -1432,6 +1556,40 @@ class TpuRateLimitCache:
             self._h_response.record((time.perf_counter() - t0) * 1e3)
         assert_(len(statuses) == n)
         return response
+
+    def do_release(self, request, resolved) -> int:
+        """Concurrency Release: one negative-rider row per resolved
+        CONCURRENCY descriptor, riding the unmodified row-block/dispatch
+        wire (algorithm id ALGO_CONC_RELEASE in the divider word — the
+        sidecar and shm-ring paths carry it with zero format change). The
+        device decrements the key's in-flight count, flooring at 0.
+        Returns the number of release rows submitted; descriptors whose
+        rule is not a concurrency cap are ignored. Callers that die
+        without releasing are covered by the row's idle TTL
+        (CONCURRENCY_TTL_S): an untouched key's whole row is reclaimed
+        and its in-flight count restarts at zero."""
+        hits_addend = max(1, request.hits_addend)
+        base = self._base
+        block = self._scratch_block(len(resolved))
+        count = 0
+        for rec in resolved:
+            if rec is None or rec.algorithm != ALGO_ID_CONCURRENCY:
+                continue
+            block[:, count] = (
+                rec.fp_lo,
+                rec.fp_hi,
+                hits_addend,
+                rec.requests_per_unit,
+                rec.divider | (ALGO_CONC_RELEASE << ALGO_SHIFT),
+                base.expiration_seconds(rec.divider) - rec.divider,
+            )
+            count += 1
+        if count:
+            if self._submit_rows is not None:
+                self._submit_rows(block[:, :count])
+            else:
+                self._engine_core.submit(_block_to_items(block[:, :count]))
+        return count
 
     def flush(self) -> None:
         self._engine_core.flush()

@@ -48,7 +48,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..models.config import RateLimit, RateLimitStats
+from ..models.config import ALGORITHM_IDS, RateLimit, RateLimitStats
 from ..models.descriptors import Descriptor, Entry
 from ..models.units import Unit, unit_to_divider
 from ..ops.hashing import fingerprint64
@@ -68,7 +68,16 @@ class ResolvedLimit:
     """One descriptor's fully-resolved hot-path record, frozen at first
     resolution. `fp` is fingerprint64(domain, entries, divider) — the slab
     identity the device probes on; `key_prefix` + str(window_start) is the
-    exact string limiter/cache_key.py would compose."""
+    exact string limiter/cache_key.py would compose.
+
+    `algorithm` is the decision-kernel id (models/config.py ALGORITHM_IDS)
+    and `wire_divider` the precomposed divider word the row block ships —
+    window length in bits 0-27, algorithm id in bits 28-30 (ops/slab.py
+    ALGO_SHIFT). For fixed_window (id 0) wire_divider == divider, so the
+    default config's wire frames are byte-identical to the pre-algorithm
+    engine. The algorithm does NOT feed the fingerprint: a reload that
+    only changes a rule's algorithm keeps hitting the same slab row, which
+    resets its state in-kernel (counted as ratelimit.slab.algo_resets)."""
 
     limit: RateLimit
     stats: RateLimitStats
@@ -82,6 +91,8 @@ class ResolvedLimit:
     sleep_on_throttle: bool
     report_details: bool
     per_second: bool
+    algorithm: int
+    wire_divider: int
 
 
 def _key_prefix(domain: str, entries: tuple[Entry, ...]) -> str:
@@ -94,10 +105,16 @@ def _key_prefix(domain: str, entries: tuple[Entry, ...]) -> str:
     return "_".join(parts) + "_"
 
 
+_ALGO_SHIFT = 28  # ops/slab.py ALGO_SHIFT twin (no jax import here)
+
+
 def _make_record(
     domain: str, entries: tuple[Entry, ...], limit: RateLimit
 ) -> ResolvedLimit:
-    divider = unit_to_divider(limit.unit)
+    # window_override_s carries a concurrency rule's idle TTL (those rules
+    # have no unit); everything else derives the window from the unit
+    divider = limit.window_override_s or unit_to_divider(limit.unit)
+    algorithm = ALGORITHM_IDS.get(limit.algorithm, 0)
     fp = fingerprint64(domain, entries, divider)
     return ResolvedLimit(
         limit=limit,
@@ -112,6 +129,8 @@ def _make_record(
         sleep_on_throttle=limit.sleep_on_throttle,
         report_details=limit.report_details,
         per_second=limit.unit == Unit.SECOND,
+        algorithm=algorithm,
+        wire_divider=divider | (algorithm << _ALGO_SHIFT),
     )
 
 

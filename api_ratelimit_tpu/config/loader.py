@@ -32,7 +32,13 @@ from typing import Iterable, Protocol
 
 import yaml
 
-from ..models.config import ConfigError, RateLimit, new_rate_limit_stats
+from ..models.config import (
+    ALGORITHM_IDS,
+    DEFAULT_CONCURRENCY_TTL_S,
+    ConfigError,
+    RateLimit,
+    new_rate_limit_stats,
+)
 from ..models.descriptors import Descriptor
 from ..models.response import RateLimitValue
 from ..models.units import Unit, unit_from_string
@@ -46,6 +52,7 @@ _VALID_KEYS = frozenset(
         "rate_limit",
         "unit",
         "requests_per_unit",
+        "algorithm",
         "sleep_on_throttle",
         "report_details",
         "shadow_mode",
@@ -105,7 +112,7 @@ _DESCRIPTOR_KEYS = frozenset(
         "shadow_mode",
     }
 )
-_RATE_LIMIT_KEYS = frozenset({"unit", "requests_per_unit"})
+_RATE_LIMIT_KEYS = frozenset({"unit", "requests_per_unit", "algorithm"})
 
 
 def _validate_keys(file: ConfigFile, node, allowed=_ROOT_KEYS, ctx="the file root") -> None:
@@ -146,9 +153,15 @@ class RateLimitConfig:
     precomputed record. The raw walker stays available as get_limit_tree —
     it is the memo-miss fallback and the differential-fuzz oracle."""
 
-    def __init__(self, files: Iterable[ConfigFile], stats_scope):
+    def __init__(
+        self,
+        files: Iterable[ConfigFile],
+        stats_scope,
+        concurrency_ttl_s: int = DEFAULT_CONCURRENCY_TTL_S,
+    ):
         self._domains: dict[str, _Node] = {}
         self._stats_scope = stats_scope
+        self._concurrency_ttl_s = int(concurrency_ttl_s)
         for file in files:
             self._load_file(file)
         from .compiled import CompiledMatcher
@@ -205,10 +218,42 @@ class RateLimitConfig:
             if rate_limit is not None:
                 if not isinstance(rate_limit, dict):
                     raise _error(file, "error loading config file: rate_limit must be a map")
+                # decision algorithm: strict whitelist — an unknown value
+                # must fail the LOAD (the reload handler keeps the last
+                # good config), never silently become fixed_window
+                algo_raw = rate_limit.get("algorithm")
+                if algo_raw is None:
+                    algorithm = "fixed_window"
+                elif (
+                    not isinstance(algo_raw, str)
+                    or algo_raw not in ALGORITHM_IDS
+                ):
+                    raise _error(
+                        file,
+                        f"invalid rate limit algorithm {algo_raw!r} "
+                        f"(valid: {', '.join(sorted(ALGORITHM_IDS))})",
+                    )
+                else:
+                    algorithm = algo_raw
                 unit_name = rate_limit.get("unit")
-                unit = unit_from_string(str(unit_name)) if unit_name is not None else None
-                if unit is None:
-                    raise _error(file, f"invalid rate limit unit '{unit_name}'")
+                if algorithm == "concurrency":
+                    # a concurrency cap bounds IN-FLIGHT requests: it has
+                    # no time window, so a unit is an illegal combo, not a
+                    # value to quietly ignore. Internally the rule carries
+                    # Unit.SECOND as a placeholder (response plumbing needs
+                    # one) and its idle TTL in window_override_s.
+                    if unit_name is not None:
+                        raise _error(
+                            file,
+                            "config error, algorithm 'concurrency' caps "
+                            "in-flight requests and takes no 'unit' "
+                            f"(got unit '{unit_name}')",
+                        )
+                    unit = Unit.SECOND
+                else:
+                    unit = unit_from_string(str(unit_name)) if unit_name is not None else None
+                    if unit is None:
+                        raise _error(file, f"invalid rate limit unit '{unit_name}'")
                 # Strict like the reference's uint32 unmarshal
                 # (config_impl.go:25 requests_per_unit uint32): a
                 # non-integer, negative, or >u32 value is a config error —
@@ -239,6 +284,12 @@ class RateLimitConfig:
                     sleep_on_throttle=bool(desc.get("sleep_on_throttle") or False),
                     report_details=bool(desc.get("report_details") or False),
                     shadow_mode=bool(desc.get("shadow_mode") or False),
+                    algorithm=algorithm,
+                    window_override_s=(
+                        self._concurrency_ttl_s
+                        if algorithm == "concurrency"
+                        else 0
+                    ),
                 )
 
             child = _Node()
@@ -256,6 +307,8 @@ class RateLimitConfig:
         sleep_on_throttle: bool = False,
         report_details: bool = False,
         shadow_mode: bool = False,
+        algorithm: str = "fixed_window",
+        window_override_s: int = 0,
     ) -> RateLimit:
         return RateLimit(
             full_key=full_key,
@@ -264,6 +317,8 @@ class RateLimitConfig:
             sleep_on_throttle=sleep_on_throttle,
             report_details=report_details,
             shadow_mode=shadow_mode,
+            algorithm=algorithm,
+            window_override_s=window_override_s,
         )
 
     # -- lookup --
@@ -328,6 +383,14 @@ class RateLimitConfigLoader(Protocol):
     def load(self, files: list[ConfigFile], stats_scope) -> RateLimitConfig: ...
 
 
-def load_config(files: list[ConfigFile], stats_scope) -> RateLimitConfig:
-    """Default loader (config_impl.go:342-346 equivalent)."""
-    return RateLimitConfig(files, stats_scope)
+def load_config(
+    files: list[ConfigFile],
+    stats_scope,
+    concurrency_ttl_s: int = DEFAULT_CONCURRENCY_TTL_S,
+) -> RateLimitConfig:
+    """Default loader (config_impl.go:342-346 equivalent).
+    concurrency_ttl_s (CONCURRENCY_TTL_S) is the idle TTL stamped into
+    concurrency rules' window_override_s — the leak-reclamation bound."""
+    return RateLimitConfig(
+        files, stats_scope, concurrency_ttl_s=concurrency_ttl_s
+    )

@@ -137,6 +137,11 @@ class BaseRateLimiter:
         # fabricate the staging metrics the operator is watching.
         if limit is not None and limit.shadow_mode:
             return False
+        # concurrency caps never cache denials: the very next Release can
+        # free a slot, so a window-stamped "over" entry would deny callers
+        # the cap no longer rejects
+        if limit is not None and limit.algorithm == "concurrency":
+            return False
         return self.local_cache is not None and self.local_cache.contains(key)
 
     def expiration_seconds(self, divider: int) -> int:
@@ -184,7 +189,11 @@ class BaseRateLimiter:
                 duration_until_reset=calculate_reset(limit.unit, now),
             )
             self._check_over_limit_threshold(limit_info, hits_addend)
-            if self.local_cache is not None and not limit.shadow_mode:
+            if (
+                self.local_cache is not None
+                and not limit.shadow_mode
+                and limit.algorithm != "concurrency"
+            ):
                 # TTL = the full unit duration; the window-stamped key ages out
                 # naturally at the window boundary. Shadow-mode rules skip the
                 # cache: its hits short-circuit evaluation, and a staged rule

@@ -18,6 +18,30 @@ class ConfigError(Exception):
     reference). Raised during load; callers keep the last good config."""
 
 
+# Canonical per-rule decision algorithms and their wire ids — the SAME ids
+# ops/slab.py carries in bits 28-30 of the divider word (tests pin the
+# equivalence; redeclared here so the config layer never imports jax).
+# fixed_window is the reference semantics and the default; the rest are the
+# sibling kernels: sliding_window (two-window interpolation — no 2x
+# boundary burst), gcra (token bucket via theoretical arrival time), and
+# concurrency (in-flight cap with a Release path).
+ALGORITHM_IDS = {
+    "fixed_window": 0,
+    "sliding_window": 1,
+    "gcra": 2,
+    "concurrency": 3,
+}
+ALGO_ID_FIXED_WINDOW = 0
+ALGO_ID_SLIDING_WINDOW = 1
+ALGO_ID_GCRA = 2
+ALGO_ID_CONCURRENCY = 3
+
+# Idle TTL for concurrency rows when CONCURRENCY_TTL_S is not configured:
+# a key whose holders all died without releasing stops being touched and
+# its whole row is reclaimed after this long — the leak bound.
+DEFAULT_CONCURRENCY_TTL_S = 60
+
+
 @dataclass(slots=True)
 class RateLimitStats:
     """Per-rule counters: total_hits / over_limit / near_limit /
@@ -52,6 +76,11 @@ class RateLimit:
     shadow_mode evaluates and counts the rule but never enforces it: the
     descriptor status is always OK, so operators can stage limits against
     live traffic before turning them on.
+
+    algorithm selects the decision kernel (ALGORITHM_IDS above;
+    "fixed_window" default). window_override_s, when nonzero, replaces
+    the unit-derived window length — concurrency rules carry their idle
+    TTL here (they have no unit; the loader rejects one).
     """
 
     full_key: str
@@ -60,6 +89,8 @@ class RateLimit:
     sleep_on_throttle: bool = False
     report_details: bool = False
     shadow_mode: bool = False
+    algorithm: str = "fixed_window"
+    window_override_s: int = 0
 
     @property
     def requests_per_unit(self) -> int:

@@ -13,11 +13,24 @@ default 128 — one full TPU lane register per set):
 
     col 0: fp_lo      64-bit key fingerprint, low half
     col 1: fp_hi      high half
-    col 2: count      fixed-window counter
-    col 3: window     window start (unix s) the counter belongs to
-    col 4: expire_at  slot reclaim time (window TTL + jitter)
-    col 5: divider    window length (s) — classifies window-ended rows
-    col 6-7: reserved
+    col 2: count      fixed/sliding-window counter; concurrency in-flight
+                      count; GCRA TAT headroom in emission intervals (the
+                      eviction valuation — a live GCRA row's "count" is how
+                      much of its burst budget is spoken for, not a window
+                      counter)
+    col 3: window     window start (unix s); GCRA: tat_sec - divider (so
+                      window + divider <= now <=> TAT drained — the
+                      window-ended eviction/reconcile rules classify a
+                      drained TAT with zero new code); concurrency: last
+                      touch (unix s)
+    col 4: expire_at  slot reclaim time (window TTL + jitter; 2 windows for
+                      sliding so the prev count survives into interpolation;
+                      idle TTL for concurrency — the leak reclamation)
+    col 5: divider    window length (s) in bits 0-27; the ALGORITHM id in
+                      bits 28-30 (ALGO_* below — 0 = fixed_window, so every
+                      pre-algorithm row and wire frame reads back unchanged)
+    col 6: prev/tat   sliding: previous window's count; GCRA: TAT unix s
+    col 7: aux        GCRA: TAT millisecond remainder (0..999)
 
 A key lives ONLY in set `fp_lo mod n_sets` (ops/hashing.py set_index — the
 set-index split of the fingerprint; the full (lo, hi) pair stays the stored
@@ -82,6 +95,61 @@ from .decide import DecideResult, decide, floor_div_exact_i32
 
 ROW_WIDTH = 8
 COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
+COL_PREV, COL_AUX = 6, 7
+
+# --- sibling decision algorithms -------------------------------------------
+#
+# The per-rule algorithm id travels in bits 28-30 of the DIVIDER word — on
+# the wire (row-block col 4) and in the slab row (col 5) alike, so the
+# uint32[6, n] frame format, the shm rings, the sidecar wire, and the
+# snapshot format all carry algorithms with zero layout change, and an
+# all-fixed_window config (id 0) is bit-for-bit the pre-algorithm engine.
+# Real dividers are <= a week (604800 s << 2^28), so the split is free.
+#
+#   fixed_window   the original: count per window, reset at rollover.
+#   sliding_window current count in col 2, PREVIOUS window's count in col
+#                  6; the effective position is cur + floor(prev * (div -
+#                  elapsed) / div) — two-window linear interpolation, which
+#                  kills the 2x boundary burst of fixed windows.
+#   gcra           token bucket via theoretical arrival time: TAT stored as
+#                  (unix seconds, ms remainder) in cols 6-7, emission
+#                  interval T = div_ms / limit, admit while TAT - now <=
+#                  tau (= burst_ratio * div_ms - T). Denials never advance
+#                  the TAT. All math in int32 ms relative to `now`.
+#   concurrency   col 2 counts in-flight acquisitions; admit while count +
+#                  hits <= limit; a RELEASE row (id 4 on the wire, stored
+#                  as 3) decrements. The divider carries the idle TTL: a
+#                  key whose holders all died stops being touched, its
+#                  expire_at passes, and the row is reclaimed — the
+#                  TTL-based leak bound.
+#
+# Within one sorted segment (one key, one batch) decisions serialize
+# exactly like the fixed path: GCRA admits are a PREFIX of the segment
+# (the conforming test does not depend on hits, so the first denial makes
+# every later item non-conforming too), and concurrency admits follow the
+# prefix rule count0 + prior_acquire_hits + hits <= limit with same-batch
+# releases applied after acquires. The host oracle
+# (testing/oracle.py SetSlabOracle) is the executable spec for all of it.
+ALGO_SHIFT = 28
+ALGO_DIV_MASK = (1 << ALGO_SHIFT) - 1
+(
+    ALGO_FIXED_WINDOW,
+    ALGO_SLIDING_WINDOW,
+    ALGO_GCRA,
+    ALGO_CONCURRENCY,
+    ALGO_CONC_RELEASE,
+) = range(5)
+ALGO_NAMES = {
+    ALGO_FIXED_WINDOW: "fixed_window",
+    ALGO_SLIDING_WINDOW: "sliding_window",
+    ALGO_GCRA: "gcra",
+    ALGO_CONCURRENCY: "concurrency",
+}
+# GCRA fixed-point bounds: TAT offsets live in int32 milliseconds, capped
+# ~12 days ahead of now; dividers are clamped before the *1000 so the ms
+# math can never overflow int32 even on a hostile wire frame.
+GCRA_TAT_CAP_MS = 1 << 30
+GCRA_DIV_CAP_S = 1_000_000
 
 # Default set associativity: one full VPU lane register per set — the
 # Mosaic way-scan shape. The engine's SLAB_WAYS knob overrides it (power
@@ -112,11 +180,18 @@ def default_ways(platform: str) -> int:
 # The uint32[HEALTH_WIDTH] per-launch health vector: the eviction mix plus
 # the within-batch contention drop count. Only EVICT_LIVE and DROPS are
 # lossy (they displace state a caller could still observe); EXPIRED and
-# WINDOW reclaim rows that carry no decision state.
-HEALTH_EVICT_EXPIRED, HEALTH_EVICT_WINDOW, HEALTH_EVICT_LIVE, HEALTH_DROPS = (
-    range(4)
-)
-HEALTH_WIDTH = 4
+# WINDOW reclaim rows that carry no decision state. ALGO_RESETS counts
+# fingerprint-matched rows whose stored algorithm differed from the
+# request's (a mid-window algorithm change on config reload): the old
+# state resets to zero, counted so a reload's blast radius is observable.
+(
+    HEALTH_EVICT_EXPIRED,
+    HEALTH_EVICT_WINDOW,
+    HEALTH_EVICT_LIVE,
+    HEALTH_DROPS,
+    HEALTH_ALGO_RESETS,
+) = range(5)
+HEALTH_WIDTH = 5
 
 
 def validate_ways(n_slots: int, ways: int) -> int:
@@ -208,7 +283,14 @@ def _scan_ways(rows, fp_lo, fp_hi, now, ways: int):
     make_split_programs) times the SHIPPED scan, not a reimplementation."""
     expire = rows[:, :, COL_EXPIRE].astype(jnp.int32)
     window = rows[:, :, COL_WINDOW].astype(jnp.int32)
-    divider = rows[:, :, COL_DIVIDER].astype(jnp.int32)
+    # mask off the algorithm id (bits 28-30): the window-ended valuation
+    # must see the real window length. A no-op for fixed_window rows, so
+    # the all-fixed scan is bit-identical to the pre-algorithm one; for
+    # GCRA rows the stored window is tat_sec - divider, so the SAME rule
+    # classifies a drained TAT as reclaimable ahead of any live row.
+    divider = rows[:, :, COL_DIVIDER].astype(jnp.int32) & jnp.int32(
+        ALGO_DIV_MASK
+    )
     count = rows[:, :, COL_COUNT]
     live = expire > now
     match = (
@@ -303,7 +385,9 @@ def _choose_ways(
 
     p_expire = picked_rows[:, COL_EXPIRE].astype(jnp.int32)
     p_window = picked_rows[:, COL_WINDOW].astype(jnp.int32)
-    p_div = picked_rows[:, COL_DIVIDER].astype(jnp.int32)
+    p_div = picked_rows[:, COL_DIVIDER].astype(jnp.int32) & jnp.int32(
+        ALGO_DIV_MASK
+    )
     p_live = p_expire > now
     p_window_ended = p_live & (p_div > 0) & (p_window + p_div <= now)
     valid = batch.hits > 0
@@ -364,6 +448,8 @@ def _slab_update_sorted(
     fuse_decide: bool = False,
     lean_decide: bool = False,  # fused decide emits ONLY the code tile
     interpret: bool = False,
+    burst_ratio: jnp.ndarray | None = None,  # float32 scalar, GCRA tau knob
+    multi_algo: bool = True,  # static: compile the sibling-algorithm arms
 ):
     """The stateful core: set scan, serialize duplicates, window-reset,
     increment, one row-scatter. Returns sorted before/after counters, the
@@ -464,6 +550,19 @@ def _slab_update_sorted(
         s_after = outs[1].astype(jnp.uint32)
         cur_window = outs[2]
         expire_at = outs[3]
+        # the Mosaic kernels implement fixed_window only; the engine's
+        # sticky algorithms guard (backends/tpu.py) routes any launch that
+        # could see a non-fixed row or request to the XLA twin below, so
+        # this branch always runs with algo id 0 everywhere — the stores
+        # below are the pre-algorithm bytes verbatim
+        s_div_eff = s_div
+        count_store = s_after
+        window_store = cur_window
+        expire_store = expire_at
+        div_store = s_div
+        prev_store = jnp.zeros_like(s_fp_lo)
+        aux_store = jnp.zeros_like(s_fp_lo)
+        algo_reset = jnp.zeros(s_fp_lo.shape[0], dtype=bool)
         if fuse_decide:
             if lean_decide:
                 # code is the only real tile; pad with zero placeholders so
@@ -480,6 +579,8 @@ def _slab_update_sorted(
                 over_delta=outs[9].astype(jnp.uint32),
             )
     else:
+        u0 = jnp.uint32(0)
+        valid = s_hits > 0
         incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
         excl = incl - s_hits
         # forward-fill each segment's starting exclusive-sum (excl is
@@ -492,25 +593,282 @@ def _slab_update_sorted(
         st_expire = st_rows[:, COL_EXPIRE].astype(jnp.int32)
         st_fp_lo = st_rows[:, COL_FP_LO]
         st_fp_hi = st_rows[:, COL_FP_HI]
+        if not multi_algo:
+            # fixed_window-only program — the EXACT pre-algorithm value
+            # graph (no divider masking, no algorithm arms): the engine
+            # compiles this while its sticky guard has seen no non-fixed
+            # row, so an all-default config pays zero compute for the
+            # subsystem and its compiled program is byte-identical to the
+            # pre-PR engine (the rollback arm, statically enforced).
+            safe_div = jnp.maximum(s_div, 1)
+            cur_window = floor_div_exact_i32(now, safe_div) * safe_div
+            slot_live = st_expire > now
+            fp_match = (
+                slot_live
+                & (st_fp_lo == s_fp_lo)
+                & (st_fp_hi == s_fp_hi)
+            )
+            same_window = st_window == cur_window
+            base = jnp.where(
+                valid & fp_match & same_window, st_count, jnp.uint32(0)
+            )
+            s_before = base + prior_in_batch
+            s_after = s_before + s_hits
+            s_div_eff = s_div
+            count_store = s_after
+            window_store = cur_window
+            expire_store = now + safe_div + s_jit
+            div_store = s_div
+            prev_store = jnp.zeros_like(s_fp_lo)
+            aux_store = jnp.zeros_like(s_fp_lo)
+            algo_reset = jnp.zeros(s_fp_lo.shape[0], dtype=bool)
+            return _finish_update(
+                state, n, order, s_slot, same_prev, evict_class,
+                s_fp_lo, s_fp_hi, s_hits, s_limit, s_div_eff,
+                s_before, s_after, count_store, window_store,
+                expire_store, div_store, prev_store, aux_store,
+                algo_reset, count_health, decision,
+            )
 
-        safe_div = jnp.maximum(s_div, 1)  # padding rows may carry divider 0
+        st_algo = (st_rows[:, COL_DIVIDER].astype(jnp.int32) >> ALGO_SHIFT) & 7
+        st_prev = st_rows[:, COL_PREV]
+        st_aux = st_rows[:, COL_AUX]
+
+        # split the wire divider word: real window length low, algorithm
+        # id high. A release row (wire id 4) mutates a stored CONCURRENCY
+        # (3) row, so matching and the row write both use store_algo.
+        algo = (s_div >> ALGO_SHIFT) & 7
+        div = s_div & jnp.int32(ALGO_DIV_MASK)
+        store_algo = jnp.where(
+            algo == ALGO_CONC_RELEASE, ALGO_CONCURRENCY, algo
+        )
+        s_div_eff = div
+        safe_div = jnp.maximum(div, 1)  # padding rows may carry divider 0
         # floor_div_exact_i32: a vector integer divide would expand into a
         # ~32-pass shift-subtract loop (~100ms at 2^20 on v5e — the r3 gap)
         cur_window = floor_div_exact_i32(now, safe_div) * safe_div
         slot_live = st_expire > now
         fp_match = slot_live & (st_fp_lo == s_fp_lo) & (st_fp_hi == s_fp_hi)
+        # an fp match under a DIFFERENT stored algorithm (config reload
+        # changed the rule's algorithm mid-flight) resets state to zero —
+        # old windows/TATs are meaningless under the new semantics;
+        # counted per winning write as HEALTH_ALGO_RESETS
+        algo_same = st_algo == store_algo
+        match_ok = fp_match & algo_same
+        algo_reset = fp_match & ~algo_same
         same_window = st_window == cur_window
+
+        # -- fixed / sliding shared windowed counter core --
         # the hits>0 gate keeps the padding contract (before = after = 0):
         # a padding lane can carry a real fingerprint (e.g. a non-owned lane
         # in the replicated mesh mode) and its probe row WOULD match
         base = jnp.where(
-            (s_hits > 0) & fp_match & same_window, st_count, jnp.uint32(0)
+            valid & match_ok & same_window, st_count, jnp.uint32(0)
         )
-
-        s_before = base + prior_in_batch
-        s_after = s_before + s_hits
+        s_before_raw = base + prior_in_batch
+        s_after_raw = s_before_raw + s_hits
         expire_at = now + safe_div + s_jit
 
+        is_slide = algo == ALGO_SLIDING_WINDOW
+        is_gcra = algo == ALGO_GCRA
+        is_acq = algo == ALGO_CONCURRENCY
+        is_rel = algo == ALGO_CONC_RELEASE
+        is_conc = is_acq | is_rel
+
+        # -- sliding window: two-window linear interpolation --
+        # prev = last window's count: carried in col 6 while the row is in
+        # the current window, or the stored count itself when the row last
+        # wrote exactly one window ago. The interpolated position adds
+        # floor(prev * (div - elapsed) / div); prev is clamped so the
+        # int32 product prev * (div - elapsed) cannot overflow (the clamp
+        # only binds past limit ~ 2^31/div — documented interpolation
+        # error, mirrored exactly by the host oracle).
+        prev_raw = jnp.where(
+            match_ok & same_window,
+            st_prev,
+            jnp.where(
+                match_ok & (st_window == cur_window - safe_div),
+                st_count,
+                u0,
+            ),
+        )
+        elapsed = now - cur_window
+        prev_cap = floor_div_exact_i32(
+            jnp.full_like(safe_div, 0x7FFFFFFF), safe_div
+        )
+        prev_c = jnp.minimum(prev_raw.astype(jnp.int32), prev_cap)
+        carried = floor_div_exact_i32(
+            prev_c * (safe_div - elapsed), safe_div
+        ).astype(jnp.uint32)
+
+        # -- GCRA: int32 millisecond math relative to `now` --
+        limit_c = jnp.maximum(s_limit.astype(jnp.int32), 1)
+        div_ms = jnp.minimum(safe_div, GCRA_DIV_CAP_S) * 1000
+        t_ms = jnp.maximum(floor_div_exact_i32(div_ms, limit_c), 1)
+        ratio = (
+            jnp.float32(1.0) if burst_ratio is None else burst_ratio
+        )
+        tau = jnp.maximum(
+            jnp.floor(div_ms.astype(jnp.float32) * ratio).astype(jnp.int32)
+            - t_ms,
+            0,
+        )
+        tat_dsec = jnp.clip(
+            st_prev.astype(jnp.int32) - now, -(1 << 20), 1 << 20
+        )
+        tat0 = jnp.maximum(tat_dsec * 1000 + st_aux.astype(jnp.int32), 0)
+        tat0 = jnp.where(match_ok & is_gcra, tat0, 0)
+        # admit <=> tat0 + prior*T <= tau <=> prior <= floor((tau-tat0)/T):
+        # the conforming test ignores hits, so segment admits are a prefix
+        # and the existing exclusive prefix sum IS the serialization
+        q_admissible = floor_div_exact_i32(
+            jnp.maximum(tau - tat0, 0), t_ms
+        )
+        admit_g = (
+            valid & is_gcra & (tat0 <= tau)
+            & (prior_in_batch <= q_admissible.astype(jnp.uint32))
+        )
+        # total admitted hits so far in the segment: running max of the
+        # admitted inclusive prefix, floored at the segment base (incl is
+        # globally nondecreasing, so earlier segments can never leak in)
+        adm_run = jax.lax.cummax(
+            jnp.maximum(
+                jnp.where(admit_g, incl, u0),
+                jnp.where(seg_start, excl, u0),
+            )
+        )
+        adm_total_g = adm_run - seg_base_excl
+        a_cap = floor_div_exact_i32(
+            jnp.full_like(t_ms, GCRA_TAT_CAP_MS), t_ms
+        )
+        a_eff = jnp.minimum(adm_total_g.astype(jnp.int32), a_cap)
+        tat_new = jnp.minimum(
+            tat0 + a_eff * t_ms, jnp.int32(GCRA_TAT_CAP_MS)
+        )
+        tat_sec_new = now + floor_div_exact_i32(tat_new, jnp.full_like(tat_new, 1000))
+        tat_frac = tat_new - (tat_sec_new - now) * 1000
+        # synthesized counter position: ceil(tat0/T) "slots spoken for"
+        # plus this segment's prefix — <= limit iff admitted (capped), so
+        # the UNCHANGED host oracle / device decide derives the right code
+        used0 = floor_div_exact_i32(tat0 + t_ms - 1, t_ms).astype(jnp.uint32)
+        vafter = used0 + prior_in_batch + s_hits
+        after_gcra = jnp.where(
+            admit_g, jnp.minimum(vafter, s_limit), s_limit + s_hits
+        )
+
+        # -- concurrency: in-flight count, acquire/release --
+        count0 = jnp.where(match_ok & is_conc, st_count, u0)
+        hits_acq = jnp.where(is_acq & valid, s_hits, u0)
+        hits_rel = jnp.where(is_rel & valid, s_hits, u0)
+        incl_a = jnp.cumsum(hits_acq, dtype=jnp.uint32)
+        excl_a = incl_a - hits_acq
+        segbase_a = jax.lax.cummax(jnp.where(seg_start, excl_a, u0))
+        prior_a = excl_a - segbase_a
+        admit_c = (
+            valid & is_acq & (count0 + prior_a + s_hits <= s_limit)
+        )
+        adm_run_c = jax.lax.cummax(
+            jnp.maximum(
+                jnp.where(admit_c, incl_a, u0),
+                jnp.where(seg_start, excl_a, u0),
+            )
+        )
+        adm_total_c = adm_run_c - segbase_a
+        incl_r = jnp.cumsum(hits_rel, dtype=jnp.uint32)
+        segbase_r = jax.lax.cummax(
+            jnp.where(seg_start, incl_r - hits_rel, u0)
+        )
+        rel_total = incl_r - segbase_r
+        # same-batch releases apply after acquires; the count floors at 0
+        count_acq = count0 + adm_total_c
+        count_conc = jnp.where(
+            count_acq >= rel_total, count_acq - rel_total, u0
+        )
+        after_conc = jnp.where(
+            is_rel,
+            u0,
+            jnp.where(admit_c, count0 + prior_a + s_hits, s_limit + s_hits),
+        )
+
+        # -- per-item result select (fixed_window is the default arm, so
+        # an all-fixed batch computes exactly the pre-algorithm values) --
+        s_after = jnp.where(
+            is_slide,
+            s_after_raw + carried,
+            jnp.where(
+                is_gcra,
+                after_gcra,
+                jnp.where(is_conc, after_conc, s_after_raw),
+            ),
+        )
+        s_before = jnp.where(
+            is_slide,
+            s_before_raw + carried,
+            jnp.where(
+                is_gcra | is_conc,
+                jnp.where(s_after >= s_hits, s_after - s_hits, u0),
+                s_before_raw,
+            ),
+        )
+
+        # -- row-write stores --
+        count_store = jnp.where(
+            is_gcra,
+            jnp.minimum(
+                floor_div_exact_i32(tat_new, t_ms), jnp.int32(ALGO_DIV_MASK)
+            ).astype(jnp.uint32),
+            jnp.where(is_conc, count_conc, s_after_raw),
+        )
+        window_store = jnp.where(
+            is_gcra,
+            tat_sec_new - safe_div,
+            jnp.where(is_conc, jnp.full_like(cur_window, now), cur_window),
+        )
+        expire_store = jnp.where(
+            is_slide,
+            # sliding rows must outlive their window by one more so the
+            # prev count survives into next-window interpolation
+            expire_at + safe_div,
+            jnp.where(
+                is_gcra,
+                # a GCRA TAT can extend past the window (burst debt):
+                # keep the row alive until the TAT fully drains plus one
+                # window, or expiry would forgive the debt mid-drain
+                expire_at
+                + floor_div_exact_i32(
+                    tat_new + 999, jnp.full_like(tat_new, 1000)
+                ),
+                expire_at,
+            ),
+        )
+        div_store = div | (store_algo << ALGO_SHIFT)
+        prev_store = jnp.where(
+            is_slide,
+            prev_raw,
+            jnp.where(is_gcra, tat_sec_new.astype(jnp.uint32), u0),
+        )
+        aux_store = jnp.where(is_gcra, tat_frac.astype(jnp.uint32), u0)
+
+    return _finish_update(
+        state, n, order, s_slot, same_prev, evict_class,
+        s_fp_lo, s_fp_hi, s_hits, s_limit, s_div_eff,
+        s_before, s_after, count_store, window_store, expire_store,
+        div_store, prev_store, aux_store, algo_reset,
+        count_health, decision,
+    )
+
+
+def _finish_update(
+    state, n, order, s_slot, same_prev, evict_class,
+    s_fp_lo, s_fp_hi, s_hits, s_limit, s_div_eff,
+    s_before, s_after, count_store, window_store, expire_store,
+    div_store, prev_store, aux_store, algo_reset,
+    count_health, decision,
+):
+    """The shared tail of _slab_update_sorted — one row write per slot,
+    the health reductions, and the return tuple — factored out so the
+    three update bodies (pallas fixed, XLA fixed-only, XLA multi-
+    algorithm) land in one place with their per-branch stores."""
     # --- one row write per SLOT: the final item in the slot's run ---
     is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
     s_valid = s_hits > 0
@@ -520,7 +878,8 @@ def _slab_update_sorted(
         # health: the eviction mix — what each WINNING insert displaced
         # (counted once per winning write; a losing evictor displaced
         # nothing) — plus drops = distinct-key segments whose write lost a
-        # within-batch way contention (the doc'd fail-open undercount).
+        # within-batch way contention (the doc'd fail-open undercount),
+        # plus algorithm-change resets (counted per winning write).
         # Only evict_live and drops are lossy; expired/window reclaims
         # carry no decision state.
         seg_end = jnp.concatenate([~same_prev, jnp.array([True])])
@@ -535,7 +894,10 @@ def _slab_update_sorted(
         drops = jnp.sum(
             (s_valid & seg_end & ~is_last).astype(jnp.uint32), dtype=jnp.uint32
         )
-        health = jnp.stack([*counts, drops])
+        resets = jnp.sum(
+            (win & algo_reset).astype(jnp.uint32), dtype=jnp.uint32
+        )
+        health = jnp.stack([*counts, drops, resets])
     else:
         health = jnp.zeros((HEALTH_WIDTH,), dtype=jnp.uint32)
 
@@ -543,16 +905,18 @@ def _slab_update_sorted(
         [
             s_fp_lo,
             s_fp_hi,
-            s_after,
-            cur_window.astype(jnp.uint32),
-            expire_at.astype(jnp.uint32),
-            # window length: lets the eviction scan (and the restore-time
-            # reconcile, persist/snapshot.py) classify rows whose fixed
-            # window ended even though their jittered TTL (expire_at)
-            # hasn't — those evict ahead of any live-window row
-            s_div.astype(jnp.uint32),
-            jnp.zeros_like(s_fp_lo),
-            jnp.zeros_like(s_fp_lo),
+            count_store,
+            window_store.astype(jnp.uint32),
+            expire_store.astype(jnp.uint32),
+            # window length low + algorithm id high: lets the eviction
+            # scan (and the restore-time reconcile, persist/snapshot.py)
+            # classify rows whose window/TAT ended even though their
+            # jittered TTL (expire_at) hasn't — those evict ahead of any
+            # live-window row — and lets the inspector/restore classify
+            # every row's algorithm
+            div_store.astype(jnp.uint32),
+            prev_store,
+            aux_store,
         ],
         axis=1,
     )
@@ -561,7 +925,7 @@ def _slab_update_sorted(
         SlabState(table=table),
         s_before,
         s_after,
-        (s_hits, s_limit, s_div),
+        (s_hits, s_limit, s_div_eff),
         order,
         health,
         decision,
@@ -578,6 +942,8 @@ def _slab_step_sorted(
     count_health: bool = True,
     lean_decide: bool = False,
     interpret: bool = False,
+    burst_ratio: jnp.ndarray | None = None,
+    multi_algo: bool = True,
 ):
     """Core step with on-device decision; returns results in slot-sorted
     order plus the permutation (callers unsort on device or on the host)
@@ -598,6 +964,8 @@ def _slab_step_sorted(
             fuse_decide=use_pallas,
             lean_decide=lean_decide,
             interpret=interpret,
+            burst_ratio=burst_ratio,
+            multi_algo=multi_algo,
         )
     )
 
@@ -662,17 +1030,21 @@ PACKED_OUT_ROWS = 9
 
 
 @functools.partial(
-    jax.jit, static_argnames=("ways", "use_pallas"), donate_argnames=("state",)
+    jax.jit,
+    static_argnames=("ways", "use_pallas", "multi_algo"),
+    donate_argnames=("state",),
 )
 def slab_step_packed(
     state: SlabState,
     packed: jnp.ndarray,  # uint32[7, b]; row 6: [now, bitcast(near_ratio), ...]
     ways: int = DEFAULT_WAYS,
     use_pallas: bool = False,
+    multi_algo: bool = True,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
-    batch, now, near_ratio = _unpack(packed)
+    batch, now, near_ratio, burst_ratio = _unpack(packed)
     state, s_before, s_after, d, order, health = _slab_step_sorted(
-        state, batch, now, near_ratio, ways, use_pallas
+        state, batch, now, near_ratio, ways, use_pallas,
+        burst_ratio=burst_ratio, multi_algo=multi_algo,
     )
     out = jnp.stack(
         [
@@ -719,7 +1091,7 @@ def _unsort(values: jnp.ndarray, order: jnp.ndarray) -> jnp.ndarray:
     return jnp.zeros_like(values).at[order].set(values, unique_indices=True)
 
 
-def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray]:
+def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     batch = SlabBatch(
         fp_lo=packed[ROW_FP_LO],
         fp_hi=packed[ROW_FP_HI],
@@ -730,12 +1102,21 @@ def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray]:
     )
     now = packed[ROW_SCALARS, 0].astype(jnp.int32)
     near_ratio = jax.lax.bitcast_convert_type(packed[ROW_SCALARS, 1], jnp.float32)
-    return batch, now, near_ratio
+    # scalar slot 2: the GCRA burst-ratio knob (f32 bitcast). 0 means the
+    # producer predates the slot (old packers zero-fill) — default 1.0, a
+    # full-window burst; a zero ratio is meaningless so the sentinel is safe
+    burst_raw = jax.lax.bitcast_convert_type(
+        packed[ROW_SCALARS, 2], jnp.float32
+    )
+    burst_ratio = jnp.where(
+        packed[ROW_SCALARS, 2] == 0, jnp.float32(1.0), burst_raw
+    )
+    return batch, now, near_ratio, burst_ratio
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "out_dtype", "use_pallas"),
+    static_argnames=("ways", "out_dtype", "use_pallas", "multi_algo"),
     donate_argnames=("state",),
 )
 def slab_step_after(
@@ -744,14 +1125,16 @@ def slab_step_after(
     ways: int = DEFAULT_WAYS,
     out_dtype=jnp.uint32,
     use_pallas: bool = False,
+    multi_algo: bool = True,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Stateful update only; returns (post-increment counters in arrival
     order, saturating-cast to out_dtype, uint32[HEALTH_WIDTH] health). The
     caller guarantees max(limit) + max(hits) < dtype max. use_pallas runs
     the Mosaic way-scan + fused INCRBY kernel (no decide outputs)."""
-    batch, now, _ = _unpack(packed)
+    batch, now, _, burst_ratio = _unpack(packed)
     state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
-        state, batch, now, ways, use_pallas=use_pallas
+        state, batch, now, ways, use_pallas=use_pallas,
+        burst_ratio=burst_ratio, multi_algo=multi_algo,
     )
     after = _unsort(s_after, order)
     cap = jnp.uint32(jnp.iinfo(out_dtype).max)
@@ -760,7 +1143,7 @@ def slab_step_after(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "use_pallas", "count_health"),
+    static_argnames=("ways", "use_pallas", "count_health", "multi_algo"),
     donate_argnames=("state",),
 )
 def slab_step_decided(
@@ -769,6 +1152,7 @@ def slab_step_decided(
     ways: int = DEFAULT_WAYS,
     use_pallas: bool = False,
     count_health: bool = True,
+    multi_algo: bool = True,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Full on-device decision; only the 1-byte code per item (1=OK,
     2=OVER_LIMIT, arrival order) plus the uint32[HEALTH_WIDTH] health come
@@ -777,10 +1161,11 @@ def slab_step_decided(
     kernel runs lean: only the code tile is computed and written (the XLA
     twin's unused decision fields are dead-code-eliminated by the
     compiler anyway)."""
-    batch, now, near_ratio = _unpack(packed)
+    batch, now, near_ratio, burst_ratio = _unpack(packed)
     state, _before, _after, d, order, health = _slab_step_sorted(
         state, batch, now, near_ratio, ways, use_pallas, count_health,
-        lean_decide=use_pallas,
+        lean_decide=use_pallas, burst_ratio=burst_ratio,
+        multi_algo=multi_algo,
     )
     return state, _unsort(d.code, order).astype(jnp.uint8), health
 

@@ -69,6 +69,7 @@ def _require_shard_map():
 
 from ..ops.slab import (
     DEFAULT_WAYS,
+    HEALTH_ALGO_RESETS,
     HEALTH_DROPS,
     HEALTH_EVICT_EXPIRED,
     HEALTH_EVICT_LIVE,
@@ -117,13 +118,14 @@ def _sharded_body(table, packed, *, ways: int, use_pallas: bool, axis: str):
     """Per-device body under shard_map. table: local shard [n_local, ROW_WIDTH];
     packed: replicated uint32[7, b]. Returns (new local shard, replicated
     uint32[8, b] results in arrival order, uint32[2] mesh-wide health)."""
-    batch, now, near_ratio = _unpack(packed)
+    batch, now, near_ratio, burst_ratio = _unpack(packed)
 
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
     state, s_before, s_after, d, order, health = _slab_step_sorted(
-        SlabState(table=table), batch, now, near_ratio, ways, use_pallas
+        SlabState(table=table), batch, now, near_ratio, ways, use_pallas,
+        burst_ratio=burst_ratio,
     )
 
     # Unsort ON DEVICE (the host-side unsort trick of slab_step_packed does
@@ -153,13 +155,14 @@ def _sharded_body_after(
     """after-mode per-device body: stateful update only; psum the single
     saturating-cast post-increment row (see ops/slab.py compact modes) and
     the uint32[2] health vector."""
-    batch, now, _near = _unpack(packed)
+    batch, now, _near, burst_ratio = _unpack(packed)
 
     owned = _owner_mask(batch.fp_lo, batch.fp_hi, axis)
     batch = batch._replace(hits=jnp.where(owned, batch.hits, jnp.uint32(0)))
 
     state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
-        SlabState(table=table), batch, now, ways, use_pallas=use_pallas
+        SlabState(table=table), batch, now, ways, use_pallas=use_pallas,
+        burst_ratio=burst_ratio,
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     after = jnp.where(owned, after, jnp.uint32(0))
@@ -247,9 +250,10 @@ def _sharded_body_after_compact(
     """block: [1, 7, bucket] — this device's own bucket only. No owner
     masking needed: the host routed every item here because this shard owns
     it. Returns ([1, bucket] saturated counters, mesh-summed health)."""
-    batch, now, _near = _unpack(block[0])
+    batch, now, _near, burst_ratio = _unpack(block[0])
     state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
-        SlabState(table=table), batch, now, ways, use_pallas=use_pallas
+        SlabState(table=table), batch, now, ways, use_pallas=use_pallas,
+        burst_ratio=burst_ratio,
     )
     after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
     health = jax.lax.psum(health, axis)
@@ -423,6 +427,7 @@ class ShardedSlabEngine:
         # per-item columns carried garbage into the scalar row; restamp it
         blocks[:, ROW_SCALARS, 0] = packed[ROW_SCALARS, 0]
         blocks[:, ROW_SCALARS, 1] = packed[ROW_SCALARS, 1]
+        blocks[:, ROW_SCALARS, 2] = packed[ROW_SCALARS, 2]
 
         # one jit wrapper per cap; jax.jit itself retraces per bucket shape
         step = self._compact_steps.get(cap)
@@ -525,6 +530,7 @@ class ShardedSlabEngine:
                 "evictions_window": self.health_totals[HEALTH_EVICT_WINDOW],
                 "evictions_live": self.health_totals[HEALTH_EVICT_LIVE],
                 "drops": self.health_totals[HEALTH_DROPS],
+                "algo_resets": self.health_totals[HEALTH_ALGO_RESETS],
                 "live_slots": live,
                 "occupancy": live / self.n_slots_global,
             }

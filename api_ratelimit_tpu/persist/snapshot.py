@@ -58,6 +58,26 @@ SUPPORTED_VERSIONS = (1, 2)
 ROW_WIDTH = 8
 COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
 
+# Algorithm id in bits 28-30 of the divider word (ops/slab.py ALGO_*).
+# Pre-algorithm rows carry 0 there, so every v2 file from before the
+# algorithm subsystem classifies as fixed_window and reconciles EXACTLY as
+# it always did — the zero-drop round-trip guarantee.
+ALGO_SHIFT = 28
+ALGO_DIV_MASK = (1 << ALGO_SHIFT) - 1
+ALGO_NAMES = {
+    0: "fixed_window",
+    1: "sliding_window",
+    2: "gcra",
+    3: "concurrency",
+}
+
+
+def row_algorithms(table: np.ndarray) -> np.ndarray:
+    """Per-row algorithm id (0 = fixed_window) from the divider word —
+    THE classification the inspector and reconcile share."""
+    table = np.asarray(table, dtype=np.uint32)
+    return (table[:, COL_DIVIDER] >> ALGO_SHIFT) & 7
+
 # header `flags` values: what kind of table the payload holds. 0 (the
 # pre-flag format) is a slab shard; FLAG_LEASE_TABLE marks the lease
 # liability registry (backends/lease.py export_rows — one row per
@@ -362,7 +382,14 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
         decision state even while TTL-pinned — the next touch would roll
         the window and restart at 0 (ops/slab.py same_window gate) — so
         they are dropped too, exactly the population the set scan evicts
-        ahead of any live-window row;
+        ahead of any live-window row. The divider word's algorithm bits
+        (28-30) are masked before the arithmetic, so the SAME rule serves
+        every algorithm: GCRA rows store window = tat_sec - divider, which
+        makes "window ended" mean "TAT drained"; concurrency rows store
+        window = last touch with divider = idle TTL, which makes it mean
+        "idle past the leak TTL". Pre-algorithm rows carry zero algorithm
+        bits, so their reconcile is bit-identical to before (zero drops on
+        a v2 round-trip);
       * live rows inside a still-open window keep their counts: these are
         the counters a warm restart exists to preserve.
 
@@ -379,7 +406,9 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
     occupied = table.any(axis=1)
     expire_at = table[:, COL_EXPIRE].astype(np.int64)
     window = table[:, COL_WINDOW].astype(np.int64)
-    divider = table[:, COL_DIVIDER].astype(np.int64)
+    divider = (table[:, COL_DIVIDER] & np.uint32(ALGO_DIV_MASK)).astype(
+        np.int64
+    )
     live = occupied & (expire_at > now)
     window_ended = live & (divider > 0) & (window + divider <= now)
     keep = live & ~window_ended

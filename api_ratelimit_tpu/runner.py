@@ -135,6 +135,7 @@ def create_limiter(
             precompile=settings.tpu_precompile,
             dispatch_loop=settings.dispatch_loop,
             lease_table=lease_table,
+            gcra_burst_ratio=settings.gcra_burst(),
             **kwargs,
         )
     if backend == "tpu-sidecar":
@@ -446,10 +447,20 @@ class Runner:
                 self.fallback.degraded_reason
             )
 
+        # the config loader carries the validated algorithm knobs: the
+        # concurrency idle TTL is stamped into rules at load/hot-reload
+        from .config.loader import load_config as _load_config
+
+        service_scope = self.scope.scope("service")
+        rl_scope = service_scope.scope("rate_limit")
+        concurrency_ttl = settings.concurrency_ttl()
         self.service = RateLimitService(
             runtime=self.runtime,
             cache=cache,
-            stats_scope=self.scope.scope("service"),
+            stats_scope=service_scope,
+            config_loader=lambda files: _load_config(
+                files, rl_scope, concurrency_ttl_s=concurrency_ttl
+            ),
             time_source=RealTimeSource(),
             runtime_watch_root=settings.runtime_watch_root,
             max_sleeping_routines=settings.max_sleeping_routines,

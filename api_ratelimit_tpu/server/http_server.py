@@ -231,6 +231,44 @@ def add_json_handler(
 
     server.add_post("/json", handle)
 
+    def _handle_release(h: _Handler) -> None:
+        """POST /release — the concurrency Release surface: same
+        RateLimitRequest JSON body as /json, but instead of admitting it
+        DECREMENTS each matched concurrency descriptor's in-flight count
+        (service.release). Answers {"released": n}."""
+        try:
+            length = int(h.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            h._write(400, b"Bad Request: invalid Content-Length\n")
+            return
+        body = h.rfile.read(length) if length > 0 else b""
+        if not body:
+            h._write(400, b"Bad Request: empty body\n")
+            return
+        try:
+            req = json_format.Parse(body, rls_v3.RateLimitRequest())
+        except json_format.ParseError as e:
+            h._write(400, f"Bad Request: {e}\n".encode())
+            return
+        try:
+            internal = proto_adapter.request_from_v3(req)
+            released = service.release(internal)
+        except (CacheError, ServiceError) as e:
+            h._write(500, f"Internal Server Error: {e}\n".encode())
+            return
+        h._write(
+            200,
+            json.dumps({"released": released}).encode(),
+            content_type="application/json",
+        )
+
+    def handle_release(h: _Handler) -> None:
+        with tracing.start_http_server_span("/release", h.headers) as span:
+            with tracing.activate(span):
+                _handle_release(h)
+
+    server.add_post("/release", handle_release)
+
 
 def add_healthcheck(server: HttpServer, health: HealthChecker) -> None:
     def handle(h: _Handler) -> None:

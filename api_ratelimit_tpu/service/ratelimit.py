@@ -486,6 +486,31 @@ class RateLimitService:
         )
         return overall, statuses, headers
 
+    def release(self, request: RateLimitRequest) -> int:
+        """The concurrency Release RPC: decrement each matched CONCURRENCY
+        descriptor's in-flight count (backends/tpu.py do_release — a
+        negative-rider row on the normal row-block/dispatch wire, so the
+        sidecar and shm-ring paths carry it unchanged). Returns how many
+        release rows were submitted; descriptors resolving to no rule or
+        to a non-concurrency rule are ignored. Exposed over HTTP as
+        POST /release (server/http_server.py); callers that never release
+        (crashed clients) are reclaimed by the rule's idle TTL."""
+        if request.domain == "":
+            raise ServiceError("rate limit domain must not be empty")
+        if not request.descriptors:
+            raise ServiceError("rate limit descriptor list must not be empty")
+        config = self.get_current_config()
+        if config is None:
+            raise ServiceError("no rate limit configuration loaded")
+        compiled = getattr(config, "compiled", None)
+        do_release = getattr(self._cache, "do_release", None)
+        if compiled is None or do_release is None:
+            return 0  # backend without a release path (memory/redis)
+        resolved = [
+            compiled.resolve(request.domain, d) for d in request.descriptors
+        ]
+        return do_release(request, resolved)
+
     def _shed_answer(
         self,
         request: RateLimitRequest,

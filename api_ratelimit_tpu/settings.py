@@ -300,6 +300,19 @@ class Settings:
     # spawn. 1 (the default) is the single-process legacy boot,
     # byte-identical to PR-10.
     frontend_procs: int = 1
+    # --- rate-limit algorithm knobs (config/loader.py, ops/slab.py) ---
+    # CONCURRENCY_TTL_S: idle TTL (seconds) stamped into `algorithm:
+    # concurrency` rules — a key none of whose holders acquire or release
+    # for this long has its whole row reclaimed and its in-flight count
+    # restarts at zero (the leak bound for callers that die without
+    # releasing). Applied at config load/hot-reload.
+    concurrency_ttl_s: int = 60
+    # GCRA_BURST_RATIO: burst tolerance as a fraction of the rule's
+    # window — tau = ratio * window_ms - T. 1.0 (the default) admits a
+    # full window's worth of back-to-back arrivals, matching the
+    # fixed-window limit's steady-state; smaller ratios trade burst
+    # capacity for smoothness.
+    gcra_burst_ratio: float = 1.0
     # fault injection (testing/faults.py): comma-separated
     # site:kind:value rules, e.g.
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
@@ -596,6 +609,29 @@ class Settings:
             )
         return n
 
+    def concurrency_ttl(self) -> int:
+        """Validated CONCURRENCY_TTL_S idle TTL. Junk (<= 0, or past the
+        divider word's 28-bit field) fails the boot like every other knob —
+        a typo'd TTL must not silently become 'leak forever' or corrupt
+        the algorithm bits of the wire divider."""
+        ttl = int(self.concurrency_ttl_s)
+        if ttl <= 0 or ttl >= (1 << 28):
+            raise ValueError(
+                f"CONCURRENCY_TTL_S must be in [1, 2^28), got {ttl}"
+            )
+        return ttl
+
+    def gcra_burst(self) -> float:
+        """Validated GCRA_BURST_RATIO. Junk (<= 0 or > 16) fails the
+        boot — a zero ratio would deny everything and a huge one would
+        never deny, neither silently."""
+        ratio = float(self.gcra_burst_ratio)
+        if not 0.0 < ratio <= 16.0:
+            raise ValueError(
+                f"GCRA_BURST_RATIO must be in (0, 16], got {ratio}"
+            )
+        return ratio
+
     def fault_rules(self):
         """Parsed FAULT_INJECT rules (testing/faults.py grammar). Raises
         ValueError on junk — a typo'd chaos spec must fail the boot, not
@@ -732,6 +768,8 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("shm_control_sock", "SHM_CONTROL_SOCK", str),
     ("shm_ring_rows", "SHM_RING_ROWS", int),
     ("frontend_procs", "FRONTEND_PROCS", int),
+    ("concurrency_ttl_s", "CONCURRENCY_TTL_S", int),
+    ("gcra_burst_ratio", "GCRA_BURST_RATIO", float),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
 ]

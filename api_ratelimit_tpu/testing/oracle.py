@@ -22,8 +22,23 @@ import numpy as np
 # stays importable without jax; tests pin the equivalence)
 ROW_WIDTH = 8
 COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
+COL_PREV, COL_AUX = 6, 7
 SCORE_TIER_SHIFT = 28
 EVICT_NONE, EVICT_EXPIRED, EVICT_WINDOW, EVICT_LIVE = range(4)
+
+# algorithm ids in bits 28-30 of the divider word (ops/slab.py ALGO_*)
+ALGO_SHIFT = 28
+ALGO_DIV_MASK = (1 << ALGO_SHIFT) - 1
+(
+    ALGO_FIXED_WINDOW,
+    ALGO_SLIDING_WINDOW,
+    ALGO_GCRA,
+    ALGO_CONCURRENCY,
+    ALGO_CONC_RELEASE,
+) = range(5)
+GCRA_TAT_CAP_MS = 1 << 30
+GCRA_DIV_CAP_S = 1_000_000
+HEALTH_WIDTH = 5  # evictions expired/window/live + drops + algo resets
 
 
 class SetSlabOracle:
@@ -45,8 +60,9 @@ class SetSlabOracle:
     documented in ops/slab.py); the oracle raises instead of guessing, and
     the fuzz generators construct fingerprints with unique top bits."""
 
-    def __init__(self, n_slots: int, ways: int):
+    def __init__(self, n_slots: int, ways: int, burst_ratio: float = 1.0):
         ways = min(int(ways), int(n_slots))
+        self.burst_ratio = float(burst_ratio)
         if ways <= 0 or ways & (ways - 1):
             raise ValueError(f"ways must be a positive power of two: {ways}")
         if n_slots % ways:
@@ -58,9 +74,9 @@ class SetSlabOracle:
         slot_bits = self.n_slots.bit_length()
         self.fp_bits = max(0, min(16, 32 - slot_bits - 1))
         self.table = np.zeros((self.n_slots, ROW_WIDTH), dtype=np.uint64)
-        # cumulative uint32[4]: evictions expired/window/live + drops —
-        # the ops/slab.py HEALTH_* layout
-        self.health = [0, 0, 0, 0]
+        # cumulative uint32[HEALTH_WIDTH]: evictions expired/window/live +
+        # drops + algorithm-change resets — the ops/slab.py HEALTH_* layout
+        self.health = [0] * HEALTH_WIDTH
 
     def _choose(self, fp_lo: int, fp_hi: int, now: int):
         """(slot, matched, evict_class) against the CURRENT table — the
@@ -78,10 +94,11 @@ class SetSlabOracle:
                 and int(r[COL_FP_HI]) == fp_hi
             ):
                 return base + w, True, EVICT_NONE
+            rdiv = int(r[COL_DIVIDER]) & ALGO_DIV_MASK  # strip the algo id
             ended = (
                 live
-                and int(r[COL_DIVIDER]) > 0
-                and int(r[COL_WINDOW]) + int(r[COL_DIVIDER]) <= now
+                and rdiv > 0
+                and int(r[COL_WINDOW]) + rdiv <= now
             )
             tier = (1 if ended else 2) if live else 0
             rot = (w - pref) & (self.ways - 1)
@@ -96,9 +113,10 @@ class SetSlabOracle:
         victim = self.table[base + best_w]
         v_exp = int(victim[COL_EXPIRE])
         if v_exp > now:
+            v_div = int(victim[COL_DIVIDER]) & ALGO_DIV_MASK
             ended = (
-                int(victim[COL_DIVIDER]) > 0
-                and int(victim[COL_WINDOW]) + int(victim[COL_DIVIDER]) <= now
+                v_div > 0
+                and int(victim[COL_WINDOW]) + v_div <= now
             )
             cls = EVICT_WINDOW if ended else EVICT_LIVE
         else:
@@ -125,28 +143,157 @@ class SetSlabOracle:
                 segs[key] = [matched, cls, []]
                 order.append(key)
             segs[key][2].append(i)
-        # pass 2: serialize duplicates + pick each way's winning segment
+        # pass 2: serialize duplicates + pick each way's winning segment.
+        # Each segment runs its rule's decision algorithm — the sequential
+        # executable spec the vectorized kernels must match bit-for-bit.
         by_slot: dict = {}
-        delta = [0, 0, 0, 0]
+        delta = [0] * HEALTH_WIDTH
         for key in order:
             slot, fp_lo, fp_hi = key
             matched, cls, idxs = segs[key]
             row = self.table[slot]
-            div = max(int(items[idxs[0]][4]), 1)
-            cur_window = (now // div) * div
-            running = (
-                int(row[COL_COUNT])
-                if matched and int(row[COL_WINDOW]) == cur_window
-                else 0
+            raw_div0 = int(items[idxs[0]][4])
+            algo0 = (raw_div0 >> ALGO_SHIFT) & 7
+            store_algo = (
+                ALGO_CONCURRENCY if algo0 == ALGO_CONC_RELEASE else algo0
             )
-            for i in idxs:
-                hits, limit = int(items[i][2]), int(items[i][3])
-                before[i] = running
-                running += hits
-                after[i] = running
-                codes[i] = 2 if after[i] > limit else 1
+            for i in idxs[1:]:
+                a = (int(items[i][4]) >> ALGO_SHIFT) & 7
+                sa = ALGO_CONCURRENCY if a == ALGO_CONC_RELEASE else a
+                if sa != store_algo:
+                    raise AssertionError(
+                        "one key carries two algorithms in one batch: the "
+                        "kernel's per-segment serialization assumes one "
+                        "rule per key per launch (reloads land between "
+                        "batches; construct fuzz batches accordingly)"
+                    )
+            div = max(raw_div0 & ALGO_DIV_MASK, 1)
+            st_algo = (int(row[COL_DIVIDER]) >> ALGO_SHIFT) & 7
+            match_ok = matched and st_algo == store_algo
+            algo_reset = matched and st_algo != store_algo
+            cur_window = (now // div) * div
+            last_i = idxs[-1]
+            jit = int(items[last_i][5])
+            out_row = None
+
+            if store_algo in (ALGO_FIXED_WINDOW, ALGO_SLIDING_WINDOW):
+                same_window = int(row[COL_WINDOW]) == cur_window
+                base = int(row[COL_COUNT]) if match_ok and same_window else 0
+                carried = 0
+                prev_raw = 0
+                if store_algo == ALGO_SLIDING_WINDOW:
+                    if match_ok and same_window:
+                        prev_raw = int(row[COL_PREV])
+                    elif match_ok and int(row[COL_WINDOW]) == (
+                        cur_window - div
+                    ) % (1 << 32):
+                        prev_raw = int(row[COL_COUNT])
+                    prev_c = min(prev_raw, (2**31 - 1) // div)
+                    carried = prev_c * (div - (now - cur_window)) // div
+                running = base
+                for i in idxs:
+                    hits, limit = int(items[i][2]), int(items[i][3])
+                    before[i] = running + carried
+                    running += hits
+                    after[i] = running + carried
+                    codes[i] = 2 if after[i] > limit else 1
+                if store_algo == ALGO_FIXED_WINDOW:
+                    out_row = [
+                        fp_lo, fp_hi, running, cur_window,
+                        now + div + jit, raw_div0 & ALGO_DIV_MASK, 0, 0,
+                    ]
+                else:
+                    out_row = [
+                        fp_lo, fp_hi, running, cur_window,
+                        now + 2 * div + jit,
+                        (raw_div0 & ALGO_DIV_MASK)
+                        | (ALGO_SLIDING_WINDOW << ALGO_SHIFT),
+                        prev_raw, 0,
+                    ]
+
+            elif store_algo == ALGO_GCRA:
+                limit0 = max(int(items[idxs[0]][3]), 1)
+                div_ms = min(div, GCRA_DIV_CAP_S) * 1000
+                t_ms = max(div_ms // limit0, 1)
+                tau = max(
+                    int(
+                        np.floor(
+                            np.float32(div_ms)
+                            * np.float32(self.burst_ratio)
+                        )
+                    )
+                    - t_ms,
+                    0,
+                )
+                tat0 = 0
+                if match_ok:
+                    dsec = int(row[COL_PREV]) - now
+                    dsec = max(-(1 << 20), min(dsec, 1 << 20))
+                    tat0 = max(dsec * 1000 + int(row[COL_AUX]), 0)
+                used0 = (tat0 + t_ms - 1) // t_ms
+                prior = 0
+                admitted = 0
+                q = (tau - tat0) // t_ms if tat0 <= tau else -1
+                for i in idxs:
+                    hits, limit = int(items[i][2]), int(items[i][3])
+                    admit = tat0 <= tau and prior <= q
+                    if admit:
+                        after[i] = min(used0 + prior + hits, limit)
+                        admitted += hits
+                    else:
+                        after[i] = limit + hits
+                    before[i] = max(after[i] - hits, 0)
+                    codes[i] = 2 if after[i] > limit else 1
+                    prior += hits
+                a_eff = min(admitted, GCRA_TAT_CAP_MS // t_ms)
+                tat_new = min(tat0 + a_eff * t_ms, GCRA_TAT_CAP_MS)
+                tat_sec_new = now + tat_new // 1000
+                out_row = [
+                    fp_lo, fp_hi,
+                    min(tat_new // t_ms, ALGO_DIV_MASK),
+                    (tat_sec_new - div) % (1 << 32),
+                    # alive until the TAT drains + one window (the kernel's
+                    # burst-debt rule: expiry must not forgive the TAT)
+                    now + div + (tat_new + 999) // 1000 + jit,
+                    (raw_div0 & ALGO_DIV_MASK) | (ALGO_GCRA << ALGO_SHIFT),
+                    tat_sec_new % (1 << 32),
+                    tat_new % 1000,
+                ]
+
+            else:  # concurrency: acquire/release against the in-flight count
+                count0 = int(row[COL_COUNT]) if match_ok else 0
+                prior_a = 0
+                adm_total = 0
+                rel_total = 0
+                for i in idxs:
+                    hits, limit = int(items[i][2]), int(items[i][3])
+                    a = (int(items[i][4]) >> ALGO_SHIFT) & 7
+                    if a == ALGO_CONC_RELEASE:
+                        after[i] = 0
+                        before[i] = 0
+                        codes[i] = 1
+                        rel_total += hits
+                        continue
+                    admit = count0 + prior_a + hits <= limit
+                    if admit:
+                        after[i] = count0 + prior_a + hits
+                        adm_total += hits
+                    else:
+                        after[i] = limit + hits
+                    before[i] = max(after[i] - hits, 0)
+                    codes[i] = 2 if after[i] > limit else 1
+                    prior_a += hits
+                count_new = max(count0 + adm_total - rel_total, 0)
+                out_row = [
+                    fp_lo, fp_hi, count_new, now,
+                    now + div + jit,
+                    (raw_div0 & ALGO_DIV_MASK)
+                    | (ALGO_CONCURRENCY << ALGO_SHIFT),
+                    0, 0,
+                ]
+
             by_slot.setdefault(slot, []).append(
-                (key, matched, cls, running, idxs[-1], cur_window)
+                (key, matched, cls, algo_reset, out_row)
             )
         writes = []
         for slot, contenders in by_slot.items():
@@ -164,24 +311,16 @@ class SetSlabOracle:
                     )
                 winner = max(contenders, key=lambda c: c[0][2] >> (32 - self.fp_bits))
             delta[3] += len(contenders) - 1  # losing segments drop, counted
-            (slot_, fp_lo, fp_hi), _m, cls, total, last_i, _cur_window = winner
+            _key, _m, cls, algo_reset, out_row = winner
             if cls != EVICT_NONE:
                 delta[cls - 1] += 1
-            # the kernel's row write takes divider/jitter (and therefore
-            # the stored window) from the segment's LAST item
-            div = max(int(items[last_i][4]), 1)
-            jit = int(items[last_i][5])
-            cur_window = (now // div) * div
-            writes.append(
-                (
-                    slot,
-                    [fp_lo, fp_hi, total, cur_window, now + div + jit, div, 0, 0],
-                )
-            )
+            if algo_reset:
+                delta[4] += 1
+            writes.append((slot, out_row))
         # pass 3: ONE write per way, after every scan (the kernel scatter)
         for slot, row in writes:
             self.table[slot] = np.array(row, dtype=np.uint64)
-        for k in range(4):
+        for k in range(HEALTH_WIDTH):
             self.health[k] += delta[k]
         return before, after, codes, delta
 

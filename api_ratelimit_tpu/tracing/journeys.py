@@ -56,6 +56,15 @@ OWNER_STAGES = ("take", "pack", "launch", "redeem", "scatter")
 # (backends/lease.py) mark this single stage INSTEAD of the device set —
 # /debug/journeys shows at a glance which requests never left the frontend
 STAGE_LEASE_LOCAL = "lease_local"
+# per-algorithm decision tags (backends/tpu.py ALGO_JOURNEY_STAGES marks
+# one on every over-limit decision): a slow or shed journey shows which
+# decision kernel — fixed/sliding window, GCRA, concurrency — denied it
+ALGO_STAGES = (
+    "algo_fixed_window",
+    "algo_sliding_window",
+    "algo_gcra",
+    "algo_concurrency",
+)
 
 FLAG_SLOW = "slow"
 FLAG_SHED = "shed"

@@ -351,6 +351,10 @@ def bench_engine_zipf(
             # only the code comes back: the lean kernel skips the five
             # decision tiles the XLA twin's DCE drops for free
             lean_decide=use_pallas,
+            # the production all-fixed program (the engine's static
+            # multi_algo gate is off until a non-fixed row appears; the
+            # boundary_burst tier times the algorithm kernels)
+            multi_algo=False,
         )
         over = _unsort(d.code, order) == 2
         return state, jnp.packbits(over), health
@@ -368,6 +372,7 @@ def bench_engine_zipf(
             ways=ways,
             count_health=True,
             use_pallas=use_pallas,
+            multi_algo=False,
         )
         after = jnp.minimum(_unsort(s_after, order), jnp.uint32(255))
         return state, after.astype(jnp.uint8), health
@@ -435,7 +440,7 @@ def bench_engine_zipf(
                 fetched_first = fetched_pass
         t_e2e = time.perf_counter() - t0
         decisions = k * batch
-        ev_expired, ev_window, ev_live, drops = (
+        ev_expired, ev_window, ev_live, drops, _algo_resets = (
             int(v) for v in np.asarray(jnp.stack(healths)).sum(axis=0)
         )
         live = int(slab_live_slots(state, now))
@@ -647,6 +652,7 @@ def bench_slab_occupancy(device, on_tpu: bool, left=lambda: 1e9) -> dict:
             ways=ways,
             count_health=True,
             use_pallas=use_pallas,
+            multi_algo=False,
         )
         after = jnp.minimum(_unsort(s_after, order), jnp.uint32(0xFFFF))
         return state, after.astype(jnp.uint16), health
@@ -727,6 +733,180 @@ def bench_slab_occupancy(device, on_tpu: bool, left=lambda: 1e9) -> dict:
         result["rate_at_50pct"] = next(
             (p["rate"] for p in points if p.get("load") == 0.5), None
         )
+    return result
+
+
+def bench_boundary_burst(device, on_tpu: bool, left=lambda: 1e9) -> dict:
+    """Algorithm tier (round 12): the window-edge burst workload fixed
+    windows are KNOWN to fail — 2x the limit admitted when a burst
+    straddles a window boundary — run identically against the three
+    rate algorithms, plus a connection-churn tier for concurrency caps.
+
+    boundary_burst: K independent keys each offer `limit` requests in the
+    last quarter of window W and `limit` more in the first quarter of
+    window W+1 (2*limit offered across the edge). The admitted-over-limit
+    ratio per algorithm is the headline: fixed ~2.0 (the documented
+    failure), sliding <= 1 + interpolation error, GCRA <= the burst
+    tolerance. Deterministic clock (the `now` scalar is injected per
+    launch), so the tier is exact, not statistical.
+
+    connection_churn: sessions acquire against a concurrency cap, hold,
+    and release — except a leak fraction that never releases. The cap
+    must hold under churn (admitted in-flight never exceeds it), and
+    after the idle TTL passes the leaked slots must be reclaimed (fresh
+    acquires admit again)."""
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        ALGO_CONC_RELEASE,
+        ALGO_CONCURRENCY,
+        ALGO_GCRA,
+        ALGO_SHIFT,
+        ALGO_SLIDING_WINDOW,
+        OUT_CODE,
+        OUT_ORDER,
+        ROW_DIVIDER,
+        ROW_FP_HI,
+        ROW_FP_LO,
+        ROW_HITS,
+        ROW_LIMIT,
+        ROW_SCALARS,
+        make_slab,
+        slab_step_packed,
+    )
+
+    ways = default_ways_bench(on_tpu)
+    use_pallas = False  # algorithm kernels are the XLA twin by design
+    limit = 100
+    div = 60
+    n_keys = 64 if on_tpu else 16
+    batch = n_keys  # one lane per key per launch
+
+    def run_stream(algo_id: int, times_and_hits) -> tuple[int, int]:
+        """Drive one algorithm: per (now, hits-per-key) step, every key
+        submits `hits` one-hit launches... flattened as `hits` launches of
+        one request per key. Returns (admitted, offered)."""
+        state = make_slab(1 << 12, device=device)
+        admitted = offered = 0
+        for now, per_key in times_and_hits:
+            for _ in range(per_key):
+                packed = np.zeros((7, batch), dtype=np.uint32)
+                ids = np.arange(n_keys, dtype=np.uint32) + np.uint32(
+                    0x1000 * (algo_id + 1)
+                )
+                packed[ROW_FP_LO] = fmix32_np(ids)
+                packed[ROW_FP_HI] = fmix32_np(ids ^ np.uint32(0x5A5A5A5A))
+                packed[ROW_HITS] = 1
+                packed[ROW_LIMIT] = limit
+                packed[ROW_DIVIDER] = div | (algo_id << ALGO_SHIFT)
+                packed[ROW_SCALARS, 0] = np.uint32(now)
+                packed[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+                state, out, _h = slab_step_packed(
+                    state, jnp.asarray(packed), ways=ways,
+                    use_pallas=use_pallas,
+                )
+                out = np.asarray(out)
+                order = out[OUT_ORDER].astype(np.int64)
+                codes = np.empty(batch, dtype=np.uint32)
+                codes[order] = out[OUT_CODE]
+                admitted += int(np.sum(codes == 1))
+                offered += batch
+        return admitted, offered
+
+    # the synchronized edge burst: window W = [w0, w0+div); `limit`
+    # arrivals per key in its last quarter, `limit` more in the first
+    # quarter of W+1. Steps spread each half-burst over 4 clock points.
+    w0 = 1_000_000 * div // div * div  # exact window start
+    edge = []
+    for k in range(4):
+        edge.append((w0 + div - 8 + 2 * k, limit // 4))
+    for k in range(4):
+        edge.append((w0 + div + 2 + 2 * k, limit // 4))
+    result: dict = {"limit": limit, "offered_per_key": 2 * limit}
+    t0 = time.perf_counter()
+    for name, algo_id in (
+        ("fixed_window", 0),
+        ("sliding_window", ALGO_SLIDING_WINDOW),
+        ("gcra", ALGO_GCRA),
+    ):
+        admitted, offered = run_stream(algo_id, edge)
+        per_key = admitted / n_keys
+        result[name] = {
+            "admitted_per_key": round(per_key, 1),
+            # the headline: admitted across the edge relative to ONE
+            # window's limit — fixed's known failure mode reads ~2.0
+            "admitted_over_limit_ratio": round(per_key / limit, 3),
+        }
+        print(f"[boundary_burst] {name}: {result[name]}", file=sys.stderr)
+
+    # connection churn: cap 32 in-flight per key; sessions of 3 steps;
+    # 25% of acquires leak (never released). After the TTL the leaked
+    # slots must admit again.
+    cap, ttl = 32, 40
+    churn: dict = {"cap": cap, "ttl_s": ttl}
+    state = make_slab(1 << 12, device=device)
+    rng = np.random.default_rng(12)
+    ids = np.arange(n_keys, dtype=np.uint32) + np.uint32(0x9000)
+    fp_lo, fp_hi = fmix32_np(ids), fmix32_np(ids ^ np.uint32(0x5A5A5A5A))
+
+    def conc_launch(now, release_mask):
+        packed = np.zeros((7, batch), dtype=np.uint32)
+        packed[ROW_FP_LO], packed[ROW_FP_HI] = fp_lo, fp_hi
+        packed[ROW_HITS] = 1
+        packed[ROW_LIMIT] = cap
+        algo = np.where(
+            release_mask, ALGO_CONC_RELEASE, ALGO_CONCURRENCY
+        ).astype(np.uint32)
+        packed[ROW_DIVIDER] = np.uint32(ttl) | (algo << np.uint32(ALGO_SHIFT))
+        packed[ROW_SCALARS, 0] = np.uint32(now)
+        packed[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+        return jnp.asarray(packed)
+
+    now = w0 + 10 * div
+    admitted = denied = 0
+    # churn phase: 60 acquire waves; each wave releases the previous
+    # wave's non-leaked sessions
+    leak = rng.random(size=(60, batch)) < 0.25
+    for wave in range(60):
+        state, out, _h = slab_step_packed(
+            state, conc_launch(now, np.zeros(batch, dtype=bool)),
+            ways=ways, use_pallas=use_pallas,
+        )
+        out = np.asarray(out)
+        order = out[OUT_ORDER].astype(np.int64)
+        codes = np.empty(batch, dtype=np.uint32)
+        codes[order] = out[OUT_CODE]
+        admitted += int(np.sum(codes == 1))
+        denied += int(np.sum(codes == 2))
+        # release the admitted, minus the leakers
+        if not leak[wave].all():
+            state, _out, _h = slab_step_packed(
+                state, conc_launch(now, ~leak[wave]),
+                ways=ways, use_pallas=use_pallas,
+            )
+        now += 1
+    churn["churn_admitted"] = admitted
+    churn["churn_denied"] = denied
+    # leaked slots accumulate ~0.25/wave until the cap binds: denials
+    # under churn prove the in-flight bound holds
+    churn["cap_bound_held"] = denied > 0
+    # TTL reclamation: idle past the TTL, then one acquire wave per key
+    # must admit again (the leaked rows were reclaimed whole)
+    now += ttl + 5
+    state, out, _h = slab_step_packed(
+        state, conc_launch(now, np.zeros(batch, dtype=bool)),
+        ways=ways, use_pallas=use_pallas,
+    )
+    out = np.asarray(out)
+    order = out[OUT_ORDER].astype(np.int64)
+    codes = np.empty(batch, dtype=np.uint32)
+    codes[order] = out[OUT_CODE]
+    churn["reclaimed_admit_rate"] = round(
+        float(np.mean(codes == 1)), 3
+    )
+    result["connection_churn"] = churn
+    result["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    print(f"[boundary_burst] churn: {churn}", file=sys.stderr)
     return result
 
 
@@ -2609,6 +2789,19 @@ def main() -> None:
             )
         except Exception as e:
             configs["slab_occupancy"] = {"error": str(e)[-300:]}
+    emit()
+
+    # algorithm tier (round 12): window-edge burst across fixed vs
+    # sliding vs GCRA, plus the concurrency-cap connection-churn tier
+    if left() < 45:
+        configs["boundary_burst"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["boundary_burst"] = bench_boundary_burst(
+                device, on_tpu, left
+            )
+        except Exception as e:
+            configs["boundary_burst"] = {"error": str(e)[-300:]}
     emit()
 
     for key, yaml_text in (

@@ -1,0 +1,594 @@
+"""The algorithm subsystem end to end: config loading/validation, the
+compiled-record plumbing, the rollback byte-identity arm, per-algorithm
+service behavior (sliding window, GCRA, concurrency caps + Release), the
+lease stories, snapshot round-trips, and the algo stats/journey tags.
+
+The kernel-vs-oracle bit-exactness lives in tests/test_slab_fuzz.py
+(TestFuzzMixedAlgorithmBatches, >= 10k decisions per algorithm); this file
+covers every layer ABOVE the kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+from api_ratelimit_tpu.config.loader import ConfigFile, load_config
+from api_ratelimit_tpu.limiter import BaseRateLimiter, LocalCache
+from api_ratelimit_tpu.models import Code, Descriptor, RateLimitRequest
+from api_ratelimit_tpu.models.config import (
+    ALGO_ID_CONCURRENCY,
+    ALGO_ID_GCRA,
+    ALGO_ID_SLIDING_WINDOW,
+    ALGORITHM_IDS,
+    ConfigError,
+)
+from api_ratelimit_tpu.service.ratelimit import RateLimitService
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+
+def req(*pairs, domain="algo", hits=1):
+    return RateLimitRequest(
+        domain=domain,
+        descriptors=tuple(Descriptor.of(p) for p in pairs),
+        hits_addend=hits,
+    )
+
+
+def load(yaml_text, name="config.algo", **kw):
+    store = Store(TestSink())
+    return load_config(
+        [ConfigFile(name=name, contents=yaml_text)],
+        store.scope("rate_limit"),
+        **kw,
+    )
+
+
+ALGO_YAML = """
+domain: algo
+descriptors:
+  - key: fixed
+    rate_limit: {unit: minute, requests_per_unit: 5}
+  - key: slide
+    rate_limit: {unit: minute, requests_per_unit: 6, algorithm: sliding_window}
+  - key: bucket
+    rate_limit: {unit: minute, requests_per_unit: 4, algorithm: gcra}
+  - key: bucket2
+    rate_limit: {unit: minute, requests_per_unit: 2, algorithm: gcra}
+  - key: conns
+    rate_limit: {requests_per_unit: 3, algorithm: concurrency}
+"""
+
+
+class FakeRuntime:
+    def __init__(self, files: dict):
+        self.files = dict(files)
+        self._callbacks = []
+
+    def snapshot(self):
+        outer = self
+
+        class Snap:
+            def keys(self):
+                return list(outer.files)
+
+            def get(self, key):
+                return outer.files[key]
+
+        return Snap()
+
+    def add_update_callback(self, cb):
+        self._callbacks.append(cb)
+
+    def touch(self):
+        for cb in self._callbacks:
+            cb()
+
+
+def make_cache(ts, local_cache_size=0, stats_scope=None):
+    local = LocalCache(local_cache_size, ts) if local_cache_size else None
+    base = BaseRateLimiter(ts, local_cache=local, near_limit_ratio=0.8)
+    return TpuRateLimitCache(
+        base,
+        n_slots=1 << 12,
+        buckets=(128,),
+        max_batch=128,
+        use_pallas=False,
+        stats_scope=stats_scope,
+    )
+
+
+def make_service(yaml_text=ALGO_YAML, ts=None, stats_scope=None, **kw):
+    ts = ts or FakeTimeSource(1_000_000)
+    store = Store(TestSink())
+    scope = stats_scope if stats_scope is not None else store.scope("ratelimit")
+    cache = make_cache(ts, stats_scope=scope)
+    runtime = FakeRuntime({"config.algo": yaml_text})
+    svc = RateLimitService(
+        runtime=runtime,
+        cache=cache,
+        stats_scope=scope.scope("service"),
+        time_source=ts,
+        **kw,
+    )
+    return svc, runtime, cache, store, ts
+
+
+class TestLoaderValidation:
+    def test_algorithms_parse_and_default(self):
+        config = load(ALGO_YAML)
+        c = config.compiled
+        assert c.resolve("algo", Descriptor.of(("fixed", ""))).algorithm == 0
+        assert (
+            c.resolve("algo", Descriptor.of(("slide", ""))).algorithm
+            == ALGO_ID_SLIDING_WINDOW
+        )
+        assert (
+            c.resolve("algo", Descriptor.of(("bucket", ""))).algorithm
+            == ALGO_ID_GCRA
+        )
+        assert (
+            c.resolve("algo", Descriptor.of(("conns", ""))).algorithm
+            == ALGO_ID_CONCURRENCY
+        )
+
+    def test_wire_divider_composition(self):
+        config = load(ALGO_YAML, concurrency_ttl_s=45)
+        c = config.compiled
+        fixed = c.resolve("algo", Descriptor.of(("fixed", "")))
+        assert fixed.wire_divider == fixed.divider == 60  # id 0: identical
+        slide = c.resolve("algo", Descriptor.of(("slide", "")))
+        assert slide.wire_divider == 60 | (ALGO_ID_SLIDING_WINDOW << 28)
+        conns = c.resolve("algo", Descriptor.of(("conns", "")))
+        assert conns.divider == 45  # CONCURRENCY_TTL_S stamped at load
+        assert conns.wire_divider == 45 | (ALGO_ID_CONCURRENCY << 28)
+
+    def test_unknown_algorithm_rejected(self):
+        with pytest.raises(ConfigError, match="invalid rate limit algorithm"):
+            load(
+                """
+domain: d
+descriptors:
+  - key: k
+    rate_limit: {unit: minute, requests_per_unit: 1, algorithm: leaky_bucket}
+"""
+            )
+
+    def test_concurrency_with_unit_rejected(self):
+        with pytest.raises(ConfigError, match="takes no 'unit'"):
+            load(
+                """
+domain: d
+descriptors:
+  - key: k
+    rate_limit: {unit: minute, requests_per_unit: 1, algorithm: concurrency}
+"""
+            )
+
+    def test_non_concurrency_still_requires_unit(self):
+        with pytest.raises(ConfigError, match="invalid rate limit unit"):
+            load(
+                """
+domain: d
+descriptors:
+  - key: k
+    rate_limit: {requests_per_unit: 1, algorithm: gcra}
+"""
+            )
+
+    def test_algorithm_key_position_enforced(self):
+        # `algorithm` floated up to the descriptor level would silently be
+        # ignored; the position-aware strict pass rejects it instead
+        with pytest.raises(ConfigError, match="not valid in a descriptor"):
+            load(
+                """
+domain: d
+descriptors:
+  - key: k
+    algorithm: gcra
+    rate_limit: {unit: minute, requests_per_unit: 1}
+"""
+            )
+
+    def test_hot_reload_keeps_serving_previous_config(self):
+        svc, runtime, _cache, _store, _ts = make_service()
+        assert svc.should_rate_limit(req(("fixed", "")))[0] == Code.OK
+        # a reload with an invalid algorithm must NOT replace the config
+        runtime.files["config.algo"] = """
+domain: algo
+descriptors:
+  - key: fixed
+    rate_limit: {unit: minute, requests_per_unit: 5, algorithm: nonsense}
+"""
+        runtime.touch()
+        overall, statuses, _ = svc.should_rate_limit(req(("fixed", "")))
+        assert overall == Code.OK  # old rule still matches and serves
+        config = svc.get_current_config()
+        rec = config.compiled.resolve("algo", Descriptor.of(("fixed", "")))
+        assert rec is not None and rec.algorithm == 0
+
+    def test_ids_pinned_to_kernel_constants(self):
+        from api_ratelimit_tpu.ops import slab
+        from api_ratelimit_tpu.persist import snapshot
+        from api_ratelimit_tpu.testing import oracle
+
+        assert ALGORITHM_IDS == {
+            "fixed_window": slab.ALGO_FIXED_WINDOW,
+            "sliding_window": slab.ALGO_SLIDING_WINDOW,
+            "gcra": slab.ALGO_GCRA,
+            "concurrency": slab.ALGO_CONCURRENCY,
+        }
+        assert oracle.ALGO_SHIFT == slab.ALGO_SHIFT == snapshot.ALGO_SHIFT
+        assert (
+            oracle.ALGO_DIV_MASK
+            == slab.ALGO_DIV_MASK
+            == snapshot.ALGO_DIV_MASK
+        )
+        assert oracle.HEALTH_WIDTH == slab.HEALTH_WIDTH
+        assert snapshot.ALGO_NAMES == {
+            i: n for n, i in ALGORITHM_IDS.items()
+        }
+
+
+class TestRollbackArm:
+    """All-rules-default config == the pre-algorithm engine, spy-pinned:
+    same wire rows (divider word high bits zero), pallas guard never
+    flips, slab rows keep zero cols 6-7."""
+
+    def test_default_config_wire_and_slab_bytes(self):
+        svc, _runtime, cache, _store, _ts = make_service(
+            yaml_text="""
+domain: algo
+descriptors:
+  - key: fixed
+    rate_limit: {unit: minute, requests_per_unit: 5}
+"""
+        )
+        captured = []
+        real = cache._batcher._execute
+
+        def spy(blocks):
+            captured.append([np.array(b) for b in blocks])
+            return real(blocks)
+
+        cache._batcher._execute = spy
+        for _ in range(3):
+            assert svc.should_rate_limit(req(("fixed", "")))[0] == Code.OK
+        rows = np.concatenate([b for bs in captured for b in bs], axis=1)
+        # the divider column is the PLAIN window length — no algorithm bits
+        assert (rows[4] == 60).all()
+        engine = cache.engine
+        assert engine._algos_seen is False  # pallas arm untouched
+        table = np.asarray(engine._state.table)
+        occupied = table.any(axis=1)
+        assert occupied.any()
+        # pre-algorithm slab bytes: divider plain, cols 6-7 zero
+        assert (table[occupied, 5] == 60).all()
+        assert (table[:, 6] == 0).all() and (table[:, 7] == 0).all()
+
+    def test_non_fixed_traffic_flips_engine_to_xla(self):
+        svc, _runtime, cache, _store, _ts = make_service()
+        assert cache.engine._algos_seen is False
+        svc.should_rate_limit(req(("bucket", "")))
+        assert cache.engine._algos_seen is True
+
+
+class TestAlgorithmsThroughService:
+    def test_sliding_window_carries_across_edge(self):
+        ts = FakeTimeSource(999_960 + 50)  # late in window [999960, 1000020)
+        svc, _r, _c, _s, _ = make_service(ts=ts)
+        for _ in range(6):  # fill the sliding limit (6/min)
+            assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OVER_LIMIT
+        # 15s into the NEXT window: prev raw count is 7 (sliding counts
+        # denied hits too), carry = floor(7 * 45/60) = 5, so ONE more
+        # admits — a fixed window would re-admit all 6 (the 2x burst)
+        ts.now = 1_000_020 + 15
+        codes = [
+            svc.should_rate_limit(req(("slide", "")))[0] for _ in range(4)
+        ]
+        assert codes == [
+            Code.OK, Code.OVER_LIMIT, Code.OVER_LIMIT, Code.OVER_LIMIT,
+        ]
+        # late in the window the carry has decayed; admits resume
+        ts.now = 1_000_020 + 55
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OK
+
+    def test_gcra_burst_then_rate(self):
+        svc, _r, _c, _s, ts = make_service()
+        # burst up to the limit admits, then denies (tau exhausted)
+        codes = [
+            svc.should_rate_limit(req(("bucket", "")))[0] for _ in range(6)
+        ]
+        assert codes[:4] == [Code.OK] * 4  # limit 4/min
+        assert codes[4] == Code.OVER_LIMIT
+        # T = 60s/4 = 15s: one emission drains every 15s
+        ts.advance(15)
+        assert svc.should_rate_limit(req(("bucket", "")))[0] == Code.OK
+        assert (
+            svc.should_rate_limit(req(("bucket", "")))[0] == Code.OVER_LIMIT
+        )
+
+    def test_concurrency_cap_and_release(self):
+        svc, _r, cache, _s, ts = make_service(ts=FakeTimeSource(1_000_000))
+        for _ in range(3):  # cap 3
+            assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OVER_LIMIT
+        # Release frees one slot; the next acquire admits again
+        released = svc.release(req(("conns", "")))
+        assert released == 1
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OVER_LIMIT
+        # non-concurrency descriptors are ignored by the release path
+        assert svc.release(req(("fixed", ""))) == 0
+
+    def test_concurrency_ttl_reclaims_leaked_slots(self):
+        ts = FakeTimeSource(1_000_000)
+        svc, _r, _c, _s, _ = make_service(ts=ts)
+        for _ in range(3):
+            assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OVER_LIMIT
+        # every holder dies without releasing; past the idle TTL (default
+        # 60s) the whole row is reclaimed and acquires admit again
+        ts.advance(120)
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
+
+    def test_concurrency_skips_over_limit_local_cache(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        scope = store.scope("ratelimit")
+        cache = make_cache(ts, local_cache_size=1 << 16, stats_scope=scope)
+        runtime = FakeRuntime({"config.algo": ALGO_YAML})
+        svc = RateLimitService(
+            runtime=runtime,
+            cache=cache,
+            stats_scope=scope.scope("service"),
+            time_source=ts,
+        )
+        for _ in range(3):
+            svc.should_rate_limit(req(("conns", "")))
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OVER_LIMIT
+        # a denial must NOT be cached: a release immediately unblocks
+        svc.release(req(("conns", "")))
+        assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
+
+    def test_algo_stats_and_journey_tag(self):
+        from api_ratelimit_tpu.tracing import journeys
+
+        store = Store(TestSink())
+        scope = store.scope("ratelimit")
+        svc, _r, _c, _s, _ts = make_service(stats_scope=scope)
+        recorder = journeys.JourneyRecorder(retain=16, ring=16)
+        journeys.set_global_recorder(recorder)
+        try:
+            for _ in range(5):
+                svc.should_rate_limit(req(("bucket", "")))
+        finally:
+            journeys.set_global_recorder(None)
+        # counters live under ratelimit.algo.gcra.*
+        assert scope.scope("algo").counter("gcra.decisions").value() == 5
+        # limit 4/min: the fifth decision denied
+        assert scope.scope("algo").counter("gcra.over_limit").value() == 1
+        snap = recorder.snapshot()
+        journeys_seen = list(snap["retained"]) + [
+            j for ring in snap["recent"].values() for j in ring
+        ]
+        stages = {s for j in journeys_seen for s in j.get("stages", {})}
+        assert "algo_gcra" in stages
+
+
+class TestReleaseHttpSurface:
+    def test_post_release_decrements(self):
+        import json as _json
+        import urllib.request
+
+        from api_ratelimit_tpu.server.http_server import (
+            HttpServer,
+            add_json_handler,
+        )
+
+        svc, _r, _c, _s, _ts = make_service()
+        server = HttpServer("127.0.0.1", 0, "test-release")
+        add_json_handler(server, svc)
+        server.serve_background()
+        try:
+            port = server.port
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=body.encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req) as r:
+                        return r.status, r.read().decode()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read().decode()
+
+            body = _json.dumps(
+                {
+                    "domain": "algo",
+                    "descriptors": [{"entries": [{"key": "conns"}]}],
+                }
+            )
+            for _ in range(3):  # cap 3: fill it over /json
+                assert post("/json", body)[0] == 200
+            assert post("/json", body)[0] == 429
+            status, text = post("/release", body)
+            assert status == 200
+            assert _json.loads(text) == {"released": 1}
+            assert post("/json", body)[0] == 200  # slot freed
+            assert post("/release", "")[0] == 400  # malformed body: 400
+        finally:
+            server.shutdown()
+
+
+class TestLeaseStories:
+    def _table(self, base):
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+
+        return LeaseTable(base, min_size=4, max_size=64)
+
+    def test_concurrency_never_leased(self):
+        ts = FakeTimeSource(1_000_000)
+        base = BaseRateLimiter(ts)
+        lease = self._table(base)
+        config = load(ALGO_YAML)
+        rec = config.compiled.resolve("algo", Descriptor.of(("conns", "")))
+        assert lease.plan_grant(rec, 1, 1_000_000) is None
+
+    def test_fixed_and_gcra_lease_plans(self):
+        ts = FakeTimeSource(1_000_000)
+        base = BaseRateLimiter(ts)
+        lease = self._table(base)
+        config = load(ALGO_YAML)
+        fixed = config.compiled.resolve("algo", Descriptor.of(("fixed", "")))
+        gcra = config.compiled.resolve("algo", Descriptor.of(("bucket", "")))
+        assert lease.plan_grant(fixed, 1, 1_000_000) is not None
+        planned = lease.plan_grant(gcra, 1, 1_000_000)
+        assert planned is not None  # a GCRA lease is a TAT slice
+        lease.abort_grant(planned)
+
+    def test_denied_gcra_rider_aborts_grant(self):
+        """A denied GCRA grant rider reserved no TAT slice: the cache must
+        abort the grant (no lease installed) and still answer the caller
+        with a denial. Construction: limit 2/min (T = 30s, tau = 30s),
+        rider size 2, so each granted launch advances the TAT by 1.5
+        windows — after two window-spaced grants the third window's rider
+        arrives with the TAT past tau and is denied."""
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+
+        ts = FakeTimeSource(1_000_020)  # exact window start
+        base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+        lease = LeaseTable(base, min_size=2, max_size=64)
+        cache = TpuRateLimitCache(
+            base,
+            n_slots=1 << 12,
+            buckets=(128,),
+            max_batch=128,
+            use_pallas=False,
+            lease_table=lease,
+        )
+        config = load(ALGO_YAML)
+        resolved = [
+            config.compiled.resolve("algo", d)
+            for d in req(("bucket2", "")).descriptors
+        ]
+        cache.do_limit_resolved(req(("bucket2", "")), resolved)  # TAT 90s
+        ts.advance(60)
+        cache.do_limit_resolved(req(("bucket2", "")), resolved)  # TAT 120s
+        ts.advance(60)
+        # rider arrives with tat0 = 60s > tau = 30s: denied, aborted
+        resp = cache.do_limit_resolved(req(("bucket2", "")), resolved)
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        _live, tokens = lease.outstanding()
+        assert tokens == 0  # no phantom TAT slice survives a denial
+        cache.close()
+
+
+class TestSnapshotRoundTrip:
+    def test_pre_algorithm_v2_rows_reconcile_zero_drops(self):
+        """A v2 snapshot from before this PR (algo bits all zero) must
+        classify every row fixed_window and reconcile with zero NEW drops
+        — bit-identical keep/drop decisions to the old rule."""
+        from api_ratelimit_tpu.persist.snapshot import (
+            reconcile_rows,
+            row_algorithms,
+        )
+
+        now = 1_000_000
+        table = np.zeros((8, 8), dtype=np.uint32)
+        # live in-window row, live window-ended row, dead row
+        table[0] = (1, 2, 5, now - now % 60, now + 50, 60, 0, 0)
+        table[1] = (3, 4, 7, now - 600, now + 50, 60, 0, 0)
+        table[2] = (5, 6, 9, now - 600, now - 10, 60, 0, 0)
+        assert (row_algorithms(table) == 0).all()
+        rec, stats = reconcile_rows(table, now)
+        assert stats == {
+            "restored": 1,
+            "dropped_expired": 1,
+            "dropped_window": 1,
+        }
+
+    def test_algorithm_rows_reconcile_by_their_own_semantics(self):
+        from api_ratelimit_tpu.persist.snapshot import reconcile_rows
+
+        now = 1_000_000
+        table = np.zeros((8, 8), dtype=np.uint32)
+        # GCRA with TAT still ahead (window = tat_sec - div): kept
+        table[0] = (1, 2, 3, now + 30 - 60, now + 50, 60 | (2 << 28), now + 30, 500)
+        # GCRA fully drained (tat_sec <= now): dropped as window-ended
+        table[1] = (3, 4, 0, now - 10 - 60, now + 50, 60 | (2 << 28), now - 10, 0)
+        # concurrency touched recently (idle TTL 60): kept
+        table[2] = (5, 6, 2, now - 5, now + 55, 60 | (3 << 28), 0, 0)
+        rec, stats = reconcile_rows(table, now)
+        assert stats["restored"] == 2
+        assert stats["dropped_window"] == 1
+        assert rec[0].any() and rec[2].any() and not rec[1].any()
+
+    def test_snapshot_inspect_renders_algorithms(self, tmp_path):
+        import tools.snapshot_inspect as si
+        from api_ratelimit_tpu.persist.snapshot import write_snapshot
+
+        now = 1_000_000
+        table = np.zeros((8, 8), dtype=np.uint32)
+        table[0] = (1, 2, 5, now, now + 50, 60, 0, 0)
+        table[1] = (3, 4, 3, now, now + 50, 60 | (1 << 28), 2, 0)
+        table[2] = (5, 6, 1, now, now + 50, 60 | (2 << 28), now, 10)
+        table[3] = (7, 8, 2, now, now + 55, 60 | (3 << 28), 0, 0)
+        path = str(tmp_path / "algo.snap")
+        write_snapshot(path, table, created_at=now, ways=4)
+        report = si.inspect_file(path, now)
+        assert report["algorithms"] == {
+            "fixed_window": 1,
+            "sliding_window": 1,
+            "gcra": 1,
+            "concurrency": 1,
+        }
+        # masked dividers: the algorithm bits never leak into the report
+        assert report["rows"]["dividers"] == [60]
+
+    def test_restore_of_algorithm_rows_flips_engine_guard(self):
+        ts = FakeTimeSource(1_000_000)
+        cache = make_cache(ts)
+        engine = cache.engine
+        assert engine._algos_seen is False
+        table = np.zeros((1 << 12, 8), dtype=np.uint32)
+        table[0] = (1, 2, 3, 999_970, 1_000_050, 60 | (2 << 28), 1_000_030, 0)
+        engine.import_tables([table])
+        assert engine._algos_seen is True
+
+
+class TestSettingsKnobs:
+    def test_concurrency_ttl_validation(self):
+        from api_ratelimit_tpu.settings import Settings
+
+        s = Settings()
+        assert s.concurrency_ttl() == 60
+        s.concurrency_ttl_s = 0
+        with pytest.raises(ValueError, match="CONCURRENCY_TTL_S"):
+            s.concurrency_ttl()
+        s.concurrency_ttl_s = 1 << 28
+        with pytest.raises(ValueError, match="CONCURRENCY_TTL_S"):
+            s.concurrency_ttl()
+
+    def test_gcra_burst_validation(self):
+        from api_ratelimit_tpu.settings import Settings
+
+        s = Settings()
+        assert s.gcra_burst() == 1.0
+        for junk in (0.0, -1.0, 17.0):
+            s.gcra_burst_ratio = junk
+            with pytest.raises(ValueError, match="GCRA_BURST_RATIO"):
+                s.gcra_burst()
+
+    def test_env_parsing_rejects_junk(self):
+        from api_ratelimit_tpu.settings import new_settings
+
+        s = new_settings({"CONCURRENCY_TTL_S": "120", "GCRA_BURST_RATIO": "0.5"})
+        assert s.concurrency_ttl() == 120 and s.gcra_burst() == 0.5
+        with pytest.raises(ValueError, match="CONCURRENCY_TTL_S"):
+            new_settings({"CONCURRENCY_TTL_S": "soon"})

@@ -324,7 +324,7 @@ class TestSlabHealth:
     def test_no_loss_on_clean_traffic(self):
         state = make_slab(N_SLOTS)
         state, res = run(state, [(KEY_A, 1, 10, 60), (KEY_B, 1, 10, 60)], now=1000)
-        assert [int(v) for v in res.health] == [0, 0, 0, 0]
+        assert [int(v) for v in res.health] == [0, 0, 0, 0, 0]
 
     def test_within_batch_contention_drop_counted(self):
         # 4 sets x 1 way: three distinct keys with equal fp_lo mod 4 fight
@@ -333,7 +333,7 @@ class TestSlabHealth:
         state = make_slab(4)
         keys = [(0x0 << 32) | 0x10, (0x1 << 32) | 0x20, (0x2 << 32) | 0x30]
         state, res = run(state, [(k, 1, 10, 60) for k in keys], now=1000, ways=1)
-        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        ev_exp, ev_win, ev_live, drops, _resets = (int(v) for v in res.health)
         assert drops == 2
         assert (ev_exp, ev_win, ev_live) == (0, 0, 0)  # fresh ways: no evict
         # every item still got a decision (fail open)
@@ -347,9 +347,9 @@ class TestSlabHealth:
         light = (0x6 << 32) | 0x1
         state, _ = run(state, [(heavy, 5, 100, 60)], now=1000, ways=2)
         state, res = run(state, [(light, 1, 100, 60)], now=1000, ways=2)
-        assert [int(v) for v in res.health] == [0, 0, 0, 0]
+        assert [int(v) for v in res.health] == [0, 0, 0, 0, 0]
         state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=1000, ways=2)
-        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        ev_exp, ev_win, ev_live, drops, _resets = (int(v) for v in res.health)
         assert (ev_exp, ev_win, ev_live, drops) == (0, 0, 1, 0)
         assert int(res.after[0]) == 1  # the evictor starts fresh
         # the heavy key survived (the light one was the victim)
@@ -366,7 +366,7 @@ class TestSlabHealth:
         state, _ = run(state, [(ended_key, 7, 100, 1, 300)], now=1000, ways=2)
         state, _ = run(state, [(open_key, 3, 100, 3600)], now=1002, ways=2)
         state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=1002, ways=2)
-        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        ev_exp, ev_win, ev_live, drops, _resets = (int(v) for v in res.health)
         assert (ev_exp, ev_win, ev_live, drops) == (0, 1, 0, 0)
         # the open-window counter survived
         state, res = run(state, [(open_key, 1, 100, 3600)], now=1002, ways=2)
@@ -382,7 +382,7 @@ class TestSlabHealth:
         state, _ = run(state, [(dead_key, 2, 100, 1)], now=1000, ways=2)
         state, _ = run(state, [(live_key, 4, 100, 3600)], now=2000, ways=2)
         state, res = run(state, [((0x7 << 32) | 0x2, 1, 100, 60)], now=2000, ways=2)
-        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        ev_exp, ev_win, ev_live, drops, _resets = (int(v) for v in res.health)
         assert (ev_exp, ev_win, ev_live, drops) == (1, 0, 0, 0)
         state, res = run(state, [(live_key, 1, 100, 3600)], now=2000, ways=2)
         assert int(res.before[0]) == 4
@@ -398,7 +398,7 @@ class TestSlabHealth:
         # same batch: a matches its live row, b would have to evict it
         state, res = run(state, [(b, 1, 100, 3600), (a, 1, 100, 3600)], now=1000, ways=1)
         assert [int(x) for x in res.after] == [1, 3]
-        ev_exp, ev_win, ev_live, drops = (int(v) for v in res.health)
+        ev_exp, ev_win, ev_live, drops, _resets = (int(v) for v in res.health)
         assert drops == 1  # b's insert lost
         assert ev_live == 0  # and displaced nothing
         state, res = run(state, [(a, 1, 100, 3600)], now=1000, ways=1)

@@ -25,6 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from api_ratelimit_tpu.ops.slab import (
+    ALGO_CONC_RELEASE,
+    ALGO_CONCURRENCY,
+    ALGO_FIXED_WINDOW,
+    ALGO_GCRA,
+    ALGO_SHIFT,
+    ALGO_SLIDING_WINDOW,
     OUT_AFTER,
     OUT_BEFORE,
     OUT_CODE,
@@ -232,6 +238,115 @@ class TestMidWindowEvictThenReinsert:
             got = h.step([(fp_lo, fp_hi, 1, 100, 3600, 0)], now, label="survivor")
             assert int(got["before"][0]) == c
         h.assert_tables_equal()
+
+
+class TestFuzzMixedAlgorithmBatches:
+    """Differential fuzz of the sibling decision kernels: fixed-window,
+    sliding-window, GCRA, and concurrency keys INTERLEAVED in one launch,
+    bit-exact against the multi-algorithm host oracle — per-item
+    before/after/code, the health vector (including algorithm-change
+    resets), and the final row table. Each algorithm clears >= 10k fuzzed
+    decisions across the campaign classes below (the acceptance bar);
+    SLAB_FUZZ_EXAMPLES deepens it on idle hardware."""
+
+    # one stable rule per key id: the production invariant (one fp == one
+    # rule == one algorithm per config generation)
+    @staticmethod
+    def _rule(key_id: int):
+        algo = (
+            ALGO_FIXED_WINDOW,
+            ALGO_SLIDING_WINDOW,
+            ALGO_GCRA,
+            ALGO_CONCURRENCY,
+        )[key_id % 4]
+        limit = 2 + key_id % 7
+        div = (5, 30, 60)[key_id % 3]
+        jit = key_id % 5
+        return algo, limit, div, jit
+
+    def _item(self, key_id: int, hits: int, release: bool = False):
+        algo, limit, div, jit = self._rule(key_id)
+        if release and algo == ALGO_CONCURRENCY:
+            algo = ALGO_CONC_RELEASE
+        return (*_fp(key_id), hits, limit, div | (algo << ALGO_SHIFT), jit)
+
+    def test_interleaved_streams_match_oracle(self):
+        examples = FUZZ_EXAMPLES or 40
+        per_algo = [0, 0, 0, 0]
+        for seed in range(examples):
+            rng = np.random.default_rng(30_000 + seed)
+            h = _Harness(n_slots=32, ways=4, pad_to=32)
+            now = 700_000
+            for batch_no in range(10):
+                now += int(rng.integers(0, 40))
+                size = int(rng.integers(1, 33))
+                items = []
+                for _ in range(size):
+                    key_id = int(rng.integers(0, 40))
+                    release = bool(rng.integers(0, 3) == 0)
+                    items.append(
+                        self._item(key_id, int(rng.integers(1, 4)), release)
+                    )
+                    per_algo[key_id % 4] += 1
+                h.step(items, now, label=(seed, batch_no))
+            h.assert_tables_equal(label=seed)
+        # every algorithm genuinely interleaves in this class (the >= 10k
+        # per-algorithm depth bar is test_per_algorithm_depth's job)
+        assert all(n >= 1000 for n in per_algo), per_algo
+        assert sum(per_algo) >= 5_000
+
+    def test_per_algorithm_depth(self):
+        """>= 10k decisions per NON-FIXED algorithm (fixed clears its own
+        bar in the legacy classes above), duplicate-heavy so the segment
+        serialization rules (GCRA admit prefix, concurrency
+        acquire/release ordering, sliding carry) are hammered."""
+        for algo_base in (1, 2, 3):  # sliding, gcra, concurrency key ids
+            done = 0
+            seed0 = 50_000 + algo_base
+            batch_no = 0
+            h = _Harness(n_slots=16, ways=4, pad_to=64)
+            rng = np.random.default_rng(seed0)
+            now = 800_000
+            while done < 10_000:
+                now += int(rng.integers(0, 25))
+                size = int(rng.integers(32, 65))
+                items = []
+                for _ in range(size):
+                    # 12 keys of this algorithm: heavy duplication + way
+                    # contention in every batch
+                    key_id = algo_base + 4 * int(rng.integers(0, 12))
+                    release = bool(rng.integers(0, 3) == 0)
+                    items.append(
+                        self._item(key_id, int(rng.integers(1, 4)), release)
+                    )
+                h.step(items, now, label=(seed0, batch_no))
+                done += size
+                batch_no += 1
+            h.assert_tables_equal(label=seed0)
+            assert done >= 10_000
+
+    def test_algorithm_change_on_reload_resets_and_counts(self):
+        """Mid-window algorithm change (a hot reload swapping a rule's
+        algorithm between launches): the fingerprint still matches the
+        row, but the stored state resets to zero and the reset is counted
+        in the health vector — on both the kernel and the oracle."""
+        h = _Harness(n_slots=8, ways=4, pad_to=8)
+        now = 700_000
+        fp_lo, fp_hi = _fp(7)
+        fixed = (fp_lo, fp_hi, 1, 10, 60, 0)
+        for _ in range(5):
+            h.step([fixed], now)
+        assert int(h.oracle.table[:, 2].max()) == 5
+        # reload flips the rule to GCRA mid-window: same fp, state resets
+        gcra = (fp_lo, fp_hi, 1, 10, 60 | (ALGO_GCRA << ALGO_SHIFT), 0)
+        got = h.step([gcra], now, label="algo flip")
+        assert int(got["after"][0]) == 1  # fresh TAT, not counter 6
+        assert h.oracle.health[4] == 1  # the reset is counted
+        # and flipping back resets again, counted again
+        got = h.step([fixed], now, label="flip back")
+        assert int(got["before"][0]) == 0 and int(got["after"][0]) == 1
+        assert h.oracle.health[4] == 2
+        h.assert_tables_equal(label="algo change")
 
 
 class TestAtScaleOneSidedParity:

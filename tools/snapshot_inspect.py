@@ -29,10 +29,13 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
+    ALGO_DIV_MASK,
+    ALGO_NAMES,
     COL_COUNT,
     COL_DIVIDER,
     COL_EXPIRE,
     COL_WINDOW,
+    row_algorithms,
     FLAG_LEASE_TABLE,
     LEASE_COL_EXPIRE,
     LEASE_COL_GRANTED,
@@ -101,10 +104,18 @@ def inspect_file(path: str, now: int | None) -> dict:
             "full_sets": full_sets,
             "max_set_occupancy": max(nonzero) if nonzero else 0,
         }
+    # per-row algorithm class (divider word bits 28-30; pre-algorithm
+    # files carry 0 everywhere => all rows classify fixed_window)
+    algos = row_algorithms(table)
+    algo_counts = {
+        name: int(np.sum(occupied & (algos == aid)))
+        for aid, name in ALGO_NAMES.items()
+    }
     report = {
         "path": path,
         "valid": True,
         "kind": "slab",
+        "algorithms": algo_counts,
         "version": header.version,
         "needs_migration": header.version < SNAPSHOT_VERSION,
         "sets": set_view,
@@ -124,7 +135,9 @@ def inspect_file(path: str, now: int | None) -> dict:
             "count_max": int(counts[occupied].max()) if occupied.any() else 0,
             "dividers": sorted(
                 int(d)
-                for d in np.unique(table[occupied, COL_DIVIDER])
+                for d in np.unique(
+                    table[occupied, COL_DIVIDER] & np.uint32(ALGO_DIV_MASK)
+                )
             )
             if occupied.any()
             else [],
@@ -182,6 +195,10 @@ def _print_text(report: dict) -> None:
         f"  counts  sum={rows['count_sum']} max={rows['count_max']} "
         f"dividers={rows['dividers']} window_span={rows['window_span_s']}s"
     )
+    algos = report.get("algorithms")
+    if algos:
+        body = " ".join(f"{k}:{v}" for k, v in algos.items() if v)
+        print(f"  algos   {body or 'fixed_window:0 (empty)'}")
     if report.get("needs_migration"):
         print(
             f"  layout  v{report['version']} open-addressed — boot will "
