@@ -44,6 +44,7 @@ from ..limiter.cache import CacheError
 from ..limiter.cache_key import generate_cache_key
 from ..models.config import (
     ALGO_ID_CONCURRENCY,
+    ALGO_ID_FIXED_WINDOW,
     ALGO_ID_GCRA,
     RateLimit,
 )
@@ -667,6 +668,10 @@ class SlabDeviceEngine:
         applied the expiry reconciliation before calling)."""
         if self._engine is not None:
             self._engine.import_tables(tables)
+            if self._engine.algos_seen:
+                # keep the backend's own sticky guard in sync so its
+                # pre-launch check (and logging) agree with the engine
+                self._algos_seen = True
             return
         if len(tables) != 1:
             raise ValueError(
@@ -744,6 +749,11 @@ class SlabDeviceEngine:
                 # fixed_window-only). One .max() over a row slice — no
                 # temporaries, sub-microsecond at any bucket size.
                 self._algos_seen = True
+                if self._engine is not None:
+                    # mesh mode bakes use_pallas into the sharded step
+                    # functions — flip them too, or sliding/GCRA/release
+                    # rows would still run the fixed-window Mosaic body
+                    self._engine.note_algos_seen()
                 if self._use_pallas:
                     _log.info(
                         "non-fixed rate-limit algorithm on the wire: "
@@ -1392,11 +1402,12 @@ class TpuRateLimitCache:
                 keys[i] = key
                 # shadow rules never consult the over-limit cache
                 # (base_limiter.is_over_limit_with_local_cache rationale);
-                # neither do concurrency caps — a denial is not sticky for
-                # a window there: the next Release can free a slot
+                # neither does any non-fixed algorithm — a denial is not
+                # sticky for a window there: a Release can free a slot, a
+                # GCRA TAT drains continuously, a sliding position decays
                 if (
                     not rec.shadow_mode
-                    and rec.algorithm != ALGO_ID_CONCURRENCY
+                    and rec.algorithm == ALGO_ID_FIXED_WINDOW
                     and local_cache.contains(key)
                 ):
                     if over_local is None:

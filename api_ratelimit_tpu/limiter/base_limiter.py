@@ -137,10 +137,14 @@ class BaseRateLimiter:
         # fabricate the staging metrics the operator is watching.
         if limit is not None and limit.shadow_mode:
             return False
-        # concurrency caps never cache denials: the very next Release can
-        # free a slot, so a window-stamped "over" entry would deny callers
-        # the cap no longer rejects
-        if limit is not None and limit.algorithm == "concurrency":
+        # only fixed_window denials are sticky for the rest of a window,
+        # so only fixed_window consults the cache. For every sibling
+        # algorithm a cached "over" entry would deny traffic the
+        # algorithm itself admits: a concurrency Release can free a slot
+        # immediately, a GCRA TAT drains continuously (unit=hour,
+        # limit=3600 re-admits one request per second), and a sliding
+        # interpolated position decays mid-window.
+        if limit is not None and limit.algorithm != "fixed_window":
             return False
         return self.local_cache is not None and self.local_cache.contains(key)
 
@@ -192,12 +196,14 @@ class BaseRateLimiter:
             if (
                 self.local_cache is not None
                 and not limit.shadow_mode
-                and limit.algorithm != "concurrency"
+                and limit.algorithm == "fixed_window"
             ):
                 # TTL = the full unit duration; the window-stamped key ages out
                 # naturally at the window boundary. Shadow-mode rules skip the
                 # cache: its hits short-circuit evaluation, and a staged rule
-                # must keep counting real traffic.
+                # must keep counting real traffic. Non-fixed algorithms never
+                # seed it — their denials are not sticky for a window (the
+                # is_over_limit_with_local_cache rationale above).
                 self.local_cache.set(key, unit_to_divider(limit.unit))
         else:
             status = DescriptorStatus(

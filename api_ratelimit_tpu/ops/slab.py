@@ -275,7 +275,7 @@ def _gather_sets(state: SlabState, batch: SlabBatch, ways: int):
     return set_idx, rows
 
 
-def _scan_ways(rows, fp_lo, fp_hi, now, ways: int):
+def _scan_ways(rows, fp_lo, fp_hi, now, ways: int, multi_algo: bool = True):
     """The W-wide scan arithmetic on PRE-GATHERED sets — the XLA twin of
     pallas_way_scan (ops/pallas_slab.py swaps in for exactly this
     function): (int32[b] way, bool[b] match_any). Standalone so the
@@ -288,9 +288,8 @@ def _scan_ways(rows, fp_lo, fp_hi, now, ways: int):
     # the all-fixed scan is bit-identical to the pre-algorithm one; for
     # GCRA rows the stored window is tat_sec - divider, so the SAME rule
     # classifies a drained TAT as reclaimable ahead of any live row.
-    divider = rows[:, :, COL_DIVIDER].astype(jnp.int32) & jnp.int32(
-        ALGO_DIV_MASK
-    )
+    raw_div = rows[:, :, COL_DIVIDER].astype(jnp.int32)
+    divider = raw_div & jnp.int32(ALGO_DIV_MASK)
     count = rows[:, :, COL_COUNT]
     live = expire > now
     match = (
@@ -298,7 +297,21 @@ def _scan_ways(rows, fp_lo, fp_hi, now, ways: int):
         & (rows[:, :, COL_FP_LO] == fp_lo[:, None])
         & (rows[:, :, COL_FP_HI] == fp_hi[:, None])
     )
-    window_ended = live & (divider > 0) & (window + divider <= now)
+    if multi_algo:
+        # sliding rows carry the count the NEXT window's interpolation
+        # reads for one window past their own end (the 2-window
+        # expire_at, expire_store below) — don't tier that state
+        # reclaimable until the grace window also passed, or boundary
+        # keys lose their 2x-burst protection to any colliding insert.
+        # Static-gated so the all-fixed compiled program stays
+        # byte-identical to the pre-algorithm engine (the rollback arm).
+        algo = (raw_div >> jnp.int32(ALGO_SHIFT)) & jnp.int32(7)
+        span = jnp.where(
+            algo == jnp.int32(ALGO_SLIDING_WINDOW), divider * 2, divider
+        )
+    else:
+        span = divider
+    window_ended = live & (divider > 0) & (window + span <= now)
 
     way_bits = max(1, (ways - 1).bit_length())
     way_iota = jnp.arange(ways, dtype=jnp.int32)
@@ -338,6 +351,7 @@ def _choose_ways(
     ways: int,
     use_pallas: bool = False,
     interpret: bool = False,
+    multi_algo: bool = True,
 ):
     """The W-wide set scan; returns (int32[b] chosen slot = set * W + way —
     n_slots for padding, int32[b] eviction class (EVICT_*), bool[b]
@@ -378,18 +392,26 @@ def _choose_ways(
         )
     else:
         way, match_any = _scan_ways(
-            rows, batch.fp_lo, batch.fp_hi, now, ways
+            rows, batch.fp_lo, batch.fp_hi, now, ways, multi_algo=multi_algo
         )
     chosen = set_idx * jnp.int32(ways) + way
     picked_rows = jnp.take_along_axis(rows, way[:, None, None], axis=1)[:, 0]
 
     p_expire = picked_rows[:, COL_EXPIRE].astype(jnp.int32)
     p_window = picked_rows[:, COL_WINDOW].astype(jnp.int32)
-    p_div = picked_rows[:, COL_DIVIDER].astype(jnp.int32) & jnp.int32(
-        ALGO_DIV_MASK
-    )
+    p_raw_div = picked_rows[:, COL_DIVIDER].astype(jnp.int32)
+    p_div = p_raw_div & jnp.int32(ALGO_DIV_MASK)
+    if multi_algo:
+        # the same sliding grace window the scan's tiering applies — the
+        # eviction-mix health counters must classify what the scan saw
+        p_algo = (p_raw_div >> jnp.int32(ALGO_SHIFT)) & jnp.int32(7)
+        p_span = jnp.where(
+            p_algo == jnp.int32(ALGO_SLIDING_WINDOW), p_div * 2, p_div
+        )
+    else:
+        p_span = p_div
     p_live = p_expire > now
-    p_window_ended = p_live & (p_div > 0) & (p_window + p_div <= now)
+    p_window_ended = p_live & (p_div > 0) & (p_window + p_span <= now)
     valid = batch.hits > 0
     # classification of what the insert displaced: a never-written way
     # (expire_at == 0) is a fresh slot, not an eviction
@@ -478,7 +500,8 @@ def _slab_update_sorted(
     now = now.astype(jnp.int32)
 
     chosen, evict_class, matched, picked_rows = _choose_ways(
-        state, batch, now, ways, use_pallas=use_pallas, interpret=interpret
+        state, batch, now, ways, use_pallas=use_pallas, interpret=interpret,
+        multi_algo=multi_algo,
     )
 
     b = chosen.shape[0]
@@ -550,11 +573,13 @@ def _slab_update_sorted(
         s_after = outs[1].astype(jnp.uint32)
         cur_window = outs[2]
         expire_at = outs[3]
-        # the Mosaic kernels implement fixed_window only; the engine's
-        # sticky algorithms guard (backends/tpu.py) routes any launch that
-        # could see a non-fixed row or request to the XLA twin below, so
-        # this branch always runs with algo id 0 everywhere — the stores
-        # below are the pre-algorithm bytes verbatim
+        # the Mosaic kernels implement fixed_window only; the sticky
+        # algorithms guards (backends/tpu.py _algos_seen for the
+        # single-device engine, parallel/sharded_slab.py note_algos_seen
+        # for the mesh engine) route any launch that could see a
+        # non-fixed row or request to the XLA twin below, so this branch
+        # always runs with algo id 0 everywhere — the stores below are
+        # the pre-algorithm bytes verbatim
         s_div_eff = s_div
         count_store = s_after
         window_store = cur_window

@@ -68,6 +68,8 @@ def _require_shard_map():
     return shard_map
 
 from ..ops.slab import (
+    ALGO_SHIFT,
+    COL_DIVIDER,
     DEFAULT_WAYS,
     HEALTH_ALGO_RESETS,
     HEALTH_DROPS,
@@ -76,6 +78,7 @@ from ..ops.slab import (
     HEALTH_EVICT_WINDOW,
     HEALTH_WIDTH,
     PACKED_OUT_ROWS,
+    ROW_DIVIDER,
     ROW_FP_HI,
     ROW_FP_LO,
     ROW_HITS,
@@ -328,6 +331,13 @@ class ShardedSlabEngine:
             self._state_sharding,
         )
         self._use_pallas = use_pallas
+        # Sticky algorithms guard, mesh edition (the single-device twin is
+        # backends/tpu.py _algos_seen): the Mosaic kernels implement
+        # fixed_window only, so the first launch or restored table that
+        # carries a non-fixed algorithm id (divider-word bits 28-30)
+        # rebuilds every cached step function on the XLA twin permanently.
+        # An all-fixed config never flips, keeping the pallas arm intact.
+        self._algos_seen = False
         self._step = sharded_slab_step(mesh, ways=self.ways, use_pallas=use_pallas)
         self._after_steps: dict[int, object] = {}
         self._compact_steps: dict[int, object] = {}
@@ -351,9 +361,45 @@ class ShardedSlabEngine:
         self._state_lock = threading.Lock()
         self._pending_health: list = []
 
+    @property
+    def algos_seen(self) -> bool:
+        return self._algos_seen
+
+    def note_algos_seen(self) -> None:
+        """Flip the sticky algorithms guard: from here on every launch
+        runs the XLA kernels. Idempotent; called by the backend when its
+        own guard flips, by import_tables on a restored table carrying
+        algorithm rows, and by _guard_algos on direct engine use."""
+        if self._algos_seen:
+            return
+        self._algos_seen = True
+        if self._use_pallas:
+            self._use_pallas = False
+            # rebuild the cached jitted steps on the XLA twin; jit is
+            # lazy, so the one-time cost is the recompile at next launch
+            self._step = sharded_slab_step(
+                self.mesh, ways=self.ways, use_pallas=False
+            )
+            self._after_steps.clear()
+            self._compact_steps.clear()
+
+    def _guard_algos(self, packed: np.ndarray) -> None:
+        """Per-launch check for direct engine callers (the backend has
+        already run its own before dispatching): any VALID lane (hits > 0
+        — padding/garbage lanes never count) carrying a non-fixed
+        algorithm id flips the guard before a step function is chosen."""
+        if self._algos_seen:
+            return
+        valid = packed[ROW_HITS] > 0
+        if valid.any() and int(
+            packed[ROW_DIVIDER][valid].max()
+        ) >= (1 << ALGO_SHIFT):
+            self.note_algos_seen()
+
     def step_packed(self, packed: np.ndarray) -> np.ndarray:
         """One mesh-wide launch. packed: uint32[7, b] -> uint32[8, b] results
         in arrival order (no permutation row: unsorted on device pre-psum)."""
+        self._guard_algos(packed)
         packed_dev = jax.device_put(packed, self._batch_sharding)
         with self._state_lock:
             self._state, out, health = self._step(self._state, packed_dev)
@@ -364,6 +410,7 @@ class ShardedSlabEngine:
         """Production readback path: stateful update only, one saturated
         post-increment counter row back (caller guarantees cap > limit+hits;
         see ops/slab.py compact modes)."""
+        self._guard_algos(packed)
         step = self._after_steps.get(cap)
         if step is None:
             step = sharded_slab_step_after(
@@ -395,6 +442,7 @@ class ShardedSlabEngine:
         min_bucket floors the power-of-two bucket ladder: callers that know
         the shapes they will see (the bench pins one bucket across a block
         stream) can force a single compile instead of one per ladder rung."""
+        self._guard_algos(packed)
         n_dev = int(self.mesh.devices.size)
         b = packed.shape[1]
         hits = packed[ROW_HITS]
@@ -498,6 +546,13 @@ class ShardedSlabEngine:
                 f"snapshot shards assemble to {full.shape}, slab is "
                 f"({self.n_slots_global}, {ROW_WIDTH})"
             )
+        if not self._algos_seen and int(
+            full[:, COL_DIVIDER].max(initial=0)
+        ) >= (1 << ALGO_SHIFT):
+            # restored rows carry non-fixed algorithms: the table is no
+            # longer pallas-safe even before the first such launch (the
+            # same rule the single-device import applies)
+            self.note_algos_seen()
         with self._state_lock:
             self._state = jax.device_put(full, self._state_sharding)
 

@@ -64,9 +64,10 @@ COL_FP_LO, COL_FP_HI, COL_COUNT, COL_WINDOW, COL_EXPIRE, COL_DIVIDER = range(6)
 # it always did — the zero-drop round-trip guarantee.
 ALGO_SHIFT = 28
 ALGO_DIV_MASK = (1 << ALGO_SHIFT) - 1
+ALGO_SLIDING_WINDOW = 1
 ALGO_NAMES = {
     0: "fixed_window",
-    1: "sliding_window",
+    ALGO_SLIDING_WINDOW: "sliding_window",
     2: "gcra",
     3: "concurrency",
 }
@@ -387,7 +388,13 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
         every algorithm: GCRA rows store window = tat_sec - divider, which
         makes "window ended" mean "TAT drained"; concurrency rows store
         window = last touch with divider = idle TTL, which makes it mean
-        "idle past the leak TTL". Pre-algorithm rows carry zero algorithm
+        "idle past the leak TTL". SLIDING rows get one extra window of
+        grace (window + 2*divider <= now): a row whose window just ended
+        still carries the count the NEXT window's interpolation reads —
+        that is why the slab stamps sliding rows with a 2-window
+        expire_at (ops/slab.py expire_store) — so dropping it at one
+        window would silently disable the 2x boundary-burst protection
+        across a warm restart. Pre-algorithm rows carry zero algorithm
         bits, so their reconcile is bit-identical to before (zero drops on
         a v2 round-trip);
       * live rows inside a still-open window keep their counts: these are
@@ -406,11 +413,16 @@ def reconcile_rows(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
     occupied = table.any(axis=1)
     expire_at = table[:, COL_EXPIRE].astype(np.int64)
     window = table[:, COL_WINDOW].astype(np.int64)
+    algo = (table[:, COL_DIVIDER] >> np.uint32(ALGO_SHIFT)) & np.uint32(7)
     divider = (table[:, COL_DIVIDER] & np.uint32(ALGO_DIV_MASK)).astype(
         np.int64
     )
     live = occupied & (expire_at > now)
-    window_ended = live & (divider > 0) & (window + divider <= now)
+    # sliding rows stay useful one window past their own end (see the
+    # grace rationale in the docstring); every other algorithm ends at
+    # window + divider
+    span = np.where(algo == ALGO_SLIDING_WINDOW, divider * 2, divider)
+    window_ended = live & (divider > 0) & (window + span <= now)
     keep = live & ~window_ended
     table[~keep] = 0
     return table, {

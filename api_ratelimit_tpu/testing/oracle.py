@@ -94,11 +94,20 @@ class SetSlabOracle:
                 and int(r[COL_FP_HI]) == fp_hi
             ):
                 return base + w, True, EVICT_NONE
-            rdiv = int(r[COL_DIVIDER]) & ALGO_DIV_MASK  # strip the algo id
+            raw_div = int(r[COL_DIVIDER])
+            rdiv = raw_div & ALGO_DIV_MASK  # strip the algo id
+            # sliding rows stay tier-LIVE one window past their own end:
+            # the stored count feeds the next window's interpolation (the
+            # kernel's 2-window expire_at) — mirrors _scan_ways exactly
+            span = (
+                rdiv * 2
+                if ((raw_div >> ALGO_SHIFT) & 7) == ALGO_SLIDING_WINDOW
+                else rdiv
+            )
             ended = (
                 live
                 and rdiv > 0
-                and int(r[COL_WINDOW]) + rdiv <= now
+                and int(r[COL_WINDOW]) + span <= now
             )
             tier = (1 if ended else 2) if live else 0
             rot = (w - pref) & (self.ways - 1)
@@ -113,10 +122,16 @@ class SetSlabOracle:
         victim = self.table[base + best_w]
         v_exp = int(victim[COL_EXPIRE])
         if v_exp > now:
-            v_div = int(victim[COL_DIVIDER]) & ALGO_DIV_MASK
+            v_raw = int(victim[COL_DIVIDER])
+            v_div = v_raw & ALGO_DIV_MASK
+            v_span = (
+                v_div * 2
+                if ((v_raw >> ALGO_SHIFT) & 7) == ALGO_SLIDING_WINDOW
+                else v_div
+            )
             ended = (
                 v_div > 0
-                and int(victim[COL_WINDOW]) + v_div <= now
+                and int(victim[COL_WINDOW]) + v_span <= now
             )
             cls = EVICT_WINDOW if ended else EVICT_LIVE
         else:

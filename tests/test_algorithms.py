@@ -354,6 +354,53 @@ class TestAlgorithmsThroughService:
         svc.release(req(("conns", "")))
         assert svc.should_rate_limit(req(("conns", "")))[0] == Code.OK
 
+    def test_gcra_skips_over_limit_local_cache(self):
+        ts = FakeTimeSource(1_000_000)
+        store = Store(TestSink())
+        scope = store.scope("ratelimit")
+        cache = make_cache(ts, local_cache_size=1 << 16, stats_scope=scope)
+        runtime = FakeRuntime({"config.algo": ALGO_YAML})
+        svc = RateLimitService(
+            runtime=runtime,
+            cache=cache,
+            stats_scope=scope.scope("service"),
+            time_source=ts,
+        )
+        for _ in range(4):
+            assert svc.should_rate_limit(req(("bucket", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("bucket", "")))[0] == Code.OVER_LIMIT
+        # the TAT drains continuously: one emission interval (T = 15s)
+        # later — still inside the SAME minute window — the bucket
+        # re-admits. A window-stamped cached denial would keep denying
+        # until the window boundary.
+        ts.advance(15)
+        assert svc.should_rate_limit(req(("bucket", "")))[0] == Code.OK
+
+    def test_sliding_skips_over_limit_local_cache(self):
+        ts = FakeTimeSource(999_960 + 50)  # late in window [999960, 1000020)
+        store = Store(TestSink())
+        scope = store.scope("ratelimit")
+        cache = make_cache(ts, local_cache_size=1 << 16, stats_scope=scope)
+        runtime = FakeRuntime({"config.algo": ALGO_YAML})
+        svc = RateLimitService(
+            runtime=runtime,
+            cache=cache,
+            stats_scope=scope.scope("service"),
+            time_source=ts,
+        )
+        for _ in range(6):  # fill the sliding limit (6/min)
+            assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OVER_LIMIT
+        # early in the NEXT window the carried position still denies...
+        ts.now = 1_000_020 + 15
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OK
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OVER_LIMIT
+        # ...but the interpolated carry DECAYS mid-window: admits resume
+        # inside the same window the denial above would have been cache-
+        # stamped with — so a cached entry would wrongly deny until :00
+        ts.now = 1_000_020 + 55
+        assert svc.should_rate_limit(req(("slide", "")))[0] == Code.OK
+
     def test_algo_stats_and_journey_tag(self):
         from api_ratelimit_tpu.tracing import journeys
 
@@ -528,6 +575,27 @@ class TestSnapshotRoundTrip:
         assert stats["restored"] == 2
         assert stats["dropped_window"] == 1
         assert rec[0].any() and rec[2].any() and not rec[1].any()
+
+    def test_sliding_rows_keep_one_window_of_grace(self):
+        """A sliding row whose window just ended still carries the count
+        the NEXT window's interpolation reads (the kernel's 2-window
+        expire_at) — restore must keep it for one extra window or a warm
+        restart silently drops the 2x boundary-burst protection."""
+        from api_ratelimit_tpu.persist.snapshot import reconcile_rows
+
+        now = 1_000_000
+        table = np.zeros((8, 8), dtype=np.uint32)
+        # sliding, window ended ONE window ago: kept (grace window)
+        table[0] = (1, 2, 6, now - 70, now + 50, 60 | (1 << 28), 3, 0)
+        # sliding, window ended TWO windows ago: nothing left to read
+        table[1] = (3, 4, 6, now - 130, now + 50, 60 | (1 << 28), 3, 0)
+        # fixed_window one window stale: still dropped at ONE window —
+        # the grace applies to sliding rows only
+        table[2] = (5, 6, 6, now - 70, now + 50, 60, 0, 0)
+        rec, stats = reconcile_rows(table, now)
+        assert stats["restored"] == 1
+        assert stats["dropped_window"] == 2
+        assert rec[0].any() and not rec[1].any() and not rec[2].any()
 
     def test_snapshot_inspect_renders_algorithms(self, tmp_path):
         import tools.snapshot_inspect as si

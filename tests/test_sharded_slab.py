@@ -87,6 +87,70 @@ class TestShardedEngine:
         with pytest.raises(ValueError):
             ShardedSlabEngine(mesh=mesh, n_slots_global=8 * 300)
 
+    def test_non_fixed_launch_flips_pallas_guard(self, mesh):
+        """The sticky algorithms guard, mesh edition: a use_pallas engine
+        whose launch carries a non-fixed algorithm id must rebuild its
+        step functions on the XLA twin BEFORE dispatch — the Mosaic body
+        is fixed_window-only, so without the flip sliding/GCRA/release
+        rows would run fixed-window math on multi-chip deployments. (On
+        this CPU mesh a pallas compile would fail outright, so the
+        correct counters below also prove no pallas program ever built.)"""
+        from api_ratelimit_tpu.ops.slab import (
+            ALGO_CONC_RELEASE,
+            ALGO_CONCURRENCY,
+            ALGO_SHIFT,
+            ALGO_SLIDING_WINDOW,
+        )
+
+        eng = ShardedSlabEngine(
+            mesh=mesh, n_slots_global=8 * 256, use_pallas=True
+        )
+        assert eng._use_pallas is True and eng.algos_seen is False
+
+        def packed_one(algo, hits=1, limit=10, now=1_000_000):
+            p = np.zeros((7, 128), dtype=np.uint32)
+            p[0, 0], p[1, 0] = 1234, 0xABCD0001
+            p[2, 0] = hits
+            p[3, 0] = limit
+            p[4, 0] = 60 | (algo << ALGO_SHIFT)
+            p[6, 0] = now
+            p[6, 1] = np.float32(0.8).view(np.uint32)
+            p[6, 2] = np.float32(1.0).view(np.uint32)
+            return p
+
+        # sliding key: two launches in one window must accumulate 1 -> 2
+        # (the fixed-window Mosaic body misreading the divider word would
+        # never see the same window twice for a ~2^28-second "window")
+        after = eng.step_after_compact(packed_one(ALGO_SLIDING_WINDOW), 0xFFFF)
+        assert eng.algos_seen is True and eng._use_pallas is False
+        assert int(after[0]) == 1
+        after = eng.step_after_compact(packed_one(ALGO_SLIDING_WINDOW), 0xFFFF)
+        assert int(after[0]) == 2
+
+        # concurrency on a second key: acquire, release (wire id 4 must
+        # DECREMENT, not increment), acquire again lands back at 1 + 1
+        def conc(algo):
+            p = packed_one(algo, limit=3)
+            p[0, 0], p[1, 0] = 5678, 0xBEEF0001
+            return p
+
+        assert int(eng.step_after_compact(conc(ALGO_CONCURRENCY), 0xFFFF)[0]) == 1
+        eng.step_after_compact(conc(ALGO_CONC_RELEASE), 0xFFFF)
+        assert int(eng.step_after_compact(conc(ALGO_CONCURRENCY), 0xFFFF)[0]) == 1
+
+    def test_restored_algorithm_rows_flip_pallas_guard(self, mesh):
+        eng = ShardedSlabEngine(
+            mesh=mesh, n_slots_global=8 * 256, use_pallas=True
+        )
+        tables = [np.zeros((256, 8), dtype=np.uint32) for _ in range(8)]
+        # one restored GCRA row: the table is no longer pallas-safe even
+        # before the first non-fixed launch
+        tables[3][0] = (
+            1, 2, 3, 999_970, 1_000_050, 60 | (2 << 28), 1_000_030, 0,
+        )
+        eng.import_tables(tables)
+        assert eng.algos_seen is True and eng._use_pallas is False
+
     def test_over_limit_sequence(self, mesh):
         ts = FakeTimeSource(1_000_000)
         store = Store(TestSink())
