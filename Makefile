@@ -8,7 +8,8 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_artifact \
-        tests_chaos tests_integration tests_mp tests_with_redis tests_tpu \
+        tests_chaos tests_cluster tests_integration tests_mp \
+        tests_with_redis tests_tpu \
         bench profile serve check_config clean docker_image docker_tests
 
 all: compile
@@ -54,13 +55,21 @@ tests_mp: native
 	$(PY) -m pytest tests/ -v -m mp
 
 # Failure-injection + failover chaos tier: the degradation ladder, the
-# warm-standby replication suite, and the SIGKILL-the-primary acceptance
-# scenario (zero failed requests, bounded overshoot, split-brain fence)
+# warm-standby replication suite, the SIGKILL-the-primary acceptance
+# scenario (zero failed requests, bounded overshoot, split-brain fence),
+# and the partitioned-cluster suite (kill-one-partition, live reshard)
 # get their own CI entry point so the failover story can gate a release
 # independently of the full unit tier.
 tests_chaos:
 	$(PY) -m pytest tests/test_chaos.py tests/test_replication.py \
-	  tests/test_warm_restart.py -v -m "not slow"
+	  tests/test_warm_restart.py tests/test_cluster.py -v -m "not slow"
+
+# Partitioned device-owner cluster tier (cluster/; `cluster` marker):
+# K-partition routing parity, the STATUS_STALE_MAP wire fence, live
+# resharding K=2->4 under closed-loop load, per-partition standby
+# promotion, and the PARTITIONS=1 byte-identical rollback arm.
+tests_cluster:
+	$(PY) -m pytest tests/test_cluster.py -v -m cluster
 
 # Full suite; the in-process fake Redis/Memcache servers play the role the
 # reference's local redis fleet plays (Makefile:91-125).

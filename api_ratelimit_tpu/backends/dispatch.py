@@ -250,6 +250,13 @@ class DispatchStats:
         <scope>.ring.arena_hwm  high-water mark of arena rows in use
                                 across every ring (how close the arenas
                                 run to the overflow regime)
+
+    Partitioned owners (cluster/; DispatchLoop(partition=k)) additionally
+    export the arena pair under a partition-labeled name —
+    <scope>.partition_<k>.arena_overflow and
+    <scope>.ring.partition_<k>.arena_hwm — so ring pressure is
+    attributable to the partition whose keyspace is generating it (the
+    flat names keep aggregating for unpartitioned dashboards).
     """
 
     def __init__(self, loop: "DispatchLoop", scope):
@@ -259,15 +266,24 @@ class DispatchStats:
         self._arena_overflow = scope.counter("arena_overflow")
         self._arena_hwm = scope.gauge("ring.arena_hwm")
         self._overflow_seen = 0
+        self._p_overflow = self._p_hwm = None
+        part = getattr(loop, "partition", -1)
+        if part >= 0:
+            self._p_overflow = scope.counter(f"partition_{part}.arena_overflow")
+            self._p_hwm = scope.gauge(f"ring.partition_{part}.arena_hwm")
 
     def generate_stats(self) -> None:
         self._queue_depth.set(self._loop.queue_depth)
         self._inflight.set(self._loop.inflight)
         overflow, hwm = self._loop.arena_pressure()
         if overflow > self._overflow_seen:
+            if self._p_overflow is not None:
+                self._p_overflow.add(overflow - self._overflow_seen)
             self._arena_overflow.add(overflow - self._overflow_seen)
             self._overflow_seen = overflow
         self._arena_hwm.set(hwm)
+        if self._p_hwm is not None:
+            self._p_hwm.set(hwm)
 
 
 class DispatchLoop:
@@ -292,7 +308,14 @@ class DispatchLoop:
         max_inflight: int = 2,
         ring_slots: int = 128,
         ring_rows: int = 4096,
+        partition: int = -1,
     ):
+        # which cluster partition this owner serves (cluster/; -1 =
+        # unpartitioned). Pure labeling: DispatchStats exports the
+        # arena-pressure pair under a partition_<k> name next to the
+        # flat one, so ring pressure is attributable to a partition in
+        # /metrics and debug_snapshot.
+        self.partition = int(partition)
         self._launch = launch
         self._collect = collect
         # ready(token) -> bool: non-blocking "has this launch's readback

@@ -139,27 +139,62 @@ OP_PING = 2
 # STREAMS replication frames on this connection until it dies — the one
 # op that breaks the request/response rhythm, by design
 OP_REPL_SUBSCRIBE = 3
+# --- partitioned-cluster admin ops (cluster/) --------------------------
+# small request/response RPCs used by the router and the reshard
+# coordinator; every one replies u8 status | u32 len | blob (ok) or the
+# standard error frame. Owners without a ClusterNode answer errors.
+OP_MAP_GET = 4  # empty -> the owner's current PartitionMap JSON
+OP_MAP_SET = 5  # u32 len | map JSON -> adopt iff newer epoch
+OP_RESHARD_PULL = 6  # u32 lo | u32 hi | u32 route_sets -> rows section
+OP_RESHARD_PUSH = 7  # u32 len | pack_table_bytes section -> merge stats
 # header flags (the u16 after op): bit 0 = B3 trace trailer appended,
 # bit 1 = lease-ops trailer appended (before the trace trailer),
 # bit 2 = u32 epoch trailer appended (after the lease trailer, before the
 #         trace trailer) — the split-brain fence: set only by multi-address
 #         clients (SIDECAR_ADDRS), so single-address deployments ship
 #         byte-identical frames to the pre-replication protocol
+# bit 3 = u32 partition-map epoch trailer appended (after the epoch
+#         trailer, before the trace trailer) — the cluster routing fence:
+#         set only by the partition router (cluster/router.py), so
+#         PARTITIONS=1 deployments ship byte-identical legacy frames
 FLAG_TRACE = 1
 FLAG_LEASE = 2
 FLAG_EPOCH = 4
+FLAG_MAP = 8
 
 # response status bytes. 0/1 are the original protocol; 2/3 only ever
-# answer FLAG_EPOCH frames, so legacy clients never see them.
+# answer FLAG_EPOCH frames, and 4 only ever answers FLAG_MAP frames, so
+# legacy clients never see them.
 STATUS_OK = 0
 STATUS_ERROR = 1
 STATUS_OK_EPOCH = 2  # u32 epoch | u32 n | counters
 STATUS_STALE_EPOCH = 3  # u32 server_epoch — the write was NOT applied
+# the frame was routed with a stale/mismatched PartitionMap: the write
+# was NOT applied; the body is u32 len | the owner's current map JSON so
+# the client re-buckets against it (the Redis Cluster MOVED analog)
+STATUS_STALE_MAP = 4
 # sanity cap on the trace trailer — B3 TextMap is ~90 bytes
 MAX_TRACE_TRAILER = 1024
 # sanity cap on the lease trailer (a request carries a handful of grant/
 # settle records; 64 KiB is ~4k records)
 MAX_LEASE_TRAILER = 1 << 16
+# sanity cap on cluster admin bodies (a PartitionMap JSON is ~100 bytes
+# per partition; a reshard section is a route range's live rows)
+MAX_MAP_BYTES = 1 << 20
+MAX_RESHARD_BYTES = 1 << 28
+
+
+class StaleMapError(CacheError):
+    """A SUBMIT was refused with STATUS_STALE_MAP: the owner holds a
+    newer (or conflicting) PartitionMap than the one this frame was
+    routed with, and the write was NOT applied. Carries the owner's map
+    JSON so the router (cluster/router.py) adopts it, re-buckets, and
+    resubmits — callers without a router see an ordinary CacheError and
+    degrade through the FAILURE_MODE_DENY ladder."""
+
+    def __init__(self, message: str, map_json: bytes):
+        super().__init__(message)
+        self.map_json = map_json
 
 _HDR = struct.Struct("<IBBH")  # magic, version, op, reserved
 _U32 = struct.Struct("<I")
@@ -261,8 +296,17 @@ class SlabSidecarServer:
         fault_injector=None,
         repl=None,
         shm_control_path: str = "",
+        cluster=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
+
+        cluster: optional cluster.node.ClusterNode — this owner's
+        partition membership. When set, map-stamped SUBMIT frames
+        (FLAG_MAP) are fenced against the node's PartitionMap (a stale
+        or misrouted frame gets STATUS_STALE_MAP + the current map, the
+        write never applied) and the cluster admin ops (OP_MAP_GET/SET,
+        OP_RESHARD_PULL/PUSH) are served. None keeps the exact
+        pre-cluster behavior — the PARTITIONS=1 rollback arm.
 
         repl: optional persist.replication.ReplicationCoordinator. When
         set, OP_REPL_SUBSCRIBE connections become its ship loops, a
@@ -293,6 +337,7 @@ class SlabSidecarServer:
         self._engine = engine
         self._faults = fault_injector
         self._repl = repl
+        self._cluster = cluster
         # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
         # frontend PROCESSES publish row blocks straight into this
         # engine's dispatch loop through shared-memory rings registered
@@ -416,6 +461,15 @@ class SlabSidecarServer:
                         # loop; it never returns to request/response
                         self._repl.serve_subscriber(conn)
                         return
+                    if op in (
+                        OP_MAP_GET,
+                        OP_MAP_SET,
+                        OP_RESHARD_PULL,
+                        OP_RESHARD_PUSH,
+                    ):
+                        if not self._serve_cluster_op(conn, op):
+                            return
+                        continue
                     if op != OP_SUBMIT:
                         conn.sendall(self._error(f"bad op {op}"))
                         return
@@ -454,6 +508,13 @@ class SlabSidecarServer:
                         (frame_epoch,) = _U32.unpack(
                             _recv_exact(conn, _U32.size)
                         )
+                    frame_map_epoch = None
+                    if hdr_flags & FLAG_MAP:
+                        # partition-map fence trailer (fixed u32): same
+                        # wire-coherence rule as the epoch trailer
+                        (frame_map_epoch,) = _U32.unpack(
+                            _recv_exact(conn, _U32.size)
+                        )
                     wire_ctx = None
                     if hdr_flags & FLAG_TRACE:
                         # B3 trace trailer: read it BEFORE any fault
@@ -489,6 +550,23 @@ class SlabSidecarServer:
                             # the client sees a mid-frame connection loss
                             conn.sendall(b"\x00")
                             return
+                    if self._cluster is not None:
+                        # the cluster routing fence: a frame routed with
+                        # a stale map, or carrying rows this partition
+                        # does not own, is answered with the CURRENT map
+                        # and never applied — checked BEFORE the repl
+                        # promote-on-write so a misrouted frame cannot
+                        # promote a standby it was never meant for
+                        stale_map = self._cluster.check_block(
+                            frame_map_epoch, decode_block(payload)
+                        )
+                        if stale_map is not None:
+                            conn.sendall(
+                                bytes([STATUS_STALE_MAP])
+                                + _U32.pack(len(stale_map))
+                                + stale_map
+                            )
+                            continue
                     if self._repl is not None:
                         # a write reaching a standby IS the failover
                         # signal: promote (epoch bump + reconcile +
@@ -635,6 +713,55 @@ class SlabSidecarServer:
 
         apply_ops(decode_block(payload), out, decode_lease_ops(lease_blob))
 
+    def _serve_cluster_op(self, conn: socket.socket, op: int) -> bool:
+        """One cluster admin RPC (OP_MAP_GET/SET, OP_RESHARD_PULL/PUSH).
+        Every op replies u8 status | u32 len | blob; returns False when
+        the connection should close (protocol violation)."""
+        import json as _json
+
+        if op == OP_RESHARD_PULL:
+            lo, hi, route_sets = struct.unpack("<III", _recv_exact(conn, 12))
+        elif op in (OP_MAP_SET, OP_RESHARD_PUSH):
+            (blob_len,) = _U32.unpack(_recv_exact(conn, _U32.size))
+            cap = MAX_MAP_BYTES if op == OP_MAP_SET else MAX_RESHARD_BYTES
+            if blob_len > cap:
+                conn.sendall(
+                    self._error(f"cluster op body {blob_len} exceeds cap {cap}")
+                )
+                return False
+            body = _recv_exact(conn, blob_len)
+        if self._cluster is None and op in (OP_MAP_GET, OP_MAP_SET):
+            conn.sendall(self._error("cluster not configured"))
+            return True
+        try:
+            if op == OP_MAP_GET:
+                out = self._cluster.pmap.to_json_bytes()
+            elif op == OP_MAP_SET:
+                adopted = self._cluster.adopt_json(body)
+                out = _json.dumps(
+                    {"adopted": adopted, "epoch": self._cluster.epoch}
+                ).encode()
+            elif op == OP_RESHARD_PULL:
+                from ..persist.snapshot import pack_table_bytes
+
+                rows = self._engine.export_route_range(lo, hi, route_sets)
+                out = pack_table_bytes(
+                    rows, int(time.time()), ways=getattr(self._engine, "ways", 0)
+                )
+            else:  # OP_RESHARD_PUSH
+                from ..persist.snapshot import unpack_table_bytes
+
+                _hdr, rows, _off = unpack_table_bytes(
+                    body, what="<reshard push>"
+                )
+                out = _json.dumps(self._engine.merge_rows(rows)).encode()
+        except Exception as e:  # noqa: BLE001 - surface to the coordinator
+            logger.exception("cluster op %d failed", op)
+            conn.sendall(self._error(str(e)))
+            return True
+        conn.sendall(b"\x00" + _U32.pack(len(out)) + out)
+        return True
+
     @staticmethod
     def _error(message: str) -> bytes:
         raw = message.encode()
@@ -691,6 +818,7 @@ class SidecarEngineClient:
         sleep=time.sleep,
         shm_control_path: str = "",
         shm_ring_rows: int = 4096,
+        map_epoch_fn=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port — or a
         LIST of them (equivalently one comma-separated string: the
@@ -750,7 +878,17 @@ class SidecarEngineClient:
         path, and any shm TRANSPORT failure falls back to the socket RPC
         per call (counted in <scope>.sidecar.shm_fallback) so a dying
         owner degrades through the existing retry/breaker/failover
-        ladder, never a new one."""
+        ladder, never a new one.
+
+        map_epoch_fn: optional zero-arg callable returning the epoch of
+        the PartitionMap this client's frames were routed with
+        (cluster/router.py sets it on each per-partition client). When
+        set, every SUBMIT carries a FLAG_MAP trailer and a
+        STATUS_STALE_MAP reply raises StaleMapError (carrying the
+        owner's current map) instead of retrying — re-bucketing is the
+        router's job, not the transport's. None (the default) ships
+        byte-identical pre-cluster frames."""
+        self._map_epoch_fn = map_epoch_fn
         self._h_rpc = None
         self._h_shm = None
         self._c_retry = self._c_redial = self._c_breaker_open = None
@@ -1125,6 +1263,13 @@ class SidecarEngineClient:
             # clients never set this bit — byte-identical legacy frames.
             hdr_flags |= FLAG_EPOCH
             epoch_trailer = _U32.pack(self._epoch_known)
+        map_trailer = b""
+        if self._map_epoch_fn is not None:
+            # the cluster routing fence: which map these rows were
+            # bucketed with — a stale one gets the new map back, never a
+            # silently misrouted write
+            hdr_flags |= FLAG_MAP
+            map_trailer = _U32.pack(int(self._map_epoch_fn()))
         trailer = b""
         if parent is not None and parent.tracer is not None:
             rpc_span = parent.tracer.start_span(
@@ -1139,6 +1284,7 @@ class SidecarEngineClient:
             _HDR.pack(MAGIC, VERSION, OP_SUBMIT, hdr_flags)
             + payload
             + epoch_trailer
+            + map_trailer
             + trailer
         )
         try:
@@ -1217,6 +1363,23 @@ class SidecarEngineClient:
                     # applied), resets the breaker's failure streak
                     self._breaker.record_success()
                     raise CacheError(f"sidecar error: {message}")
+                if status == bytes([STATUS_STALE_MAP]):
+                    # the owner refused the ROUTING, not the transport:
+                    # the reply carries its current map; re-bucketing is
+                    # the router's job, so surface immediately (no retry,
+                    # no failover — every address of this partition
+                    # serves the same map or newer)
+                    (ln,) = _U32.unpack(_recv_exact(conn, _U32.size))
+                    map_json = _recv_exact(conn, ln)
+                    self._release(conn)
+                    self._breaker.record_success()
+                    if rpc_span is not None:
+                        rpc_span.log_kv(event="sidecar.stale_map")
+                    raise StaleMapError(
+                        f"sidecar at {self._path} rejected the frame's "
+                        f"partition-map routing",
+                        map_json,
+                    )
                 if status == bytes([STATUS_STALE_EPOCH]):
                     # the owner refused the write: it serves an OLDER
                     # epoch than this client has seen — a resurrected
@@ -1307,6 +1470,48 @@ class SidecarEngineClient:
             for conn in self._pool:
                 conn.close()
             self._pool.clear()
+
+
+def cluster_rpc(
+    address: str, op: int, payload: bytes = b"", timeout: float = 30.0
+) -> bytes:
+    """One cluster admin RPC (OP_MAP_GET/SET, OP_RESHARD_PULL/PUSH)
+    against a device owner: dial, send, read u8 status | u32 len | blob,
+    return the blob. Deliberately pool-less and retry-less — the reshard
+    coordinator and admin tools run off the hot path and want failures
+    loud, not absorbed. unix and tcp:// addresses only (admin ops ride
+    the same trust boundary as the socket itself)."""
+    scheme, target = parse_sidecar_address(address)
+    if scheme == "tls":
+        raise CacheError("cluster admin RPCs do not ride tls:// addresses")
+    if scheme == "unix":
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(timeout)
+        try:
+            conn.connect(target)
+        except OSError as e:
+            conn.close()
+            raise CacheError(f"cannot reach owner at {address}: {e}") from e
+    else:
+        try:
+            conn = socket.create_connection(target, timeout=timeout)
+        except OSError as e:
+            raise CacheError(f"cannot reach owner at {address}: {e}") from e
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        conn.sendall(_HDR.pack(MAGIC, VERSION, op, 0) + payload)
+        status = _recv_exact(conn, 1)
+        (ln,) = _U32.unpack(_recv_exact(conn, _U32.size))
+        body = _recv_exact(conn, ln)
+        if status != b"\x00":
+            raise CacheError(
+                f"cluster op {op} failed on {address}: {body.decode(errors='replace')}"
+            )
+        return body
+    except (OSError, ConnectionError) as e:
+        raise CacheError(f"cluster op {op} transport failure on {address}: {e}") from e
+    finally:
+        conn.close()
 
 
 def new_sidecar_cache_from_settings(

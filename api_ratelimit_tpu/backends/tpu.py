@@ -144,8 +144,15 @@ class SlabDeviceEngine:
         precompile: bool = False,
         dispatch_loop: bool = True,
         gcra_burst_ratio: float = 1.0,
+        partition: int = -1,
     ):
-        """scope: optional stats Scope rooted at the service prefix (e.g.
+        """partition: which cluster partition this owner serves
+        (cluster/; -1 = unpartitioned). Labeling only: the dispatch
+        loop's arena-pressure telemetry exports partition-attributable
+        names (backends/dispatch.py DispatchStats) so ring pressure on a
+        K-partition host traces to the keyspace slice generating it.
+
+        scope: optional stats Scope rooted at the service prefix (e.g.
         the runner's `ratelimit` scope). When set, the engine records the
         per-stage device histograms — <scope>.device.{pack_ms,launch_ms,
         readback_ms} — and hands <scope>.batcher to the micro-batcher for
@@ -344,6 +351,7 @@ class SlabDeviceEngine:
                 overload=overload,
                 fault_injector=fault_injector,
                 max_queue=max_queue,
+                partition=partition,
             )
         # Device-owner lease liability registry (backends/lease.py): who
         # holds how much un-settled leased budget, and the counter
@@ -693,6 +701,71 @@ class SlabDeviceEngine:
             self._state = jax.device_put(
                 slab_import_rows(rows), self._device
             )
+
+    # -- partitioned cluster (cluster/): reshard streaming --
+
+    def export_route_range(
+        self, lo: int, hi: int, route_sets: int
+    ) -> np.ndarray:
+        """Occupied rows whose ROUTE INDEX — set_index(fp_lo, route_sets)
+        at the cluster map's resolution (ops/hashing.py, the same split
+        the router buckets by) — falls in [lo, hi): the reshard PULL.
+        Rides the same quiesce-and-copy export the snapshotter and the
+        replication ship loop use, so the launch pipeline never blocks.
+        Returns a flat (n, ROW_WIDTH) row array (placement-free — the
+        receiving owner re-places by its own geometry)."""
+        from ..ops.hashing import set_index
+
+        if route_sets <= 0 or route_sets & (route_sets - 1):
+            raise ValueError(
+                f"route_sets must be a power of two, got {route_sets}"
+            )
+        if not 0 <= lo < hi <= route_sets:
+            raise ValueError(
+                f"route range [{lo}, {hi}) outside [0, {route_sets})"
+            )
+        tables = [np.asarray(t) for t in self.export_tables()]
+        flat = tables[0] if len(tables) == 1 else np.concatenate(tables)
+        route = np.asarray(set_index(flat[:, 0], route_sets))
+        mask = flat.any(axis=1) & (route >= lo) & (route < hi)
+        return np.ascontiguousarray(flat[mask])
+
+    def merge_rows(self, rows: np.ndarray) -> dict:
+        """The reshard PUSH: merge streamed rows into the live slab by
+        fingerprint, keep-the-newest (persist/snapshot.py
+        merge_rows_into_table — greater window wins, equal windows keep
+        the greater count), so a stage-then-drain double delivery
+        converges upward toward the true counter instead of rolling an
+        admission back. The whole export → host merge → upload runs
+        UNDER the state lock: launches queue behind it for the few ms a
+        reshard section takes, and in exchange no concurrent increment
+        can fall between the copy and the upload — the merge is atomic
+        against the launch path. Returns the merge stats dict."""
+        from ..persist.snapshot import merge_rows_into_table
+
+        rows = np.asarray(rows, dtype=np.uint32)
+        if rows.size and rows.shape[1] != ROW_WIDTH:
+            raise ValueError(
+                f"merge rows must be (n, {ROW_WIDTH}), got {rows.shape}"
+            )
+        if self._engine is not None:
+            raise CacheError(
+                "mesh-sharded owners do not support in-place reshard "
+                "merge; reshard a mesh partition via snapshot/restore"
+            )
+        with self._state_lock:
+            table = np.asarray(slab_export_copy(self._state))
+            merged, stats = merge_rows_into_table(table, rows, self._ways)
+            if not self._algos_seen and int(
+                merged[:, 5].max(initial=0)
+            ) >= (1 << ALGO_SHIFT):
+                # streamed rows may carry non-fixed algorithms: flip the
+                # sticky guard before they can reach the Mosaic body
+                self._algos_seen = True
+            self._state = jax.device_put(
+                slab_import_rows(merged), self._device
+            )
+        return stats
 
     # -- warm-standby replication (persist/replication.py) --
 

@@ -1,0 +1,31 @@
+"""Partitioned device-owner cluster (PR 13; ROADMAP item 1).
+
+The reference scales exactly one way — Redis Cluster: a key lives on one
+node — and this package is that architecture mapped onto the slab: the
+keyspace splits into K *partitions*, each an independent device-owner
+pair (its own slab, dispatch loop, snapshotter, and warm standby), and
+frontends bucket their row blocks per partition before submit.
+
+    partition_map.py  PartitionMap — the epoch-versioned assignment of
+                      route-set ranges to owner address pairs (the Redis
+                      Cluster slot table analog), plus THE routing rule:
+                      partition = owner of set_index(fp_lo, route_sets)
+    node.py           ClusterNode — owner-side membership: every epoch-
+                      stamped SUBMIT is fenced against the node's map so
+                      a stale client map gets STATUS_STALE_MAP + the new
+                      map, never a silently misrouted write
+    router.py         PartitionedEngineClient — frontend-side router:
+                      one SidecarEngineClient per partition (each with
+                      its own failover pair), blocks split by route index
+                      and verdicts scattered back in submit order
+    reshard.py        ReshardCoordinator — live resharding: streams the
+                      moved route-set ranges owner-to-owner as
+                      pack_table_bytes sections, flips the map with an
+                      epoch bump, then drains the frozen source ranges
+
+PARTITIONS=1 (the default) builds none of this: the frontend keeps the
+exact pre-cluster SidecarEngineClient and wire frames — the byte-identical
+rollback arm, pinned by test.
+"""
+
+from .partition_map import Partition, PartitionMap  # noqa: F401

@@ -44,6 +44,17 @@ logger = logging.getLogger("ratelimit.service_cmd")
 def main() -> None:
     settings = new_settings()
     n = settings.frontend_procs_count()
+    k, _groups, _route_sets, _rate = settings.cluster_config()
+    if k > 1 and settings.backend_type == "tpu":
+        # the fleet master spawns exactly ONE in-house device owner; a
+        # K-partition cluster runs its owner pairs as separately managed
+        # sidecar_cmd processes (cluster/ docstring) — frontends join it
+        # via BACKEND_TYPE=tpu-sidecar + PARTITION_ADDRS
+        raise SystemExit(
+            f"PARTITIONS={k} requires BACKEND_TYPE=tpu-sidecar (run one "
+            f"sidecar_cmd per PARTITION_ADDRS entry); BACKEND_TYPE=tpu "
+            f"owns a single in-process device"
+        )
     if n <= 1:
         Runner(settings).run()
         return

@@ -64,11 +64,42 @@ def main(argv=None) -> None:
         help="warm-standby replication role (overrides REPL_ROLE; "
         "requires SIDECAR_ADDRS to name the peer for standby/auto)",
     )
+    parser.add_argument(
+        "--partition",
+        type=int,
+        default=None,
+        help="which cluster partition this owner serves (PARTITIONS>1; "
+        "cluster/). Defaults to the PARTITION_ADDRS group listing this "
+        "process's SIDECAR_SOCKET",
+    )
     args = parser.parse_args(argv)
     settings = new_settings()
     if args.role is not None:
         settings.repl_role = args.role
     setup_logging(settings)
+
+    # Partitioned cluster membership (PARTITIONS>1; cluster/): this owner
+    # serves ONE keyspace partition of the boot map — map-stamped SUBMIT
+    # frames are fenced against it (a stale client map gets
+    # STATUS_STALE_MAP + the new map, never a silently misrouted write)
+    # and the reshard admin ops are served. PARTITIONS=1 builds none of
+    # this: the pre-cluster owner, byte-identical on the wire.
+    cluster_k, cluster_groups, cluster_route_sets, _mb = (
+        settings.cluster_config()
+    )
+    partition_index = None
+    if cluster_k > 1:
+        partition_index = (
+            args.partition
+            if args.partition is not None
+            else settings.cluster_partition_of(settings.sidecar_socket)
+        )
+        if partition_index is None:
+            raise SystemExit(
+                f"PARTITIONS={cluster_k} but neither --partition was "
+                f"given nor does any PARTITION_ADDRS group list this "
+                f"process's SIDECAR_SOCKET ({settings.sidecar_socket!r})"
+            )
 
     sink = (
         StatsdSink(settings.statsd_host, settings.statsd_port)
@@ -187,8 +218,30 @@ def main(argv=None) -> None:
         # wire frames coalesce in the rings while one thread owns every
         # launch; DISPATCH_LOOP=false falls back to leader-collects
         dispatch_loop=settings.dispatch_loop,
+        # partition labeling for the arena-pressure telemetry
+        # (DispatchStats): ring pressure on a K-partition host traces to
+        # the keyspace slice generating it
+        partition=-1 if partition_index is None else partition_index,
         **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
+    cluster_node = None
+    if partition_index is not None:
+        from ..cluster.node import ClusterNode
+        from ..cluster.partition_map import PartitionMap
+
+        cluster_node = ClusterNode(
+            partition_index,
+            PartitionMap.even_map(
+                cluster_groups, route_sets=cluster_route_sets
+            ),
+            scope=scope,
+        )
+        logger.warning(
+            "cluster partition %d of %d (route sets %d)",
+            partition_index,
+            cluster_k,
+            cluster_route_sets,
+        )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
     # Lease liability gauges (backends/lease.py): frontends with
     # LEASE_ENABLED ship grant/settle trailers on their SUBMIT frames; the
@@ -233,6 +286,12 @@ def main(argv=None) -> None:
     if snap_dir:
         from ..persist.snapshotter import SlabSnapshotter
 
+        snap_partition = None
+        if cluster_node is not None:
+            own = cluster_node.pmap.partitions[partition_index]
+            snap_partition = (
+                partition_index, own.lo, own.hi, cluster_route_sets,
+            )
         snapshotter = SlabSnapshotter(
             engine,
             snap_dir,
@@ -241,6 +300,9 @@ def main(argv=None) -> None:
             time_source=RealTimeSource(),
             scope=scope,
             fault_injector=fault_injector,
+            # stamp this owner's keyspace slice into every shard header
+            # so snapshot_inspect can tell which slice a file holds
+            partition=snap_partition,
         )
         if repl is None or not repl.is_standby:
             # explicit primary (or no replication): the original contract
@@ -271,6 +333,17 @@ def main(argv=None) -> None:
         profile_dir=settings.tpu_profile_dir,
     )
     add_healthcheck(debug, health)
+    if cluster_node is not None:
+        import json as _json
+
+        def handle_cluster(h) -> None:
+            h._write(
+                200,
+                _json.dumps(cluster_node.describe(), indent=2).encode(),
+                content_type="application/json",
+            )
+
+        debug.add_get("/debug/cluster", handle_cluster)
     debug.serve_background()
     store.start_flushing()
     # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
@@ -286,6 +359,15 @@ def main(argv=None) -> None:
             "bypass the epoch fence (socket RPC only on this owner)"
         )
         shm_control = ""
+    if shm_control and cluster_node is not None:
+        # same rationale as the epoch fence: shm frames carry no map
+        # stamp, so a stale router could write misrouted rows straight
+        # into the dispatch loop — the cluster stays on the fenced wire
+        logger.warning(
+            "SHM_RINGS disabled: PARTITIONS>1 and shm frames would "
+            "bypass the partition-map fence (socket RPC only)"
+        )
+        shm_control = ""
     server = SlabSidecarServer(
         settings.sidecar_socket,
         engine,
@@ -296,6 +378,7 @@ def main(argv=None) -> None:
         fault_injector=fault_injector,
         repl=repl,
         shm_control_path=shm_control,
+        cluster=cluster_node,
     )
     if repl is not None:
         # resolve the auto role / start the standby subscription only

@@ -87,7 +87,19 @@ def row_algorithms(table: np.ndarray) -> np.ndarray:
 # writer's set associativity (v2 slab shards; 0 = unknown/v1 — the loader
 # treats that as "rehash on restore").
 FLAG_LEASE_TABLE = 1
+# FLAG_PARTITION (cluster/): a 20-byte extension block sits between the
+# header and the payload — <IIII> partition_index, range_lo, range_hi,
+# route_sets, then a u32 CRC of those 16 bytes. Stamped by partitioned
+# device owners so an operator holding a pile of snapshot files can tell
+# WHICH keyspace slice each one holds (tools/snapshot_inspect.py renders
+# it); files without the flag parse exactly as before — byte-identical
+# unpartitioned format.
+FLAG_PARTITION = 2
 FLAG_WAYS_SHIFT = 16
+
+_PARTITION_EXT = struct.Struct("<IIII")
+_PARTITION_CRC = struct.Struct("<I")
+PARTITION_EXT_SIZE = _PARTITION_EXT.size + _PARTITION_CRC.size  # 20 bytes
 
 # Mirror of backends/lease.py's liability row layout (tests pin equality).
 LEASE_ROW_WIDTH = 8
@@ -126,6 +138,14 @@ class SnapshotHeader:
     payload_crc: int
     payload_len: int
     flags: int = 0
+    # (partition_index, range_lo, range_hi, route_sets) from the
+    # FLAG_PARTITION extension block; None on unpartitioned files
+    partition: tuple | None = None
+
+    @property
+    def ext_size(self) -> int:
+        """Bytes between the 60-byte base header and the payload."""
+        return PARTITION_EXT_SIZE if self.flags & FLAG_PARTITION else 0
 
     @property
     def ways(self) -> int:
@@ -147,7 +167,11 @@ class SnapshotHeader:
             self.payload_crc,
             self.payload_len,
         )
-        return head + _HEADER_CRC.pack(zlib.crc32(head))
+        out = head + _HEADER_CRC.pack(zlib.crc32(head))
+        if self.flags & FLAG_PARTITION:
+            ext = _PARTITION_EXT.pack(*self.partition)
+            out += ext + _PARTITION_CRC.pack(zlib.crc32(ext))
+        return out
 
 
 def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
@@ -193,6 +217,16 @@ def _unpack_header(raw: bytes, path: str) -> SnapshotHeader:
             f"{path}: payload_len {header.payload_len} does not match "
             f"{header.n_slots} rows x {header.row_width} uint32 words"
         )
+    if flags & FLAG_PARTITION:
+        ext_raw = raw[HEADER_SIZE : HEADER_SIZE + PARTITION_EXT_SIZE]
+        if len(ext_raw) < PARTITION_EXT_SIZE:
+            raise SnapshotError(f"{path}: truncated partition extension")
+        (ext_crc,) = _PARTITION_CRC.unpack_from(ext_raw, _PARTITION_EXT.size)
+        if zlib.crc32(ext_raw[: _PARTITION_EXT.size]) != ext_crc:
+            raise SnapshotError(f"{path}: partition extension CRC mismatch")
+        header = dataclasses.replace(
+            header, partition=_PARTITION_EXT.unpack_from(ext_raw)
+        )
     return header
 
 
@@ -204,18 +238,33 @@ def pack_table_bytes(
     flags: int = 0,
     ways: int = 0,
     version: int = SNAPSHOT_VERSION,
+    partition: tuple | None = None,
 ) -> bytes:
     """One table as a self-describing versioned+CRC section: the exact
     bytes a snapshot file holds (header.pack() + payload). Shared by the
-    file writer below and the replication stream (persist/replication.py),
-    so a standby's full-sync frame IS the snapshot format — same CRCs,
-    same ways stamp, same validation path."""
+    file writer below, the replication stream (persist/replication.py),
+    and the cluster reshard stream (cluster/reshard.py), so a standby's
+    full-sync frame and a moved route range ARE the snapshot format —
+    same CRCs, same ways stamp, same validation path.
+
+    partition: optional (partition_index, range_lo, range_hi,
+    route_sets) — stamped as the FLAG_PARTITION extension block so the
+    file/section records which keyspace slice it holds. None (the
+    default) writes the byte-identical unpartitioned format."""
     table = np.ascontiguousarray(table, dtype="<u4")
     if table.ndim != 2:
         raise ValueError(f"snapshot table must be 2-D, got {table.shape}")
     payload = table.tobytes()
     if ways:
         flags = int(flags) | (int(ways) << FLAG_WAYS_SHIFT)
+    if partition is not None:
+        if len(partition) != 4:
+            raise ValueError(
+                f"partition stamp must be (index, lo, hi, route_sets), "
+                f"got {partition!r}"
+            )
+        flags = int(flags) | FLAG_PARTITION
+        partition = tuple(int(v) for v in partition)
     header = SnapshotHeader(
         version=int(version),
         created_at=int(created_at),
@@ -226,6 +275,7 @@ def pack_table_bytes(
         payload_crc=zlib.crc32(payload),
         payload_len=len(payload),
         flags=int(flags),
+        partition=partition,
     )
     return header.pack() + payload
 
@@ -237,9 +287,9 @@ def unpack_table_bytes(
     header + payload CRCs exactly like load_snapshot and returns
     (header, table copy, offset past the section) so concatenated
     sections parse sequentially."""
-    raw = buf[offset : offset + HEADER_SIZE]
+    raw = buf[offset : offset + HEADER_SIZE + PARTITION_EXT_SIZE]
     header = _unpack_header(raw, what)
-    start = offset + HEADER_SIZE
+    start = offset + HEADER_SIZE + header.ext_size
     payload = buf[start : start + header.payload_len]
     if len(payload) != header.payload_len:
         raise SnapshotError(
@@ -264,11 +314,14 @@ def write_snapshot(
     flags: int = 0,
     ways: int = 0,
     version: int = SNAPSHOT_VERSION,
+    partition: tuple | None = None,
 ) -> int:
     """Atomically write one shard's row table; returns bytes written.
     ways (slab shards only) stamps the writer's set associativity into
     the header flags so a restore under a different SLAB_WAYS knows to
-    rehash. `version` exists for tests that craft old-format fixtures.
+    rehash. partition optionally stamps the owner's keyspace slice
+    (pack_table_bytes). `version` exists for tests that craft old-format
+    fixtures.
 
     fault_injector (testing/faults.py) is consulted at site
     'snapshot.write': 'error' raises OSError before any byte lands;
@@ -290,6 +343,7 @@ def write_snapshot(
         flags=flags,
         ways=ways,
         version=version,
+        partition=partition,
     )
     payload_len = len(blob) - HEADER_SIZE
     if action == "corrupt":
@@ -324,7 +378,7 @@ def read_header(path: str) -> SnapshotHeader:
     """Validate and return just the header (magic/version/CRC checked)."""
     try:
         with open(path, "rb") as f:
-            raw = f.read(HEADER_SIZE)
+            raw = f.read(HEADER_SIZE + PARTITION_EXT_SIZE)
     except OSError as e:
         raise SnapshotError(f"{path}: {e}") from e
     return _unpack_header(raw, path)
@@ -351,7 +405,7 @@ def load_snapshot(
     except OSError as e:
         raise SnapshotError(f"{path}: {e}") from e
     header = _unpack_header(raw, path)
-    payload = raw[HEADER_SIZE:]
+    payload = raw[HEADER_SIZE + header.ext_size :]
     if action == "corrupt" and payload:
         mutated = bytearray(payload)
         mutated[len(mutated) // 2] ^= 0xFF
@@ -482,6 +536,62 @@ def migrate_rows_to_sets(
     placed = int(keep.sum())
     dropped = int((~keep).sum())
     return out, {"placed": placed, "dropped_overflow": dropped}
+
+
+def merge_rows_into_table(
+    table: np.ndarray, rows: np.ndarray, ways: int
+) -> tuple[np.ndarray, dict]:
+    """Merge incoming rows into a W-way table by fingerprint — the
+    reshard-push primitive (cluster/reshard.py): the target owner merges
+    a streamed route range into its live slab.
+
+    Keep-the-newest rule per (fp_lo, fp_hi): the row with the GREATER
+    window wins (a later fixed window, a further-advanced GCRA TAT, a
+    fresher concurrency touch — every algorithm stores monotonic
+    progress there); equal windows keep the greater count, so a
+    stage-then-drain double delivery can only converge upward toward the
+    true counter, never roll an admission back. Placement then rebuilds
+    through migrate_rows_to_sets — the SAME descending-count,
+    overflow-drops-least-valuable discipline every other table migration
+    uses. Returns (merged table, {'merged', 'replaced', 'dropped_overflow'})."""
+    table = np.asarray(table, dtype=np.uint32)
+    rows = np.asarray(rows, dtype=np.uint32)
+    if rows.ndim != 2 or rows.shape[1] != table.shape[1]:
+        raise SnapshotError(
+            f"cannot merge rows of shape {rows.shape} into a table of "
+            f"shape {table.shape}"
+        )
+    n_slots = table.shape[0]
+    existing = table[table.any(axis=1)]
+    incoming = rows[rows.any(axis=1)]
+    stats = {"merged": int(incoming.shape[0]), "replaced": 0,
+             "dropped_overflow": 0}
+    if incoming.shape[0] == 0:
+        return np.array(table, copy=True), stats
+    combined = np.vstack([existing, incoming])
+    key = combined[:, COL_FP_LO].astype(np.uint64) | (
+        combined[:, COL_FP_HI].astype(np.uint64) << np.uint64(32)
+    )
+    # per fingerprint: keep max window, then max count (lexsort is
+    # ascending; the LAST row of each key run is the keeper)
+    order = np.lexsort(
+        (combined[:, COL_COUNT], combined[:, COL_WINDOW], key)
+    )
+    sorted_key = key[order]
+    is_last = np.r_[sorted_key[1:] != sorted_key[:-1], True]
+    best = combined[order[is_last]]
+    stats["replaced"] = int(combined.shape[0] - best.shape[0])
+    if best.shape[0] > n_slots:
+        # more live fingerprints than the table holds at all: keep the
+        # highest counts (the in-kernel eviction's value rule)
+        keep = np.argsort(-best[:, COL_COUNT].astype(np.int64), kind="stable")
+        stats["dropped_overflow"] += int(best.shape[0] - n_slots)
+        best = best[keep[:n_slots]]
+    scratch = np.zeros_like(table)
+    scratch[: best.shape[0]] = best
+    out, mig = migrate_rows_to_sets(scratch, ways)
+    stats["dropped_overflow"] += mig["dropped_overflow"]
+    return out, stats
 
 
 def set_occupancy_histogram(

@@ -98,6 +98,7 @@ class SlabSnapshotter:
         time_source=None,
         scope=None,
         fault_injector=None,
+        partition: tuple | None = None,
     ):
         if interval_ms <= 0:
             raise ValueError(
@@ -105,6 +106,12 @@ class SlabSnapshotter:
             )
         self._engine = engine
         self._dir = directory
+        # (partition_index, range_lo, range_hi, route_sets) — a
+        # partitioned owner (cluster/) stamps its keyspace slice into
+        # every slab-shard header (snapshot.py FLAG_PARTITION) so the
+        # inspector can tell which slice a file holds; None keeps the
+        # byte-identical unpartitioned format
+        self._partition = partition
         self._interval_s = float(interval_ms) / 1e3
         # default staleness: 3 missed intervals — one in-flight write plus
         # real slack before the health surface starts reporting degraded
@@ -206,6 +213,7 @@ class SlabSnapshotter:
                         shard_count=len(tables),
                         fault_injector=self._faults,
                         ways=ways,
+                        partition=self._partition,
                     )
                 # lease-liability section: outstanding grants ride the
                 # same snapshot set so a restart never double-grants

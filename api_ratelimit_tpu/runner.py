@@ -139,6 +139,19 @@ def create_limiter(
             **kwargs,
         )
     if backend == "tpu-sidecar":
+        k, _groups, _route_sets, _rate = settings.cluster_config()
+        if k > 1:
+            # PARTITIONS>1: the partition router (cluster/router.py) —
+            # one per-partition failover client behind the same engine
+            # verbs. PARTITIONS=1 never builds it: the plain client
+            # below ships byte-identical pre-cluster frames (the pinned
+            # rollback arm).
+            from .cluster.router import new_partitioned_cache_from_settings
+
+            return new_partitioned_cache_from_settings(
+                settings, base, stats_scope=scope,
+                fault_injector=fault_injector, lease_table=lease_table,
+            )
         from .backends.sidecar import new_sidecar_cache_from_settings
 
         return new_sidecar_cache_from_settings(
@@ -389,9 +402,20 @@ class Runner:
         # Device-owner failover probe (SIDECAR_ADDRS; backends/sidecar.py):
         # while this frontend serves from a standby address the cluster is
         # one failure from the degradation ladder — /healthcheck carries
-        # it while the instance keeps serving.
+        # it while the instance keeps serving. The partition router
+        # (cluster/router.py) exposes the same probe aggregated over its
+        # per-partition clients.
         if engine is not None and hasattr(engine, "failover_reason"):
             self.server.health.add_degraded_probe(engine.failover_reason)
+        # Partitioned-cluster debug surface (PARTITIONS>1; cluster/): the
+        # adopted map epoch, each partition's range, active address, and
+        # breaker state — GET /debug/cluster on the frontend debug port
+        # (the per-owner view lives on each sidecar's own debug port).
+        if engine is not None and hasattr(engine, "cluster_snapshot"):
+            self.server.add_debug_endpoint(
+                "/debug/cluster",
+                lambda: json.dumps(engine.cluster_snapshot(), indent=2),
+            )
 
         # Warm restart (persist/): restore the slab from the last snapshot
         # BEFORE serving, then re-snapshot on a cadence off the hot path;

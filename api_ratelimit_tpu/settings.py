@@ -300,6 +300,29 @@ class Settings:
     # spawn. 1 (the default) is the single-process legacy boot,
     # byte-identical to PR-10.
     frontend_procs: int = 1
+    # --- partitioned device-owner cluster (cluster/) ---
+    # PARTITIONS: how many keyspace partitions the cluster runs. 1 (the
+    # default) is the pre-cluster single-owner deployment — the frontend
+    # builds the plain SidecarEngineClient and ships byte-identical wire
+    # frames (the pinned rollback arm). K>1 requires PARTITION_ADDRS to
+    # name K owner groups; the frontend then routes every row block by
+    # set_index(fp_lo, PARTITION_ROUTE_SETS) through cluster/router.py.
+    partitions: int = 1
+    # PARTITION_ADDRS: K owner address groups, ';' between partitions and
+    # ',' within a group (primary first, then that partition's warm
+    # standbys — each group is a per-partition SIDECAR_ADDRS failover
+    # list). Example, 2 partitions each with a standby:
+    #   /run/p0a.sock,/run/p0b.sock;/run/p1a.sock,/run/p1b.sock
+    partition_addrs: str = ""
+    # resolution of the keyspace split (the Redis Cluster 16384-slot
+    # analog): a power of two >= PARTITIONS, fixed for the cluster's
+    # lifetime — resharding moves ranges between owners, never changes
+    # the resolution
+    partition_route_sets: int = 256
+    # reshard streaming throttle: the coordinator sleeps so moved
+    # route-range sections stream at most this fast, keeping a reshard
+    # from starving the owners' serving path of socket bandwidth
+    reshard_rate_limit_mb_s: float = 32.0
     # --- rate-limit algorithm knobs (config/loader.py, ops/slab.py) ---
     # CONCURRENCY_TTL_S: idle TTL (seconds) stamped into `algorithm:
     # concurrency` rules — a key none of whose holders acquire or release
@@ -570,6 +593,71 @@ class Settings:
             )
         return role, interval, max_lag if max_lag > 0 else 5.0 * interval
 
+    def cluster_config(self) -> tuple[int, list[list[str]], int, float]:
+        """Validated (partitions, addr_groups, route_sets,
+        reshard_rate_limit_mb_s) for the partitioned cluster (cluster/).
+        PARTITIONS=1 returns ([], ...) — the pre-cluster rollback arm
+        builds no router. Junk fails the boot like every other knob: a
+        typo'd partition count must not silently become a different
+        keyspace split."""
+        k = int(self.partitions)
+        if k < 1:
+            raise ValueError(f"PARTITIONS must be >= 1, got {k}")
+        route_sets = int(self.partition_route_sets)
+        if route_sets <= 0 or route_sets & (route_sets - 1):
+            raise ValueError(
+                f"PARTITION_ROUTE_SETS must be a power of two, "
+                f"got {route_sets}"
+            )
+        rate = float(self.reshard_rate_limit_mb_s)
+        if rate <= 0:
+            raise ValueError(
+                f"RESHARD_RATE_LIMIT_MB_S must be > 0, got {rate}"
+            )
+        if k == 1:
+            return 1, [], route_sets, rate
+        if k > route_sets:
+            raise ValueError(
+                f"PARTITIONS ({k}) cannot exceed PARTITION_ROUTE_SETS "
+                f"({route_sets})"
+            )
+        raw = self.partition_addrs.strip()
+        groups = [
+            [a.strip() for a in grp.split(",") if a.strip()]
+            for grp in raw.split(";")
+            if grp.strip()
+        ]
+        if len(groups) != k:
+            raise ValueError(
+                f"PARTITIONS={k} needs exactly {k} ';'-separated "
+                f"PARTITION_ADDRS groups, got {len(groups)} "
+                f"({self.partition_addrs!r})"
+            )
+        from .backends.sidecar import parse_sidecar_address
+
+        for i, grp in enumerate(groups):
+            if not grp:
+                raise ValueError(f"PARTITION_ADDRS group {i} is empty")
+            for addr in grp:
+                try:
+                    parse_sidecar_address(addr)
+                except ValueError as e:
+                    raise ValueError(
+                        f"bad PARTITION_ADDRS entry {addr!r} "
+                        f"(group {i}): {e}"
+                    ) from e
+        return k, groups, route_sets, rate
+
+    def cluster_partition_of(self, address: str) -> int | None:
+        """Which PARTITION_ADDRS group lists `address` — how a sidecar
+        process discovers its own partition index without a flag (the
+        --partition argument overrides). None when unlisted."""
+        _k, groups, _rs, _rate = self.cluster_config()
+        for i, grp in enumerate(groups):
+            if address in grp:
+                return i
+        return None
+
     def shm_control_path(self) -> str:
         """The shm-ring control socket path, or "" when shm rings are
         off/underivable. Explicit SHM_CONTROL_SOCK wins; otherwise a unix
@@ -768,6 +856,10 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("shm_control_sock", "SHM_CONTROL_SOCK", str),
     ("shm_ring_rows", "SHM_RING_ROWS", int),
     ("frontend_procs", "FRONTEND_PROCS", int),
+    ("partitions", "PARTITIONS", int),
+    ("partition_addrs", "PARTITION_ADDRS", str),
+    ("partition_route_sets", "PARTITION_ROUTE_SETS", int),
+    ("reshard_rate_limit_mb_s", "RESHARD_RATE_LIMIT_MB_S", float),
     ("concurrency_ttl_s", "CONCURRENCY_TTL_S", int),
     ("gcra_burst_ratio", "GCRA_BURST_RATIO", float),
     ("fault_inject", "FAULT_INJECT", str),

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Local 2-partition cluster, each partition a primary+standby owner pair,
+# plus one frontend — the smallest end-to-end PARTITIONS>1 deployment
+# (README "Partitioned cluster"). Every process shares the same
+# PARTITIONS/PARTITION_ADDRS pair; each owner discovers its partition
+# from the PARTITION_ADDRS group listing its own SIDECAR_SOCKET, and
+# each pair runs the PR-10 replication machinery privately (--role auto:
+# whoever finds a live peer becomes its standby).
+#
+# Usage:  bash examples/cluster/run_local_cluster.sh
+# Then:   curl -s localhost:6070/debug/cluster        # the router's map
+#         curl -s localhost:6071/healthcheck          # partition 0 primary
+#         curl -s -XPOST localhost:8080/json -d '{"domain":"mongo_cps",
+#           "descriptors":[{"entries":[{"key":"database","value":"users"}]}]}'
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+RUN=${RUN_DIR:-/tmp/rl-cluster}
+mkdir -p "$RUN"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export USE_STATSD=false LOG_LEVEL=INFO
+export PARTITIONS=2
+export PARTITION_ADDRS="$RUN/p0a.sock,$RUN/p0b.sock;$RUN/p1a.sock,$RUN/p1b.sock"
+export TPU_BATCH_WINDOW=200us
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+part=0
+for pair in "p0a p0b 6071 6072" "p1a p1b 6073 6074"; do
+  read -r prim stby pport sport <<<"$pair"
+  # the pair doubles as the partition's replication peer list
+  addrs="$RUN/$prim.sock,$RUN/$stby.sock"
+  SIDECAR_SOCKET="$RUN/$prim.sock" SIDECAR_ADDRS="$addrs" DEBUG_PORT=$pport \
+    SLAB_SNAPSHOT_DIR="$RUN/snap-p$part-a" \
+    python -m api_ratelimit_tpu.cmd.sidecar_cmd --role auto &
+  pids+=($!)
+  SIDECAR_SOCKET="$RUN/$stby.sock" SIDECAR_ADDRS="$addrs" DEBUG_PORT=$sport \
+    SLAB_SNAPSHOT_DIR="$RUN/snap-p$part-b" \
+    python -m api_ratelimit_tpu.cmd.sidecar_cmd --role auto &
+  pids+=($!)
+  part=$((part + 1))
+done
+
+for s in p0a p1a; do
+  while [ ! -S "$RUN/$s.sock" ]; do sleep 0.2; done
+done
+
+BACKEND_TYPE=tpu-sidecar DEBUG_PORT=6070 \
+  RUNTIME_ROOT=examples/ratelimit RUNTIME_SUBDIRECTORY= RUNTIME_WATCH_ROOT=false \
+  python -m api_ratelimit_tpu.cmd.service_cmd &
+pids+=($!)
+
+echo "cluster up: frontend :8080/:8081, debug :6070 (router) :6071-:6074 (owners)"
+wait

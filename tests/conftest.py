@@ -64,6 +64,11 @@ def pytest_configure(config):
         "mp: multi-process frontend tests (shm rings / FRONTEND_PROCS; "
         "`make tests_mp`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "cluster: partitioned device-owner cluster tests (cluster/; "
+        "`make tests_cluster`)",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -1,0 +1,783 @@
+"""Partitioned device-owner cluster (cluster/; PR 13).
+
+Covers the PartitionMap unit surface, the set_index -> partition routing
+fuzz (stability across map epochs), the K in {1, 2, 4} differential
+parity against the single-owner engine, the PARTITIONS=1 byte-identical
+rollback arm, the STATUS_STALE_MAP wire fence, live resharding K=2->4
+under closed-loop load, the SIGKILL-one-partition-primary chaos story
+(per-partition standby promotes, other partitions unaffected), the
+whole-pair-dead degradation (only that key range raises into the failure
+ladder), the /debug/cluster surfaces, the snapshot partition stamp, and
+the partition-labeled dispatch arena telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.sidecar import (
+    FLAG_MAP,
+    MAGIC,
+    OP_MAP_GET,
+    OP_SUBMIT,
+    VERSION,
+    SidecarEngineClient,
+    SlabSidecarServer,
+    StaleMapError,
+    _HDR,
+    _recv_exact,
+    cluster_rpc,
+    encode_items,
+)
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+from api_ratelimit_tpu.cluster.node import ClusterNode
+from api_ratelimit_tpu.cluster.partition_map import Partition, PartitionMap
+from api_ratelimit_tpu.cluster.reshard import ReshardCoordinator
+from api_ratelimit_tpu.cluster.router import PartitionedEngineClient
+from api_ratelimit_tpu.limiter.cache import CacheError
+from api_ratelimit_tpu.ops.hashing import set_index
+from api_ratelimit_tpu.persist.replication import ReplicationCoordinator
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+pytestmark = pytest.mark.cluster
+
+
+def _make_engine(n_slots=1 << 10, window=0.0):
+    return SlabDeviceEngine(
+        RealTimeSource(),
+        n_slots=n_slots,
+        use_pallas=False,
+        buckets=(128,),
+        batch_window_seconds=window,
+        block_mode=True,
+    )
+
+
+def _block(fps, hits=1, limit=1_000_000, divider=3600):
+    fps = np.asarray(fps, dtype=np.uint64)
+    n = fps.shape[0]
+    blk = np.zeros((6, n), dtype=np.uint32)
+    blk[0] = (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    blk[1] = (fps >> np.uint64(32)).astype(np.uint32)
+    blk[2] = hits
+    blk[3] = limit
+    blk[4] = divider
+    return blk
+
+
+class _InprocClient:
+    """In-process 'owner' for router differential tests: the router's
+    client seam over a bare engine (no sockets, no maps — routing is the
+    thing under test)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def submit_rows(self, block, lease_ops=None):
+        return self.engine.submit_rows(block, lease_ops=lease_ops)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestPartitionMap:
+    def test_even_map_tiles_the_route_space(self):
+        for k in (1, 2, 3, 4, 8):
+            m = PartitionMap.even_map([[f"a{i}"] for i in range(k)])
+            assert len(m) == k
+            covered = sum(p.hi - p.lo for p in m.partitions)
+            assert covered == m.route_sets
+            assert m.partitions[0].lo == 0
+            assert m.partitions[-1].hi == m.route_sets
+
+    def test_validation_rejects_junk(self):
+        p = lambda i, lo, hi: Partition(i, lo, hi, ("a",))  # noqa: E731
+        with pytest.raises(ValueError, match="power of two"):
+            PartitionMap(1, 100, [p(0, 0, 100)])
+        with pytest.raises(ValueError, match="tile"):
+            PartitionMap(1, 64, [p(0, 0, 16), p(1, 32, 64)])  # gap
+        with pytest.raises(ValueError, match="tile"):
+            PartitionMap(1, 64, [p(0, 0, 48), p(1, 32, 64)])  # overlap
+        with pytest.raises(ValueError, match="cover"):
+            PartitionMap(1, 64, [p(0, 0, 32)])  # short
+        with pytest.raises(ValueError, match="indices"):
+            PartitionMap(1, 64, [p(1, 0, 32), p(0, 32, 64)])
+        with pytest.raises(ValueError, match="owner address"):
+            PartitionMap(1, 64, [Partition(0, 0, 64, ())])
+        with pytest.raises(ValueError, match="at least one"):
+            PartitionMap(1, 64, [])
+
+    def test_json_round_trip(self):
+        m = PartitionMap.even_map([["a", "b"], ["c"]], route_sets=64, epoch=7)
+        m2 = PartitionMap.from_json_bytes(m.to_json_bytes())
+        assert m2 == m
+        with pytest.raises(ValueError, match="malformed"):
+            PartitionMap.from_json_bytes(b"{nope")
+
+    def test_reshard_to_bumps_epoch_and_moved_ranges(self):
+        m2 = PartitionMap.even_map([["a"], ["b"]], route_sets=64)
+        m4 = m2.reshard_to([["a"], ["b"], ["c"], ["d"]])
+        assert m4.epoch == m2.epoch + 1
+        moved = m2.moved_ranges(m4)
+        # halves of each old partition move to the new owners; the
+        # retained halves (same address pair) move nothing
+        assert [(lo, hi, s.index, d.index) for lo, hi, s, d in moved] == [
+            (16, 32, 0, 1),
+            (32, 48, 1, 2),
+            (48, 64, 1, 3),
+        ]
+        # identical addr layout = nothing to move, whatever the epoch
+        same = m2.reshard_to([["a"], ["b"]])
+        assert m2.moved_ranges(same) == []
+
+    def test_maps_are_immutable(self):
+        m = PartitionMap.even_map([["a"]])
+        with pytest.raises(AttributeError):
+            m.epoch = 9
+
+
+class TestRoutingFuzz:
+    """The satellite pin: set_index -> partition stability across map
+    epochs, on random fingerprints."""
+
+    def test_partition_of_matches_manual_range_walk(self):
+        rng = np.random.default_rng(13)
+        fp_lo = rng.integers(0, 1 << 32, size=4096, dtype=np.uint64).astype(
+            np.uint32
+        )
+        for k in (1, 2, 4, 8):
+            m = PartitionMap.even_map(
+                [[f"a{i}"] for i in range(k)], route_sets=128
+            )
+            got = np.asarray(m.partition_of(fp_lo))
+            route = np.asarray(set_index(fp_lo, 128))
+            want = np.empty_like(got)
+            for p in m.partitions:
+                want[(route >= p.lo) & (route < p.hi)] = p.index
+            assert np.array_equal(got, want)
+
+    def test_routing_stable_across_epoch_bumps(self):
+        """An epoch bump that keeps the same ranges must not move a
+        single key — reshard correctness depends on only EXPLICIT range
+        moves ever changing a key's owner."""
+        rng = np.random.default_rng(17)
+        fp_lo = rng.integers(0, 1 << 32, size=4096, dtype=np.uint64).astype(
+            np.uint32
+        )
+        groups = [["a"], ["b"], ["c"], ["d"]]
+        m1 = PartitionMap.even_map(groups, route_sets=256, epoch=1)
+        m9 = PartitionMap.even_map(groups, route_sets=256, epoch=9)
+        assert np.array_equal(
+            np.asarray(m1.partition_of(fp_lo)), np.asarray(m9.partition_of(fp_lo))
+        )
+
+    def test_every_partition_sees_only_its_range(self):
+        rng = np.random.default_rng(23)
+        fp_lo = rng.integers(0, 1 << 32, size=2048, dtype=np.uint64).astype(
+            np.uint32
+        )
+        m = PartitionMap.even_map([["a"], ["b"], ["c"]], route_sets=64)
+        route = np.asarray(set_index(fp_lo, 64))
+        for p in m.partitions:
+            mask = np.asarray(m.owned_mask(fp_lo, p.index))
+            assert np.array_equal(mask, (route >= p.lo) & (route < p.hi))
+
+
+class TestDifferentialParity:
+    """Per-partition routing is decision-identical to the single-owner
+    engine on the same stream — the oracle-parity pin across K in
+    {1, 2, 4} (the single-owner engine is itself differential-fuzzed
+    against testing/oracle.py in tests/test_slab_fuzz.py, so parity with
+    it IS oracle parity)."""
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_router_matches_single_owner(self, k):
+        control = _make_engine()
+        shards = [_make_engine() for _ in range(k)]
+        pmap = PartitionMap.even_map(
+            [[f"part{i}"] for i in range(k)], route_sets=64
+        )
+        idx_of = {f"part{i}": i for i in range(k)}
+        router = PartitionedEngineClient(
+            pmap,
+            client_factory=lambda addrs, fn: _InprocClient(
+                shards[idx_of[addrs[0]]]
+            ),
+        )
+        rng = np.random.default_rng(29)
+        try:
+            for _ in range(20):
+                n = int(rng.integers(1, 48))
+                # hot head + random tail: duplicates in one block
+                # exercise the in-launch serialization on both sides
+                fps = rng.integers(0, 1 << 20, size=n, dtype=np.uint64)
+                blk = _block(fps, limit=64)
+                got = router.submit_rows(blk)
+                want = control.submit_rows(blk.copy())
+                assert np.array_equal(got, want)
+        finally:
+            router.close()
+            control.close()
+            for e in shards:
+                e.close()
+
+
+class TestRollbackArm:
+    """PARTITIONS=1 builds NO router: the frontend keeps the plain
+    SidecarEngineClient and its byte-identical pre-cluster frames."""
+
+    def _capture_server(self, tmp_path):
+        captured = []
+        done = threading.Event()
+        sock_path = str(tmp_path / "cap.sock")
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(sock_path)
+        srv.listen(4)
+
+        def serve():
+            try:
+                while not done.is_set():
+                    conn, _ = srv.accept()
+                    with conn:
+                        while True:
+                            hdr = _recv_exact(conn, _HDR.size)
+                            _m, _v, op, flags = _HDR.unpack(hdr)
+                            if op == 2:  # PING
+                                conn.sendall(b"\x00")
+                                continue
+                            n_raw = _recv_exact(conn, 4)
+                            (n,) = struct.unpack("<I", n_raw)
+                            body = n_raw + _recv_exact(conn, 6 * n * 4)
+                            if flags & FLAG_MAP:
+                                body += _recv_exact(conn, 4)
+                            captured.append(hdr + body)
+                            conn.sendall(
+                                b"\x00"
+                                + struct.pack("<I", n)
+                                + np.ones(n, dtype=np.uint32).tobytes()
+                            )
+            except (OSError, ConnectionError):
+                return
+
+        threading.Thread(target=serve, daemon=True).start()
+        return sock_path, captured, done, srv
+
+    def test_partitions_1_builds_the_plain_client(self, tmp_path):
+        from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+        from api_ratelimit_tpu.runner import create_limiter
+        from api_ratelimit_tpu.settings import Settings
+        from api_ratelimit_tpu.stats.store import Store
+        from api_ratelimit_tpu.stats.sinks import NullSink
+        import random
+
+        sock_path, captured, done, srv = self._capture_server(tmp_path)
+        settings = Settings()
+        settings.backend_type = "tpu-sidecar"
+        settings.sidecar_socket = sock_path
+        settings.shm_rings = False
+        settings.partitions = 1  # the rollback arm
+        base = BaseRateLimiter(
+            time_source=RealTimeSource(),
+            jitter_rand=random.Random(0),
+            expiration_jitter_max_seconds=0,
+            local_cache=None,
+            near_limit_ratio=0.8,
+        )
+        cache = create_limiter(settings, base, Store(NullSink()))
+        try:
+            engine = cache.engine
+            assert isinstance(engine, SidecarEngineClient)
+            assert not isinstance(engine, PartitionedEngineClient)
+            # no map fence on the wire: the exact pre-cluster frame
+            assert engine._map_epoch_fn is None
+            items = [_Item(fp=42, hits=1, limit=10, divider=3600, jitter=0)]
+            engine.submit(items)
+            expected = (
+                _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+            )
+            assert captured[-1] == expected
+        finally:
+            cache.close()
+            done.set()
+            srv.close()
+
+    def test_map_stamped_frames_set_only_the_map_flag(self, tmp_path):
+        """A router's per-partition client adds exactly one u32 trailer
+        + FLAG_MAP on top of the legacy frame — nothing else changes."""
+        sock_path, captured, done, srv = self._capture_server(tmp_path)
+        client = SidecarEngineClient(
+            sock_path, retries=0, breaker_threshold=0, map_epoch_fn=lambda: 7
+        )
+        try:
+            items = [_Item(fp=42, hits=1, limit=10, divider=3600, jitter=0)]
+            client.submit(items)
+            legacy = (
+                _HDR.pack(MAGIC, VERSION, OP_SUBMIT, 0) + encode_items(items)
+            )
+            got = captured[-1]
+            _m, _v, _op, flags = _HDR.unpack(got[: _HDR.size])
+            assert flags == FLAG_MAP
+            assert got[_HDR.size : -4] == legacy[_HDR.size :]
+            assert got[-4:] == struct.pack("<I", 7)
+        finally:
+            client.close()
+            done.set()
+            srv.close()
+
+
+class _Owner:
+    """One socket-served partition owner (in-process engine)."""
+
+    def __init__(self, sock, pmap, index, repl=None):
+        self.sock = sock
+        self.engine = _make_engine()
+        self.node = ClusterNode(index, pmap)
+        self.repl = repl
+        self.server = SlabSidecarServer(
+            sock, self.engine, repl=repl, cluster=self.node
+        )
+        if repl is not None:
+            repl.start()
+        self.closed = False
+
+    def close(self):
+        if not self.closed:
+            self.closed = True
+            self.server.close()
+            if self.repl is not None:
+                self.repl.close()
+
+
+def _fast_client_kwargs():
+    return dict(
+        retries=2,
+        retry_backoff=0.001,
+        retry_backoff_max=0.01,
+        breaker_threshold=2,
+        breaker_reset=0.05,
+    )
+
+
+class TestStaleMapWire:
+    def test_stale_epoch_frame_gets_the_new_map(self, tmp_path):
+        sock = str(tmp_path / "o.sock")
+        boot = PartitionMap.even_map([[sock]], route_sets=64, epoch=1)
+        owner = _Owner(sock, boot, 0)
+        try:
+            newer = PartitionMap(5, 64, boot.partitions)
+            owner.node.adopt(newer)
+            client = SidecarEngineClient(
+                sock, map_epoch_fn=lambda: 1, **_fast_client_kwargs()
+            )
+            with pytest.raises(StaleMapError) as exc:
+                client.submit_rows(_block([42]))
+            replied = PartitionMap.from_json_bytes(exc.value.map_json)
+            assert replied.epoch == 5
+            # the write was NOT applied: an in-date frame starts at 1
+            client2 = SidecarEngineClient(
+                sock, map_epoch_fn=lambda: 5, **_fast_client_kwargs()
+            )
+            assert client2.submit_rows(_block([42]))[0] == 1
+            client.close()
+            client2.close()
+        finally:
+            owner.close()
+
+    def test_misrouted_rows_rejected_whatever_the_epoch(self, tmp_path):
+        socks = [str(tmp_path / f"o{i}.sock") for i in range(2)]
+        pmap = PartitionMap.even_map([[socks[0]], [socks[1]]], route_sets=64)
+        owner = _Owner(socks[0], pmap, 0)  # owns routes [0, 32)
+        try:
+            client = SidecarEngineClient(
+                socks[0],
+                map_epoch_fn=lambda: pmap.epoch,
+                **_fast_client_kwargs(),
+            )
+            # route 40 belongs to partition 1 — current epoch, wrong rows
+            with pytest.raises(StaleMapError):
+                client.submit_rows(_block([40]))
+            client.close()
+        finally:
+            owner.close()
+
+    def test_map_get_rpc_and_unconfigured_owner(self, tmp_path):
+        sock = str(tmp_path / "o.sock")
+        pmap = PartitionMap.even_map([[sock]], route_sets=64)
+        owner = _Owner(sock, pmap, 0)
+        try:
+            raw = cluster_rpc(sock, OP_MAP_GET)
+            assert PartitionMap.from_json_bytes(raw) == pmap
+        finally:
+            owner.close()
+        plain_sock = str(tmp_path / "plain.sock")
+        engine = _make_engine()
+        server = SlabSidecarServer(plain_sock, engine)
+        try:
+            with pytest.raises(CacheError, match="cluster not configured"):
+                cluster_rpc(plain_sock, OP_MAP_GET)
+        finally:
+            server.close()
+
+    def test_router_adopts_and_reroutes_transparently(self, tmp_path):
+        """The router holding a STALE map converges through one rejected
+        write per partition — no surfaced errors, no lost increments."""
+        socks = [str(tmp_path / f"o{i}.sock") for i in range(4)]
+        pmap2 = PartitionMap.even_map([[socks[0]], [socks[1]]], route_sets=64)
+        pmap4 = pmap2.reshard_to([[socks[i]] for i in range(4)])
+        # owners already live on the NEW map; the router boots on the old
+        owners = [_Owner(socks[i], pmap4, i) for i in range(4)]
+        router = PartitionedEngineClient(
+            pmap2, client_kwargs=_fast_client_kwargs()
+        )
+        try:
+            fps = np.arange(64, dtype=np.uint64) * 7 + 1
+            out = router.submit_rows(_block(fps))
+            assert (out == 1).all()
+            assert router.map_epoch() == pmap4.epoch
+        finally:
+            router.close()
+            for o in owners:
+                o.close()
+
+
+class TestLiveReshard:
+    """The acceptance pin: K=2 -> 4 under closed-loop load — zero failed
+    requests, per-key counters continuous across the epoch bump, loss
+    bounded by the in-flight overlap (<= one request per driver thread,
+    the one-replication-interval analog; leases add their outstanding
+    budgets on top, per the PR-8 bound)."""
+
+    def test_reshard_2_to_4_under_load(self, tmp_path):
+        socks = [str(tmp_path / f"o{i}.sock") for i in range(4)]
+        pmap2 = PartitionMap.even_map([[socks[0]], [socks[1]]], route_sets=64)
+        pmap4 = pmap2.reshard_to([[socks[i]] for i in range(4)])
+        # old owners boot on the old map; the new owners join holding
+        # the NEW map (they serve nothing until the flip points clients
+        # at them)
+        owners = [_Owner(socks[i], pmap2, i) for i in range(2)]
+        owners += [_Owner(socks[i], pmap4, i) for i in range(2, 4)]
+        router = PartitionedEngineClient(
+            pmap2, client_kwargs=_fast_client_kwargs()
+        )
+        rng = np.random.default_rng(31)
+        keys = rng.integers(1, 1 << 30, size=48, dtype=np.uint64)
+        n_threads = 4
+        counts = [dict() for _ in range(n_threads)]
+        errors = []
+        stop = threading.Event()
+
+        def drive(tid):
+            lrng = np.random.default_rng(100 + tid)
+            while not stop.is_set():
+                fp = int(keys[lrng.integers(0, len(keys))])
+                try:
+                    router.submit_rows(_block([fp]))
+                except Exception as e:  # noqa: BLE001 - failed request IS the metric
+                    errors.append(repr(e))
+                    return
+                counts[tid][fp] = counts[tid].get(fp, 0) + 1
+
+        threads = [
+            threading.Thread(target=drive, args=(i,)) for i in range(n_threads)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            report = ReshardCoordinator(pmap2, pmap4).run()
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(10)
+        assert errors == [], errors
+        assert report["sets_moved"] > 0
+        assert router.map_epoch() == pmap4.epoch
+        # decision continuity: one probe per key reads the final counter;
+        # it must equal the true submission count, give or take the
+        # in-flight overlap at the flip (max-merge loses at most the
+        # smaller side of a concurrent src/dst split — bounded by the
+        # driver threads' single in-flight request each)
+        submitted = {}
+        for c in counts:
+            for fp, n in c.items():
+                submitted[fp] = submitted.get(fp, 0) + n
+        try:
+            for fp, n in submitted.items():
+                final = int(router.submit_rows(_block([int(fp)]))[0]) - 1
+                assert final <= n, (fp, final, n)
+                assert final >= n - n_threads, (fp, final, n)
+        finally:
+            router.close()
+            for o in owners:
+                o.close()
+
+
+class TestPartitionChaos:
+    """Per-partition failure: one partition's primary dies -> ITS standby
+    promotes via the per-partition failover pair, every other partition
+    never notices; a whole pair dying degrades ONLY its key range (the
+    CacheError that feeds the FAILURE_MODE_DENY ladder)."""
+
+    def _pair(self, tmp_path, pmap, index, tag):
+        p_sock = str(tmp_path / f"{tag}p.sock")
+        s_sock = str(tmp_path / f"{tag}s.sock")
+        p_engine = _make_engine()
+        p_coord = ReplicationCoordinator(p_engine, "primary", interval_ms=20.0)
+        p_server = SlabSidecarServer(
+            p_sock, p_engine, repl=p_coord, cluster=ClusterNode(index, pmap)
+        )
+        p_coord.start()
+        s_engine = _make_engine()
+        s_coord = ReplicationCoordinator(
+            s_engine, "standby", peer_address=p_sock, interval_ms=20.0
+        )
+        s_server = SlabSidecarServer(
+            s_sock, s_engine, repl=s_coord, cluster=ClusterNode(index, pmap)
+        )
+        s_coord.start()
+        return {
+            "p_server": p_server,
+            "p_coord": p_coord,
+            "s_server": s_server,
+            "s_coord": s_coord,
+        }
+
+    def test_kill_one_primary_standby_promotes_others_unaffected(
+        self, tmp_path
+    ):
+        p0p = str(tmp_path / "0p.sock")
+        p0s = str(tmp_path / "0s.sock")
+        p1 = str(tmp_path / "1.sock")
+        pmap = PartitionMap.even_map([[p0p, p0s], [p1]], route_sets=64)
+        pair = self._pair(tmp_path, pmap, 0, "0")
+        solo = _Owner(p1, pmap, 1)
+        router = PartitionedEngineClient(
+            pmap, client_kwargs=_fast_client_kwargs()
+        )
+        try:
+            # fp routes: low 6 bits pick the route set; 1 -> partition 0,
+            # 40 -> partition 1
+            fp0, fp1 = 1, 40
+            for i in range(5):
+                assert router.submit_rows(_block([fp0]))[0] == i + 1
+                assert router.submit_rows(_block([fp1]))[0] == i + 1
+            # wait for the standby to mirror partition 0's counter
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                tables, _, _ = pair["s_coord"].replica_state()
+                if tables is not None:
+                    hit = tables[0][tables[0][:, 0] == fp0]
+                    if hit.shape[0] and int(hit[0, 2]) == 5:
+                        break
+                time.sleep(0.01)
+            # SIGKILL analog: the primary process vanishes mid-serve
+            pair["p_server"].close()
+            pair["p_coord"].close()
+            # zero failed requests: the per-partition client fails over,
+            # the standby promotes on first write, the counter continues
+            assert router.submit_rows(_block([fp0]))[0] == 6
+            assert pair["s_coord"].role == "primary"
+            # the OTHER partition never saw any of it
+            assert router.submit_rows(_block([fp1]))[0] == 6
+            assert router.failover_reason() is not None
+            assert "partition 0" in router.failover_reason()
+        finally:
+            router.close()
+            solo.close()
+            for key in ("p_server", "s_server"):
+                try:
+                    pair[key].close()
+                except OSError:
+                    pass
+            pair["s_coord"].close()
+
+    def test_whole_pair_dead_degrades_only_its_range(self, tmp_path):
+        socks = [str(tmp_path / f"w{i}.sock") for i in range(2)]
+        pmap = PartitionMap.even_map([[socks[0]], [socks[1]]], route_sets=64)
+        owners = [_Owner(socks[i], pmap, i) for i in range(2)]
+        router = PartitionedEngineClient(
+            pmap, client_kwargs=_fast_client_kwargs()
+        )
+        try:
+            assert router.submit_rows(_block([1]))[0] == 1
+            assert router.submit_rows(_block([40]))[0] == 1
+            owners[0].close()  # both addresses of partition 0 are gone
+            # partition 0's key range raises the CacheError the
+            # FAILURE_MODE_DENY ladder answers (fallback.py) ...
+            with pytest.raises(CacheError):
+                router.submit_rows(_block([1]))
+            # ... while partition 1's range keeps serving exactly
+            assert router.submit_rows(_block([40]))[0] == 2
+        finally:
+            router.close()
+            for o in owners:
+                o.close()
+
+
+class TestDebugSurfaces:
+    def test_node_describe_and_router_snapshot(self, tmp_path):
+        sock = str(tmp_path / "o.sock")
+        pmap = PartitionMap.even_map([[sock]], route_sets=64, epoch=3)
+        node = ClusterNode(0, pmap)
+        desc = node.describe()
+        assert desc["map_epoch"] == 3
+        assert desc["owned_range"]["lo"] == 0
+        assert desc["owned_range"]["hi"] == 64
+        owner = _Owner(sock, pmap, 0)
+        router = PartitionedEngineClient(
+            pmap, client_kwargs=_fast_client_kwargs()
+        )
+        try:
+            snap = router.cluster_snapshot()
+            assert snap["map_epoch"] == 3
+            assert snap["partitions"][0]["range"] == [0, 64]
+            assert snap["partitions"][0]["active_address"] == sock
+        finally:
+            router.close()
+            owner.close()
+
+    def test_debug_cluster_http_endpoint(self, tmp_path, test_store):
+        """GET /debug/cluster — the handler shape sidecar_cmd mounts."""
+        from api_ratelimit_tpu.server.http_server import new_debug_server
+
+        store, _sink = test_store
+        pmap = PartitionMap.even_map([["a"]], route_sets=64, epoch=2)
+        node = ClusterNode(0, pmap)
+        debug = new_debug_server("127.0.0.1", 0, store)
+
+        def handle_cluster(h):
+            h._write(
+                200,
+                json.dumps(node.describe(), indent=2).encode(),
+                content_type="application/json",
+            )
+
+        debug.add_get("/debug/cluster", handle_cluster)
+        debug.serve_background()
+        try:
+            port = debug.port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/cluster", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["map_epoch"] == 2
+            assert body["partition"] == 0
+        finally:
+            debug.shutdown()
+
+
+class TestSnapshotPartitionStamp:
+    def test_snapshotter_stamps_the_keyspace_slice(self, tmp_path):
+        from api_ratelimit_tpu.persist.snapshot import read_header
+        from api_ratelimit_tpu.persist.snapshotter import (
+            SlabSnapshotter,
+            snapshot_paths,
+        )
+
+        engine = _make_engine()
+        try:
+            engine.submit_block(_block([42]))
+            snap = SlabSnapshotter(
+                engine,
+                str(tmp_path),
+                interval_ms=60_000,
+                partition=(1, 32, 64, 256),
+            )
+            assert snap.snapshot_once() > 0
+            path = snapshot_paths(str(tmp_path), 1)[0]
+            header = read_header(path)
+            assert header.partition == (1, 32, 64, 256)
+        finally:
+            engine.close()
+
+    def test_inspector_renders_partition_fields(self, tmp_path, capsys):
+        import tools.snapshot_inspect as inspect_mod
+        from api_ratelimit_tpu.persist.snapshot import write_snapshot
+
+        rows = np.zeros((16, 8), dtype=np.uint32)
+        rows[0] = [3, 7, 5, 100, 1 << 31, 60, 0, 0]
+        path = str(tmp_path / "p.snap")
+        write_snapshot(path, rows, 1234, ways=4, partition=(2, 16, 32, 64))
+        report = inspect_mod.inspect_file(path, now=None)
+        assert report["partition"] == {
+            "index": 2,
+            "range": [16, 32],
+            "route_sets": 64,
+        }
+        inspect_mod._print_text(report)
+        out = capsys.readouterr().out
+        assert "partition 2" in out
+        assert "[16, 32)" in out
+
+    def test_unpartitioned_files_are_byte_identical(self, tmp_path):
+        """No partition stamp = the exact pre-cluster format (so the
+        replication stream and existing snapshots parse unchanged)."""
+        from api_ratelimit_tpu.persist.snapshot import (
+            pack_table_bytes,
+            read_header,
+            write_snapshot,
+        )
+
+        rows = np.zeros((8, 8), dtype=np.uint32)
+        blob = pack_table_bytes(rows, 99, ways=4)
+        assert len(blob) == 60 + rows.nbytes  # header + payload, no ext
+        path = str(tmp_path / "u.snap")
+        write_snapshot(path, rows, 99, ways=4)
+        assert read_header(path).partition is None
+
+
+class TestDispatchPartitionLabel:
+    def test_arena_telemetry_carries_the_partition(self, test_store):
+        store, _sink = test_store
+        engine = SlabDeviceEngine(
+            RealTimeSource(),
+            n_slots=1 << 8,
+            use_pallas=False,
+            buckets=(128,),
+            batch_window_seconds=0.0005,
+            scope=store.scope("ratelimit"),
+            partition=3,
+        )
+        try:
+            assert engine.dispatch_loop is not None
+            assert engine.dispatch_loop.partition == 3
+            engine.submit_rows(_block([42]))
+            snap = store.debug_snapshot()
+            assert "ratelimit.dispatch.partition_3.arena_overflow" in snap
+            assert "ratelimit.dispatch.ring.partition_3.arena_hwm" in snap
+            # the flat names keep aggregating next to the labeled pair
+            assert "ratelimit.dispatch.arena_overflow" in snap
+            assert (
+                snap["ratelimit.dispatch.ring.partition_3.arena_hwm"]
+                == snap["ratelimit.dispatch.ring.arena_hwm"]
+            )
+        finally:
+            engine.close()
+
+    def test_unpartitioned_loop_registers_no_labels(self, test_store):
+        store, _sink = test_store
+        engine = SlabDeviceEngine(
+            RealTimeSource(),
+            n_slots=1 << 8,
+            use_pallas=False,
+            buckets=(128,),
+            batch_window_seconds=0.0005,
+            scope=store.scope("ratelimit"),
+        )
+        try:
+            snap = store.debug_snapshot()
+            assert not any("partition_" in k for k in snap)
+        finally:
+            engine.close()

@@ -510,3 +510,74 @@ class TestShmRingSettings:
             ).frontend_procs_count()
         with pytest.raises(ValueError, match="SHM_RING_ROWS"):
             new_settings({"SHM_RING_ROWS": "8"}).shm_ring_rows_count()
+
+
+class TestClusterSettings:
+    """PARTITIONS / PARTITION_ADDRS / PARTITION_ROUTE_SETS /
+    RESHARD_RATE_LIMIT_MB_S (cluster/)."""
+
+    def test_defaults_are_the_rollback_arm(self):
+        s = Settings()
+        k, groups, route_sets, rate = s.cluster_config()
+        assert k == 1
+        assert groups == []
+        assert route_sets == 256
+        assert rate == 32.0
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "PARTITIONS": "2",
+                "PARTITION_ADDRS": (
+                    "/run/p0a.sock,/run/p0b.sock;"
+                    "tcp://h1:7070,tcp://h1:7071"
+                ),
+                "PARTITION_ROUTE_SETS": "512",
+                "RESHARD_RATE_LIMIT_MB_S": "8.5",
+            }
+        )
+        k, groups, route_sets, rate = s.cluster_config()
+        assert k == 2
+        assert groups == [
+            ["/run/p0a.sock", "/run/p0b.sock"],
+            ["tcp://h1:7070", "tcp://h1:7071"],
+        ]
+        assert route_sets == 512
+        assert rate == 8.5
+        # a sidecar discovers its own partition from the group listing
+        # its socket; unlisted addresses discover nothing
+        s.sidecar_socket = "/run/p0b.sock"
+        assert s.cluster_partition_of(s.sidecar_socket) == 0
+        assert s.cluster_partition_of("tcp://h1:7071") == 1
+        assert s.cluster_partition_of("/run/elsewhere.sock") is None
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="PARTITIONS"):
+            new_settings({"PARTITIONS": "two"})
+        with pytest.raises(ValueError, match="PARTITIONS"):
+            new_settings({"PARTITIONS": "0"}).cluster_config()
+        with pytest.raises(ValueError, match="PARTITION_ROUTE_SETS"):
+            new_settings({"PARTITION_ROUTE_SETS": "100"}).cluster_config()
+        with pytest.raises(ValueError, match="RESHARD_RATE_LIMIT_MB_S"):
+            new_settings({"RESHARD_RATE_LIMIT_MB_S": "0"}).cluster_config()
+        # K>1 demands exactly K ';'-separated groups
+        with pytest.raises(ValueError, match="groups"):
+            new_settings(
+                {"PARTITIONS": "2", "PARTITION_ADDRS": "/run/a.sock"}
+            ).cluster_config()
+        with pytest.raises(ValueError, match="PARTITION_ADDRS entry"):
+            new_settings(
+                {
+                    "PARTITIONS": "2",
+                    "PARTITION_ADDRS": "/run/a.sock;tcp://nope",
+                }
+            ).cluster_config()
+        # more partitions than route sets cannot tile the space
+        with pytest.raises(ValueError, match="cannot exceed"):
+            new_settings(
+                {
+                    "PARTITIONS": "4",
+                    "PARTITION_ROUTE_SETS": "2",
+                    "PARTITION_ADDRS": "a;b;c;d",
+                }
+            ).cluster_config()

@@ -124,6 +124,17 @@ def inspect_file(path: str, now: int | None) -> dict:
         "shard": f"{header.shard_index}/{header.shard_count}",
         "n_slots": header.n_slots,
         "row_width": header.row_width,
+        # cluster keyspace stamp (FLAG_PARTITION; cluster/): which
+        # partition and route-set range this file's owner served
+        "partition": (
+            {
+                "index": header.partition[0],
+                "range": [header.partition[1], header.partition[2]],
+                "route_sets": header.partition[3],
+            }
+            if header.partition is not None
+            else None
+        ),
         "bytes": os.path.getsize(path),
         "rows": {
             "occupied": int(np.sum(occupied)),
@@ -191,6 +202,13 @@ def _print_text(report: dict) -> None:
         f"dropped(expired={rows['dropped_expired']}, "
         f"window_ended={rows['dropped_window']})"
     )
+    part = report.get("partition")
+    if part:
+        print(
+            f"  cluster partition {part['index']} owning route sets "
+            f"[{part['range'][0]}, {part['range'][1]}) of "
+            f"{part['route_sets']}"
+        )
     print(
         f"  counts  sum={rows['count_sum']} max={rows['count_max']} "
         f"dividers={rows['dividers']} window_span={rows['window_span_s']}s"
