@@ -8,8 +8,8 @@ NATIVE_SRC := native/host_codec.cpp
 NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 
 .PHONY: all compile native proto tests tests_unit tests_artifact \
-        tests_chaos tests_cluster tests_integration tests_mp \
-        tests_with_redis tests_tpu \
+        tests_chaos tests_cluster tests_hotkeys tests_integration \
+        tests_mp tests_with_redis tests_tpu \
         bench profile serve check_config clean docker_image docker_tests
 
 all: compile
@@ -70,6 +70,16 @@ tests_chaos:
 # promotion, and the PARTITIONS=1 byte-identical rollback arm.
 tests_cluster:
 	$(PY) -m pytest tests/test_cluster.py -v -m cluster
+
+# Heavy-hitter sketch tier (ops/sketch.py; `hotkeys` marker): the
+# kernel-vs-SketchOracle differential fuzz (space-saving error bound,
+# bit-exact planes; crank HOTKEY_FUZZ_EXAMPLES for the idle-hardware
+# campaign), drain/debug/journey plumbing, lease pre-seeding, and the
+# HOTKEYS_ENABLED=false byte-identical rollback arm. Runs inside
+# tests_unit too ("not slow" includes it) — this entry point exists for
+# fast iteration on the sketch alone.
+tests_hotkeys: native
+	$(PY) -m pytest tests/ -q -m hotkeys
 
 # Full suite; the in-process fake Redis/Memcache servers play the role the
 # reference's local redis fleet plays (Makefile:91-125).

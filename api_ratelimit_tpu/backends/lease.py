@@ -257,6 +257,7 @@ class LeaseTable:
                 "burned_tokens": scope.counter("burned_tokens"),
                 "settles": scope.counter("settles"),
                 "fallback_hits": scope.counter("fallback_hits"),
+                "hot_preseeded": scope.counter("hot_preseeded"),
             }
             self._g_outstanding = scope.gauge("outstanding")
             self._g_tokens = scope.gauge("outstanding_tokens")
@@ -481,6 +482,26 @@ class LeaseTable:
             self._h_local.record((time.perf_counter() - t0) * 1e3)
         return response
 
+    # -- sketch-driven adaptive sizing (backends/tpu.py drain_hotkeys) --
+
+    def note_hot_fps(self, fps) -> None:
+        """Pre-seed the adaptive size map for sketch-ranked hot keys: their
+        next grant starts at LEASE_MAX instead of climbing there through
+        exhaustion-renewal doublings (each doubling is a device round trip
+        the local decide path then misses). Overshoot stays bounded by the
+        existing grant clamps — plan_grant still shrinks toward headroom
+        past the near-limit ratio and never reserves past the limit — and
+        the mostly-unused-expiry halving still rules a key that cools
+        faster than the next drain re-seeds it. fps: combined 64-bit
+        fingerprints (the _sizes key)."""
+        preseeded = 0
+        with self._lock:
+            for fp in fps:
+                if self._sizes.get(fp, self.min_size) < self.max_size:
+                    self._sizes[fp] = self.max_size
+                    preseeded += 1
+        self._count("hot_preseeded", preseeded)
+
     # -- grant planning/registration (the device path, do_limit_resolved) --
 
     def plan_grant(self, rec, hits_addend: int, now: int) -> PlannedGrant | None:
@@ -510,10 +531,20 @@ class LeaseTable:
                 elif lease.consumed + hits_addend <= lease.granted:
                     return None  # a usable lease raced in since the miss
                 else:
-                    # exhausted before its TTL: demand beat the size — grow
+                    # exhausted before its TTL: demand beat the size — grow.
+                    # max() against the CURRENT size, not a plain assign: a
+                    # hot-key pre-seed (note_hot_fps) that landed while this
+                    # small lease was live must not be clobbered back down
+                    # to granted*2 — exhaustion only ever argues for MORE
+                    # budget (the mostly-unused-expiry halving is the one
+                    # legitimate shrink path)
                     self._sizes[fp] = min(
                         self.max_size,
-                        max(self.min_size, lease.granted * 2),
+                        max(
+                            self._sizes.get(fp, self.min_size),
+                            self.min_size,
+                            lease.granted * 2,
+                        ),
                     )
                     self._count("renews")
                     self._retire_locked((fp, window), lease, expired=False)

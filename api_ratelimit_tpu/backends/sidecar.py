@@ -147,6 +147,12 @@ OP_MAP_GET = 4  # empty -> the owner's current PartitionMap JSON
 OP_MAP_SET = 5  # u32 len | map JSON -> adopt iff newer epoch
 OP_RESHARD_PULL = 6  # u32 lo | u32 hi | u32 route_sets -> rows section
 OP_RESHARD_PUSH = 7  # u32 len | pack_table_bytes section -> merge stats
+# empty -> the owner's heavy-hitter snapshot JSON (ops/sketch.py; the
+# last drained top-K, fingerprints only — frontends hold the key
+# witness). Served whether or not the owner is in a cluster, so the
+# single-owner debug surface and the router's per-partition aggregation
+# (cluster/router.py cluster_snapshot) ride the same verb.
+OP_HOTKEYS_GET = 8
 # header flags (the u16 after op): bit 0 = B3 trace trailer appended,
 # bit 1 = lease-ops trailer appended (before the trace trailer),
 # bit 2 = u32 epoch trailer appended (after the lease trailer, before the
@@ -466,6 +472,7 @@ class SlabSidecarServer:
                         OP_MAP_SET,
                         OP_RESHARD_PULL,
                         OP_RESHARD_PUSH,
+                        OP_HOTKEYS_GET,
                     ):
                         if not self._serve_cluster_op(conn, op):
                             return
@@ -734,7 +741,16 @@ class SlabSidecarServer:
             conn.sendall(self._error("cluster not configured"))
             return True
         try:
-            if op == OP_MAP_GET:
+            if op == OP_HOTKEYS_GET:
+                snap_fn = getattr(self._engine, "hotkeys_snapshot", None)
+                snap = (
+                    snap_fn()
+                    if snap_fn is not None
+                    else {"enabled": False, "k": 0, "lanes": 0,
+                          "drains": 0, "top": []}
+                )
+                out = _json.dumps(snap).encode()
+            elif op == OP_MAP_GET:
                 out = self._cluster.pmap.to_json_bytes()
             elif op == OP_MAP_SET:
                 adopted = self._cluster.adopt_json(body)

@@ -145,8 +145,16 @@ class SlabDeviceEngine:
         dispatch_loop: bool = True,
         gcra_burst_ratio: float = 1.0,
         partition: int = -1,
+        hotkey_lanes: int = 0,
+        hotkey_k: int = 16,
     ):
-        """partition: which cluster partition this owner serves
+        """hotkey_lanes: lanes of the in-kernel heavy-hitter sketch
+        (ops/sketch.py; HOTKEY_LANES). 0 disables — the HOTKEYS_ENABLED=
+        false arm: no sketch array enters the launch pytree, so the traced
+        program is byte-identical to the pre-hotkeys engine. hotkey_k is
+        the top-K size each drain reports (HOTKEY_K).
+
+        partition: which cluster partition this owner serves
         (cluster/; -1 = unpartitioned). Labeling only: the dispatch
         loop's arena-pressure telemetry exports partition-attributable
         names (backends/dispatch.py DispatchStats) so ring pressure on a
@@ -244,6 +252,31 @@ class SlabDeviceEngine:
         self._buckets = tuple(sorted(buckets))
         self._max_bucket = self._buckets[-1]
         self._n_slots = n_slots
+        # heavy-hitter sketch (ops/sketch.py): a few uint32 lanes riding
+        # every launch beside the slab; drained + halved on the stats
+        # cadence (drain_hotkeys), never per launch. Single-device only:
+        # the mesh engine's compacted per-shard launches would need a
+        # per-shard sketch merge that nothing demands yet.
+        self._hotkey_k = max(1, int(hotkey_k))
+        self._sketch = None
+        self._sketch_ways = 0
+        self._hot_fps: frozenset = frozenset()
+        self._last_topk: list[tuple[int, int, int]] = []
+        self._hotkey_drains = 0
+        self._hotkey_listeners: list = []
+        if int(hotkey_lanes) > 0:
+            if self._engine is not None:
+                _log.warning(
+                    "hotkeys sketch is single-device only; disabled on the "
+                    "mesh-sharded engine"
+                )
+            else:
+                from ..ops.sketch import make_sketch, sketch_ways
+
+                self._sketch_ways = sketch_ways(self._ways, hotkey_lanes)
+                self._sketch = jax.device_put(
+                    make_sketch(hotkey_lanes), device
+                )
         # lossy-event counters (the eviction mix / in-batch contention
         # drops — ops/slab.py HEALTH_* layout): per-launch device health
         # vectors are parked un-fetched (reading 16 bytes inline would add
@@ -854,16 +887,8 @@ class SlabDeviceEngine:
             # device_put dispatch saves ~0.1ms of per-launch host overhead
             # (a third of the launch cost at small batches)
             try:
-                self._state, after_dev, health = slab_step_after(
-                    self._state,
-                    packed,
-                    ways=self._ways,
-                    out_dtype=dtype,
-                    use_pallas=use_pallas,
-                    # static: until a non-fixed row appears, compile the
-                    # exact pre-algorithm program (zero added compute on
-                    # the all-fixed arm); the sticky flip recompiles once
-                    multi_algo=self._algos_seen,
+                after_dev, health = self._step_after_locked(
+                    packed, dtype, use_pallas
                 )
                 if use_pallas:
                     self._pallas_proven = True
@@ -882,13 +907,8 @@ class SlabDeviceEngine:
                 # the donated state is still intact for the retry.
                 _log.warning("pallas slab kernel failed; using XLA path: %s", e)
                 self._use_pallas = False
-                self._state, after_dev, health = slab_step_after(
-                    self._state,
-                    packed,
-                    ways=self._ways,
-                    out_dtype=dtype,
-                    use_pallas=False,
-                    multi_algo=self._algos_seen,
+                after_dev, health = self._step_after_locked(
+                    packed, dtype, False
                 )
             self._pending_health.append(health)
             self._decisions_total += n
@@ -897,6 +917,93 @@ class SlabDeviceEngine:
         if self._h_launch is not None:
             self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
         return after_dev, n
+
+    def _step_after_locked(self, packed, dtype, use_pallas: bool):
+        """One slab_step_after launch under the state lock, threading the
+        hotkey sketch through its ping-pong rebind when enabled. With the
+        sketch disabled the call compiles the byte-identical pre-hotkeys
+        program (ops/slab.py's sketch=None gate — same static-gate
+        discipline as multi_algo)."""
+        outs = slab_step_after(
+            self._state,
+            packed,
+            ways=self._ways,
+            out_dtype=dtype,
+            use_pallas=use_pallas,
+            # static: until a non-fixed row appears, compile the exact
+            # pre-algorithm program (zero added compute on the all-fixed
+            # arm); the sticky flip recompiles once
+            multi_algo=self._algos_seen,
+            sketch=self._sketch,
+            sketch_ways=self._sketch_ways,
+        )
+        if self._sketch is not None:
+            self._state, after_dev, health, self._sketch = outs
+        else:
+            self._state, after_dev, health = outs
+        return after_dev, health
+
+    # -- heavy-hitter sketch drain (stats cadence; ops/sketch.py) --
+
+    @property
+    def hotkeys_enabled(self) -> bool:
+        return self._sketch is not None
+
+    @property
+    def hot_fps(self) -> frozenset:
+        """Combined 64-bit fingerprints of the keys the LAST drain ranked
+        hot — the request path's journey-flag probe (a frozenset read, no
+        lock: rebound atomically by drain_hotkeys)."""
+        return self._hot_fps
+
+    def add_hotkey_listener(self, fn) -> None:
+        """fn(top, fps) called after every drain with the fresh top-K
+        [(fp_lo, fp_hi, count)] and its combined-fp frozenset — the
+        adaptive-lease pre-seeding hook (backends/lease.py note_hot_fps)."""
+        self._hotkey_listeners.append(fn)
+
+    def drain_hotkeys(self) -> list[tuple[int, int, int]]:
+        """Pull the sketch planes to the host, rank the top-K, halve the
+        counts and re-upload (ops/sketch.py sketch_decay — the head tracks
+        current traffic, and the halving keeps counts below the kernels'
+        int32-ordering contract). Called on the stats-flush cadence by
+        HotkeyStats, never per launch: the D2H+H2D pair under the state
+        lock costs what a health_snapshot's live_slots reduction does."""
+        if self._sketch is None:
+            return []
+        from ..ops.sketch import sketch_decay, sketch_topk
+
+        with self._state_lock:
+            planes = np.asarray(self._sketch).copy()
+            top = sketch_topk(planes, self._hotkey_k)
+            self._sketch = jax.device_put(
+                jnp.asarray(sketch_decay(planes)), self._device
+            )
+        self._last_topk = top
+        self._hot_fps = frozenset(
+            (hi << 32) | lo for lo, hi, _cnt in top
+        )
+        self._hotkey_drains += 1
+        for fn in self._hotkey_listeners:
+            try:
+                fn(top, self._hot_fps)
+            except Exception:  # noqa: BLE001 - listeners must not break stats
+                _log.exception("hotkey listener failed")
+        return top
+
+    def hotkeys_snapshot(self) -> dict:
+        """The last drained top-K as a debug document — /debug/hotkeys
+        without key resolution (the cache layer adds witness keys)."""
+        return {
+            "enabled": self._sketch is not None,
+            "k": self._hotkey_k,
+            "lanes": 0 if self._sketch is None else int(self._sketch.shape[1]),
+            "drains": self._hotkey_drains,
+            "top": [
+                {"fp": f"{(hi << 32) | lo:016x}", "count": cnt}
+                for lo, hi, cnt in self._last_topk
+            ],
+        }
 
     def _launch_ready(self, tokens) -> bool:
         """Non-blocking readiness probe for a launch token (the dispatch
@@ -1159,6 +1266,39 @@ class SlabHealthStats:
         self._gauges["watermark"].set(snap.get("watermark", 0))
 
 
+class HotkeyStats:
+    """StatGenerator draining the heavy-hitter sketch on every stats flush
+    (SlabDeviceEngine.drain_hotkeys — this generator IS the drain cadence):
+
+        ratelimit.hotkeys.tracked    occupied top-K entries the last drain
+                                     reported (<= HOTKEY_K)
+        ratelimit.hotkeys.top_count  the hottest key's space-saving
+                                     estimate at drain time — the sketch
+                                     decays by half each drain, so this
+                                     tracks the CURRENT traffic mix
+        ratelimit.hotkeys.drains     cumulative drains (liveness: flat
+                                     while traffic flows means the stats
+                                     loop stalled, not the traffic)
+
+    The ranked entries themselves ship via GET /debug/hotkeys (gauges
+    cannot carry a keyed list); this exports the alarmable envelope."""
+
+    def __init__(self, engine, scope):
+        self._engine = engine
+        self._g_tracked = scope.gauge("tracked")
+        self._g_top = scope.gauge("top_count")
+        self._c_drains = scope.counter("drains")
+        self._drains_seen = 0
+
+    def generate_stats(self) -> None:
+        top = self._engine.drain_hotkeys()
+        self._g_tracked.set(len(top))
+        self._g_top.set(top[0][2] if top else 0)
+        drains = self._engine._hotkey_drains
+        self._c_drains.add(drains - self._drains_seen)
+        self._drains_seen = drains
+
+
 class TpuRateLimitCache:
     """limiter.RateLimitCache implementation backed by the TPU slab."""
 
@@ -1183,6 +1323,8 @@ class TpuRateLimitCache:
         dispatch_loop: bool = True,
         lease_table=None,
         gcra_burst_ratio: float = 1.0,
+        hotkey_lanes: int = 0,
+        hotkey_k: int = 16,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -1237,6 +1379,8 @@ class TpuRateLimitCache:
                 precompile=precompile,
                 dispatch_loop=dispatch_loop,
                 gcra_burst_ratio=gcra_burst_ratio,
+                hotkey_lanes=hotkey_lanes,
+                hotkey_k=hotkey_k,
             )
         self._engine_core = engine
         # per-algorithm decision stats (ratelimit.algo.<name>.{decisions,
@@ -1297,6 +1441,37 @@ class TpuRateLimitCache:
         # do_limit path only — resolved records carry their fingerprint.)
         self._fp_cache: dict = {}
         self._fp_cache_max = 1 << 17
+        # hotkeys witness cache: combined fp -> descriptor key prefix,
+        # recorded at compose time so a drained fingerprint resolves back
+        # to the human key in /debug/hotkeys. Bounded clear-on-full like
+        # _fp_cache; None when the engine runs without a sketch (zero
+        # hot-path cost on the HOTKEYS_ENABLED=false arm).
+        self._witness: dict | None = (
+            {} if getattr(engine, "hotkeys_enabled", False) else None
+        )
+        self._witness_max = 1 << 15
+        # sketch-driven adaptive lease sizing: each drain pre-seeds the
+        # lease table's size map for the ranked-hot keys, so a hot key's
+        # FIRST grant of a window is already LEASE_MAX-bounded large
+        # instead of climbing there through exhaustion-renewal doublings
+        if self._witness is not None and self._lease is not None:
+            engine.add_hotkey_listener(
+                lambda _top, fps: self._lease.note_hot_fps(fps)
+            )
+
+    def hotkeys_debug(self) -> dict:
+        """The /debug/hotkeys document: the engine's last drained top-K
+        with each fingerprint resolved to its descriptor key where the
+        witness cache saw one composed."""
+        snap_fn = getattr(self._engine_core, "hotkeys_snapshot", None)
+        if snap_fn is None:
+            return {"enabled": False, "top": []}
+        doc = snap_fn()
+        witness = self._witness
+        if witness is not None:
+            for entry in doc["top"]:
+                entry["key"] = witness.get(int(entry["fp"], 16))
+        return doc
 
     @property
     def engine(self):
@@ -1464,11 +1639,27 @@ class TpuRateLimitCache:
         over_local: list[bool] | None = None
         lease = self._lease
         grants: list | None = None
+        # hotkeys witness + journey flag (both None/empty on the disabled
+        # arm — the probe below compiles out to two dict/set no-ops)
+        witness = self._witness
+        hot_fps = (
+            self._engine_core.hot_fps if witness is not None else None
+        )
         for i in range(n):
             rec = resolved[i]
             if rec is None:
                 continue
             rec.stats.total_hits.add(hits_addend)
+            if witness is not None:
+                wfp = (rec.fp_hi << 32) | rec.fp_lo
+                if wfp not in witness:
+                    if len(witness) >= self._witness_max:
+                        witness.clear()
+                    witness[wfp] = rec.key_prefix
+                if hot_fps and wfp in hot_fps:
+                    # flight-recorder breadcrumb: this request touched a
+                    # sketch-ranked hot key (tail-samples "slow AND hot")
+                    journeys.note_flag(journeys.FLAG_HOTKEY)
             divider = rec.divider
             if local_cache is not None:
                 key = rec.key_prefix + str((now // divider) * divider)

@@ -343,10 +343,16 @@ class PartitionedEngineClient:
         return "; ".join(reasons) or None
 
     def cluster_snapshot(self) -> dict:
-        """The /debug/cluster body for this frontend: the adopted map
-        plus each partition's live transport state."""
+        """The /debug/cluster body for this frontend: the adopted map,
+        each partition's live transport state, and — when the owners run
+        the heavy-hitter sketch — each partition's last drained top-K
+        plus a count-merged cluster-wide head. Keys route to exactly one
+        partition, so merging the per-owner lists by count is exact (no
+        fingerprint appears under two owners)."""
         pmap = self.pmap
         parts = []
+        hot_merged: list[dict] = []
+        hot_k = 0
         for p in pmap.partitions:
             client = self._clients.get(tuple(p.addrs))
             entry = {
@@ -354,6 +360,7 @@ class PartitionedEngineClient:
                 "range": [p.lo, p.hi],
                 "addrs": list(p.addrs),
             }
+            active = None
             if client is not None:
                 active = getattr(client, "active_address", None)
                 if active is not None:
@@ -361,13 +368,34 @@ class PartitionedEngineClient:
                 breaker = getattr(client, "breaker", None)
                 if breaker is not None:
                     entry["breaker_state"] = breaker.state
+            try:
+                import json as _json
+
+                from ..backends.sidecar import OP_HOTKEYS_GET, cluster_rpc
+
+                snap = _json.loads(
+                    cluster_rpc(
+                        active or p.addrs[0], OP_HOTKEYS_GET, timeout=2.0
+                    )
+                )
+                entry["hotkeys"] = snap
+                if snap.get("enabled"):
+                    hot_k = max(hot_k, int(snap.get("k", 0)))
+                    for item in snap.get("top", ()):
+                        hot_merged.append(dict(item, partition=p.index))
+            except Exception as e:  # noqa: BLE001 - debug body best effort
+                entry["hotkeys"] = {"error": str(e)}
             parts.append(entry)
-        return {
+        out = {
             "role": "router",
             "map_epoch": pmap.epoch,
             "route_sets": pmap.route_sets,
             "partitions": parts,
         }
+        if hot_merged:
+            hot_merged.sort(key=lambda x: -int(x.get("count", 0)))
+            out["hotkeys"] = hot_merged[: hot_k or len(hot_merged)]
+        return out
 
 
 def new_partitioned_cache_from_settings(

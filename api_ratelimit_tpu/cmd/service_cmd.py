@@ -18,8 +18,11 @@ With BACKEND_TYPE=tpu the master spawns the device owner itself
 (cmd/sidecar_cmd.py inherits the TPU_* knobs) and rewrites the workers to
 BACKEND_TYPE=tpu-sidecar pointed at SIDECAR_SOCKET; with
 BACKEND_TYPE=tpu-sidecar an external owner is already running and only
-the workers spawn. Worker debug ports are offset by worker index (debug
-scrapes must not SO_REUSEPORT-split across processes); dead workers are
+the workers spawn. Debug ports: the master keeps DEBUG_PORT and serves
+the fleet metrics aggregator there (GET /metrics?fleet=1 merges every
+member's exposition via stats/fleet.py); worker i listens on
+DEBUG_PORT+1+i and the in-house owner on DEBUG_PORT+1+N (debug scrapes
+must not SO_REUSEPORT-split across processes). Dead workers are
 restarted with a 1 s backoff; SIGTERM/SIGINT tears the fleet down
 workers-first so the owner drains last. FRONTEND_PROCS=1 (the default)
 is the byte-identical single-process legacy boot.
@@ -78,6 +81,70 @@ def _wait_for_unix_socket(path: str, proc, timeout: float = 180.0) -> None:
         time.sleep(0.1)
 
 
+def _serve_fleet_aggregator(settings: Settings, member_ports: list[int]):
+    """Mount the master's debug listener: GET /metrics?fleet=1 scrapes
+    every fleet member's /metrics and serves one merged exposition; a
+    plain GET /metrics answers with the member port map (the master has
+    no stats store of its own). Returns the HttpServer, or None when the
+    port could not bind (the fleet must still serve traffic)."""
+    import json as _json
+    import urllib.parse as _urlparse
+
+    from ..server.http_server import HttpServer
+    from ..stats import fleet as fleet_mod
+
+    try:
+        server = HttpServer("", settings.debug_port, "fleet")
+    except OSError as e:
+        logger.error(
+            "fleet aggregator cannot bind debug port %d: %s "
+            "(per-member /metrics remain on ports %s)",
+            settings.debug_port,
+            e,
+            member_ports,
+        )
+        return None
+
+    def handle_metrics(h) -> None:
+        query = _urlparse.parse_qs(_urlparse.urlparse(h.path).query)
+        if query.get("fleet", ["0"])[0] not in ("1", "true"):
+            body = _json.dumps(
+                {
+                    "fleet": True,
+                    "member_debug_ports": member_ports,
+                    "hint": "GET /metrics?fleet=1 for the merged "
+                    "fleet-wide exposition",
+                },
+                indent=2,
+            ).encode()
+            h._write(200, body, content_type="application/json")
+            return
+        merged, errors = fleet_mod.fleet_metrics(member_ports)
+        for port, reason in errors:
+            logger.warning(
+                "fleet scrape: member on port %d did not answer: %s",
+                port,
+                reason,
+            )
+        h._write(200, merged.encode(), content_type=fleet_mod.CONTENT_TYPE)
+
+    def handle_index(h) -> None:
+        lines = ["fleet master endpoints:"] + [
+            f"  {e}" for e in server.endpoints()
+        ]
+        h._write(200, ("\n".join(lines) + "\n").encode())
+
+    server.add_get("/metrics", handle_metrics)
+    server.add_get("/", handle_index)
+    server.serve_background()
+    logger.warning(
+        "fleet metrics aggregator on debug port %d (members: %s)",
+        settings.debug_port,
+        member_ports,
+    )
+    return server
+
+
 def run_frontend_fleet(settings: Settings, n: int) -> None:
     """Master process: spawn (owner +) N workers, supervise, tear down."""
     setup_logging(settings)
@@ -85,10 +152,19 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
 
     worker_env = dict(os.environ)
     worker_env["FRONTEND_PROCS"] = "1"
+    # debug-port layout: the MASTER keeps DEBUG_PORT for the fleet
+    # aggregator below, worker i gets DEBUG_PORT+1+i, the in-house owner
+    # DEBUG_PORT+1+N — every process a distinct port, because the debug
+    # listeners bind SO_REUSEPORT and same-port scrapes would split
+    # randomly across processes (an owner sharing worker 0's port was
+    # exactly that bug)
+    owner_debug_port = settings.debug_port + 1 + n
     owner = None
+    owner_env = None
     if settings.backend_type == "tpu":
         owner_env = dict(os.environ)
         owner_env["FRONTEND_PROCS"] = "1"
+        owner_env["DEBUG_PORT"] = str(owner_debug_port)
         owner = subprocess.Popen(
             [sys.executable, "-m", "api_ratelimit_tpu.cmd.sidecar_cmd"],
             env=owner_env,
@@ -109,7 +185,7 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
         env = dict(worker_env)
         # gRPC/HTTP serve through SO_REUSEPORT on the SHARED ports; the
         # debug listener must stay per-process or scrapes would split
-        env["DEBUG_PORT"] = str(settings.debug_port + i)
+        env["DEBUG_PORT"] = str(settings.debug_port + 1 + i)
         proc = subprocess.Popen(
             [sys.executable, "-m", "api_ratelimit_tpu.cmd.service_cmd"],
             env=env,
@@ -124,6 +200,17 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
         return proc
 
     workers = [spawn_worker(i) for i in range(n)]
+
+    # fleet metrics aggregator (stats/fleet.py): the master serves the
+    # debug port the fleet took away from individual processes. One
+    # Prometheus scrape entry hits GET /metrics?fleet=1 here and gets the
+    # whole fleet as one exposition — counters summed, histogram buckets
+    # merged, high-water-mark gauges maxed — instead of N+1 scrape
+    # targets or (worse) SO_REUSEPORT roulette.
+    member_ports = [settings.debug_port + 1 + i for i in range(n)]
+    if owner is not None:
+        member_ports.append(owner_debug_port)
+    aggregator = _serve_fleet_aggregator(settings, member_ports)
 
     def on_signal(signum, frame):
         logger.warning("got signal %s, tearing down the fleet", signum)
@@ -157,10 +244,12 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
                 time.sleep(1.0)
                 owner = subprocess.Popen(
                     [sys.executable, "-m", "api_ratelimit_tpu.cmd.sidecar_cmd"],
-                    env={**os.environ, "FRONTEND_PROCS": "1"},
+                    env=owner_env,
                 )
             stop.wait(0.5)
     finally:
+        if aggregator is not None:
+            aggregator.shutdown()
         # workers first (they drain their in-flight requests against a
         # live owner), owner last
         for proc in workers:

@@ -190,6 +190,7 @@ def main(argv=None) -> None:
     )
     settings.warn_deprecated_knobs(logger)
 
+    hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
     engine = SlabDeviceEngine(
         time_source=RealTimeSource(),
         near_limit_ratio=settings.near_limit_ratio,
@@ -222,6 +223,11 @@ def main(argv=None) -> None:
         # (DispatchStats): ring pressure on a K-partition host traces to
         # the keyspace slice generating it
         partition=-1 if partition_index is None else partition_index,
+        # in-kernel heavy-hitter sketch (ops/sketch.py): the device owner
+        # sees the coalesced traffic of every frontend, so the hot-key
+        # head measured here is the authoritative one
+        hotkey_lanes=hk_lanes if hk_enabled else 0,
+        hotkey_k=hk_k,
         **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
     cluster_node = None
@@ -243,6 +249,14 @@ def main(argv=None) -> None:
             cluster_route_sets,
         )
     store.add_stat_generator(SlabHealthStats(engine, scope.scope("slab")))
+    if engine.hotkeys_enabled:
+        from ..backends.tpu import HotkeyStats
+
+        # the stats flush cadence IS the sketch drain cadence (see
+        # HotkeyStats): gauges + the ranked head for /debug/hotkeys
+        store.add_stat_generator(
+            HotkeyStats(engine, scope.scope("hotkeys"))
+        )
     # Lease liability gauges (backends/lease.py): frontends with
     # LEASE_ENABLED ship grant/settle trailers on their SUBMIT frames; the
     # device owner tracks the outstanding budget here — the Σ budgets term
@@ -344,6 +358,20 @@ def main(argv=None) -> None:
             )
 
         debug.add_get("/debug/cluster", handle_cluster)
+    if engine.hotkeys_enabled:
+        import json as _hk_json
+
+        def handle_hotkeys(h) -> None:
+            # no compose-time witness in the device owner (keys live in
+            # the frontends), so entries carry fingerprints only — the
+            # frontend /debug/hotkeys resolves them to descriptor keys
+            h._write(
+                200,
+                _hk_json.dumps(engine.hotkeys_snapshot(), indent=2).encode(),
+                content_type="application/json",
+            )
+
+        debug.add_get("/debug/hotkeys", handle_hotkeys)
     debug.serve_background()
     store.start_flushing()
     # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host

@@ -1,0 +1,318 @@
+"""In-kernel heavy-hitter sketch: device-side space-saving top-K beside the slab.
+
+The slab answers "how fast are we deciding"; this answers "what are we
+deciding about". A few extra uint32 lanes ride next to the row table and
+are updated per launch with the SAME bounded W-wide scan shape the
+eviction path already pays for (PAPERS "Limited Associativity Caching in
+the Data Plane" — detect the hot head where the traffic flows). Each
+stats cadence the engine drains the planes to the host, publishes the
+top-K (`ratelimit.hotkeys.*`, GET /debug/hotkeys), and halves the counts
+so the head tracks the CURRENT traffic mix instead of all history.
+
+Layout — `uint32[SKETCH_PLANES, lanes]`, three parallel planes viewed as
+`[n_sets, ways]` with ways = min(SLAB_WAYS, lanes) (one lane register per
+set on TPU, a cache-line-scale set on hosts — the slab's own geometry
+argument, ops/slab.py default_ways):
+
+    plane 0: fp_lo   64-bit key fingerprint, low half
+    plane 1: fp_hi   high half
+    plane 2: count   space-saving estimate (occupied iff > 0)
+
+A key lives only in set `fp_lo mod n_sets`. Per launch the update sees
+one CANDIDATE per distinct key in the batch — the sorted segment ends the
+slab step already delineates — weighted by the segment's total hits (raw
+requested traffic: denied hits still heat a key; heat is what the wire
+carries, not what the limiter admits). Two phases, in this order:
+
+  A. matched candidates scatter-add their weight into their lane;
+  B. per sketch set, ONE unmatched candidate per launch wins the insert —
+     ranked lexicographically by (weight, fp_hi, fp_lo), a content-based
+     order the host oracle can mirror without knowing the device sort —
+     and replaces the argmin-count way of its set with
+     count = victim_count + weight (the space-saving inheritance:
+     the estimate OVERCOUNTS by at most the inherited amount, never
+     undercounts a resident key's hits since insertion).
+
+Losing unmatched candidates simply retry next launch (their weight is
+dropped, so the sketch can UNDERCOUNT the raw stream for keys that keep
+losing — the bounded-insert price of a one-scatter update; the
+differential fuzz suite tracks both error directions). The winner rank
+is unique by construction: candidates are distinct fingerprints, so the
+(weight, fp_hi, fp_lo) triple never ties — the winner scatter keeps the
+slab's unique_indices discipline.
+
+The per-item scan arithmetic (match way, victim argmin) has the exact
+_way_scan_kernel shape and runs as a Mosaic kernel on the ways == 128
+geometry (the Pallas arm); the set gathers and the phase A/B scatters
+stay XLA in both arms — the same division of labor as the slab step
+(native dynamic gather/scatter beats kernel emulation;
+ops/pallas_slab.py module docstring). Counts stay below 2^31 by the
+drain-halving cadence, so the kernels' int32 views order identically to
+uint32 — the same contract the slab kernels document.
+
+Everything here is deterministic and bit-exactly mirrored by the numpy
+SketchOracle (testing/oracle.py); tests/test_hotkeys_fuzz.py holds the
+XLA twin, the Pallas interpret arm, and the oracle to one state.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SKETCH_PLANES = 3
+PLANE_FP_LO, PLANE_FP_HI, PLANE_COUNT = range(3)
+
+# lanes default: one full TPU lane register of head keys — enough for a
+# top-16 report with 8x slack for churn, and exactly one sketch set on the
+# default TPU geometry (ways == 128)
+DEFAULT_LANES = 128
+
+
+def validate_lanes(lanes: int) -> int:
+    lanes = int(lanes)
+    if lanes <= 0 or lanes & (lanes - 1):
+        raise ValueError(
+            f"hotkey lanes must be a positive power of two, got {lanes}"
+        )
+    return lanes
+
+
+def sketch_ways(slab_ways: int, lanes: int) -> int:
+    """Sketch set associativity: the slab's own W where it fits, else the
+    whole sketch is one set (tiny-lanes case — fully associative, the
+    classic space-saving shape)."""
+    return min(int(slab_ways), validate_lanes(lanes))
+
+
+def make_sketch(lanes: int, device=None) -> jnp.ndarray:
+    planes = jnp.zeros((SKETCH_PLANES, validate_lanes(lanes)), dtype=jnp.uint32)
+    if device is not None:
+        planes = jax.device_put(planes, device)
+    return planes
+
+
+def _sketch_scan(rows_lo, rows_hi, rows_cnt, q_lo, q_hi):
+    """The XLA twin of the Mosaic sketch scan: per candidate, over its
+    gathered set planes — (int32[b] match way, bool[b] match any,
+    int32[b] victim way = argmin count with first-way tiebreak, uint32[b]
+    victim count). int32 count view: the drain-halving cadence keeps
+    counts below 2^31 (module docstring), so the orderings agree."""
+    cnt = rows_cnt.astype(jnp.int32)
+    occupied = cnt > 0
+    match = occupied & (rows_lo == q_lo[:, None]) & (rows_hi == q_hi[:, None])
+    match_any = match.any(axis=1)
+    match_way = jnp.argmax(match, axis=1).astype(jnp.int32)
+    vic_way = jnp.argmin(cnt, axis=1).astype(jnp.int32)
+    vic_cnt = jnp.take_along_axis(rows_cnt, vic_way[:, None], axis=1)[:, 0]
+    return match_way, match_any, vic_way, vic_cnt
+
+
+def _sketch_scan_kernel(q_lo_ref, q_hi_ref, lo_ref, hi_ref, cnt_ref, out_ref):
+    """Mosaic sketch scan — the _way_scan_kernel shape on the sketch
+    planes: a candidate's set per sublane row, match/argmin as single
+    cross-lane reductions. One output tile, results packed into lanes
+    0-3 (caller slices; a (b, 4) output would fight the lane tiling)."""
+    lanes = jax.lax.broadcasted_iota(jnp.int32, cnt_ref.shape, 1)
+    w = cnt_ref.shape[1]
+    cnt = cnt_ref[...]
+    occupied = cnt > 0
+    match = occupied & (lo_ref[...] == q_lo_ref[...]) & (hi_ref[...] == q_hi_ref[...])
+
+    m_any = jnp.max(match.astype(jnp.int32), axis=1, keepdims=True)
+    m_way = jnp.min(
+        jnp.where(match, lanes, jnp.int32(w)), axis=1, keepdims=True
+    )
+    # argmin via min + first-lane-at-min — ties resolve to the lowest way,
+    # matching jnp.argmin in the XLA twin
+    min_cnt = jnp.min(cnt, axis=1, keepdims=True)
+    v_way = jnp.min(
+        jnp.where(cnt == min_cnt, lanes, jnp.int32(w)), axis=1, keepdims=True
+    )
+    out_ref[...] = jnp.where(
+        lanes == 0,
+        jnp.where(m_any > 0, m_way, jnp.int32(0)),
+        jnp.where(
+            lanes == 1,
+            m_any,
+            jnp.where(lanes == 2, v_way, min_cnt),
+        ),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sketch_scan(rows_lo, rows_hi, rows_cnt, q_lo, q_hi, interpret=False):
+    """Run the sketch set scan as a Mosaic kernel; bit-identical to
+    _sketch_scan (pinned by tests/test_hotkeys_fuzz.py in interpret
+    mode). Requires ways == 128: a set per sublane row is the shape."""
+    from jax.experimental import pallas as pl
+
+    from .pallas_slab import BLOCK_ROWS, LANES
+
+    b, w = rows_lo.shape
+    if w != LANES:
+        raise ValueError(f"pallas sketch scan needs ways == {LANES}, got {w}")
+    block_rows = math.gcd(b, BLOCK_ROWS)
+
+    as_i32 = lambda x: x.astype(jnp.int32)
+    q_lo_b = jnp.broadcast_to(as_i32(q_lo)[:, None], (b, w))
+    q_hi_b = jnp.broadcast_to(as_i32(q_hi)[:, None], (b, w))
+
+    block = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+    (out,) = pl.pallas_call(
+        _sketch_scan_kernel,
+        grid=(b // block_rows,),
+        in_specs=[block] * 5,
+        out_specs=[block],
+        out_shape=[jax.ShapeDtypeStruct((b, w), jnp.int32)],
+        interpret=interpret,
+    )(q_lo_b, q_hi_b, as_i32(rows_lo), as_i32(rows_hi), as_i32(rows_cnt))
+    return (
+        out[:, 0],
+        out[:, 1] > 0,
+        out[:, 2],
+        out[:, 3].astype(jnp.uint32),
+    )
+
+
+def sketch_update(
+    sketch: jnp.ndarray,  # uint32[SKETCH_PLANES, lanes]
+    fp_lo: jnp.ndarray,  # uint32[b] sorted batch fingerprints
+    fp_hi: jnp.ndarray,
+    weight: jnp.ndarray,  # uint32[b] segment-total hits (valid at cand rows)
+    cand: jnp.ndarray,  # bool[b] one True per distinct key (segment end)
+    ways: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One launch's sketch update (module docstring). Traced inside the
+    slab step's jit, so the gather/scan/scatter chain fuses with the
+    launch program — the sketch never costs an extra device round trip."""
+    lanes = sketch.shape[1]
+    n_sets = lanes // ways
+    u0 = jnp.uint32(0)
+    set_idx = (fp_lo & jnp.uint32(n_sets - 1)).astype(jnp.int32)
+    sets = sketch.reshape(SKETCH_PLANES, n_sets, ways)
+    rows_lo = sets[PLANE_FP_LO][set_idx]
+    rows_hi = sets[PLANE_FP_HI][set_idx]
+    rows_cnt = sets[PLANE_COUNT][set_idx]
+
+    if use_pallas and ways == 128:
+        m_way, m_any, v_way, v_cnt = pallas_sketch_scan(
+            rows_lo, rows_hi, rows_cnt, fp_lo, fp_hi, interpret=interpret
+        )
+    else:
+        m_way, m_any, v_way, v_cnt = _sketch_scan(
+            rows_lo, rows_hi, rows_cnt, fp_lo, fp_hi
+        )
+
+    drop = jnp.int32(lanes)  # out-of-bounds scatter sentinel (mode="drop")
+
+    # --- phase A: matched candidates accumulate in place. The lanes are
+    # unique by construction: a fingerprint occupies at most one lane of
+    # its set (phase B never inserts a fp that matched, and set_idx is a
+    # pure function of fp_lo), and candidates are distinct keys — so two
+    # candidates can never match the same lane. unique_indices lets XLA
+    # compile the add as gather+select instead of a serialized scatter. ---
+    matched = m_any & cand
+    add_lane = jnp.where(matched, set_idx * jnp.int32(ways) + m_way, drop)
+    cnt_plane = sketch[PLANE_COUNT].at[add_lane].add(
+        jnp.where(matched, weight, u0), mode="drop", unique_indices=True
+    )
+
+    # --- phase B: one winner per set among unmatched candidates, ranked
+    # lexicographically by (weight, fp_hi, fp_lo) via three masked
+    # segment-max rounds — content-based so the host oracle needs no sort
+    # knowledge, and unique because candidate fingerprints are distinct.
+    # DENSE (b, n_sets) reductions, not scatter-max: n_sets is tiny
+    # (lanes/ways; 1 on the default TPU geometry) and a non-unique
+    # scatter-max lowers to a serialized loop over the batch — measured
+    # at ~80% of the whole step on the CPU twin before this. ---
+    unmatched = cand & ~m_any
+    onehot = set_idx[:, None] == jnp.arange(n_sets, dtype=jnp.int32)[None, :]
+
+    def seg_max(mask: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+        # max over {vals[i] : mask[i] and set_idx[i] == s} ∪ {0}, per set —
+        # exactly the zeros.at[sel].max(vals, mode="drop") semantics
+        return jnp.where(mask[:, None] & onehot, vals[:, None], u0).max(axis=0)
+
+    w_max = seg_max(unmatched, weight)
+    w_ok = unmatched & (weight == w_max[set_idx])
+    h_max = seg_max(w_ok, fp_hi)
+    h_ok = w_ok & (fp_hi == h_max[set_idx])
+    l_max = seg_max(h_ok, fp_lo)
+
+    # The write itself is per-SET, not per-candidate: every candidate of a
+    # set gathered the same rows, so the victim way (argmin count, lowest
+    # way on ties — the scan's v_way for each of them) is a set property
+    # computable straight from the planes, and the winner's content IS the
+    # segment maxima above. lanes-sized selects replace three scatters.
+    # The winner inherits the displaced count — the space-saving bound.
+    set_cnt_i32 = sets[PLANE_COUNT].astype(jnp.int32)  # (n_sets, ways)
+    vic_way = jnp.argmin(set_cnt_i32, axis=1).astype(jnp.int32)
+    vic_cnt = jnp.take_along_axis(
+        sets[PLANE_COUNT], vic_way[:, None], axis=1
+    )[:, 0]
+    win_exists = w_max > u0  # candidate weights are >= 1 (hits > 0)
+    way_iota = jnp.arange(ways, dtype=jnp.int32)[None, :]
+    win_mask = (
+        (way_iota == vic_way[:, None]) & win_exists[:, None]
+    ).reshape(lanes)
+    lo_plane = jnp.where(
+        win_mask, jnp.repeat(l_max, ways), sketch[PLANE_FP_LO]
+    )
+    hi_plane = jnp.where(
+        win_mask, jnp.repeat(h_max, ways), sketch[PLANE_FP_HI]
+    )
+    cnt_plane = jnp.where(
+        win_mask, jnp.repeat(vic_cnt + w_max, ways), cnt_plane
+    )
+    return jnp.stack([lo_plane, hi_plane, cnt_plane])
+
+
+# --- host-side drain helpers -------------------------------------------------
+#
+# The engine pulls the planes on the stats cadence (never per launch), and
+# these run on the numpy copy. sketch_decay is the SAME function the
+# SketchOracle semantics specify, so kernel-vs-oracle state stays bit-exact
+# across drains.
+
+
+def sketch_topk(planes: np.ndarray, k: int):
+    """Top-k occupied entries of a drained plane copy, hottest first:
+    [(fp_lo, fp_hi, count)] ordered by (count, fp_hi, fp_lo) descending —
+    the same content-based rank the insert path uses, so the report is
+    deterministic under equal counts."""
+    planes = np.asarray(planes)
+    cnt = planes[PLANE_COUNT]
+    occ = np.flatnonzero(cnt > 0)
+    if occ.size == 0 or k <= 0:
+        return []
+    order = occ[
+        np.lexsort(
+            (planes[PLANE_FP_LO][occ], planes[PLANE_FP_HI][occ], cnt[occ])
+        )[::-1]
+    ][:k]
+    return [
+        (int(planes[PLANE_FP_LO][i]), int(planes[PLANE_FP_HI][i]), int(cnt[i]))
+        for i in order
+    ]
+
+
+def sketch_decay(planes: np.ndarray) -> np.ndarray:
+    """Post-drain decay, in place on the host copy: halve every count so
+    the head tracks current traffic (two cadences of silence fade any
+    entry below a steady key), and clear the fingerprints of entries that
+    decayed to zero — an unoccupied lane must not carry a stale tag into
+    the next drain's witness resolution."""
+    planes = np.asarray(planes)
+    cnt = planes[PLANE_COUNT]
+    cnt >>= 1
+    dead = cnt == 0
+    planes[PLANE_FP_LO][dead] = 0
+    planes[PLANE_FP_HI][dead] = 0
+    return planes

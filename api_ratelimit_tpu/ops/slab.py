@@ -472,6 +472,8 @@ def _slab_update_sorted(
     interpret: bool = False,
     burst_ratio: jnp.ndarray | None = None,  # float32 scalar, GCRA tau knob
     multi_algo: bool = True,  # static: compile the sibling-algorithm arms
+    sketch: jnp.ndarray | None = None,  # hotkeys planes (None = gate off)
+    sketch_ways: int = 0,  # static: sketch set associativity
 ):
     """The stateful core: set scan, serialize duplicates, window-reset,
     increment, one row-scatter. Returns sorted before/after counters, the
@@ -653,6 +655,8 @@ def _slab_update_sorted(
                 s_before, s_after, count_store, window_store,
                 expire_store, div_store, prev_store, aux_store,
                 algo_reset, count_health, decision,
+                sketch=sketch, sketch_ways=sketch_ways,
+                sketch_pallas=use_pallas, sketch_interpret=interpret,
             )
 
         st_algo = (st_rows[:, COL_DIVIDER].astype(jnp.int32) >> ALGO_SHIFT) & 7
@@ -880,6 +884,8 @@ def _slab_update_sorted(
         s_before, s_after, count_store, window_store, expire_store,
         div_store, prev_store, aux_store, algo_reset,
         count_health, decision,
+        sketch=sketch, sketch_ways=sketch_ways,
+        sketch_pallas=use_pallas, sketch_interpret=interpret,
     )
 
 
@@ -889,11 +895,21 @@ def _finish_update(
     s_before, s_after, count_store, window_store, expire_store,
     div_store, prev_store, aux_store, algo_reset,
     count_health, decision,
+    sketch=None, sketch_ways=0, sketch_pallas=False, sketch_interpret=False,
 ):
     """The shared tail of _slab_update_sorted — one row write per slot,
     the health reductions, and the return tuple — factored out so the
     three update bodies (pallas fixed, XLA fixed-only, XLA multi-
-    algorithm) land in one place with their per-branch stores."""
+    algorithm) land in one place with their per-branch stores.
+
+    sketch (static gate via pytree structure: None = off, and the traced
+    program is byte-identical to the pre-sketch engine — the same
+    rollback discipline as multi_algo) threads the heavy-hitter planes
+    (ops/sketch.py) through the launch: one candidate per distinct-key
+    segment, weighted by the segment's total hits, updates the sketch in
+    the same program. When on, the return tuple grows ONE trailing
+    element (the new sketch) — conditional arity keeps every existing
+    destructuring call site untouched."""
     # --- one row write per SLOT: the final item in the slot's run ---
     is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
     s_valid = s_hits > 0
@@ -946,7 +962,7 @@ def _finish_update(
         axis=1,
     )
     table = _scatter_rows(state.table, write_idx, new_rows)
-    return (
+    base = (
         SlabState(table=table),
         s_before,
         s_after,
@@ -955,6 +971,28 @@ def _finish_update(
         health,
         decision,
     )
+    if sketch is None:
+        return base
+
+    from .sketch import sketch_update
+
+    # one candidate per distinct-key segment (padding segments carry
+    # hits 0 at their end row and drop out), weighted by the segment's
+    # TOTAL hits — the same cumsum/cummax forward-fill the serialization
+    # uses, recomputed here so all three update bodies (including the
+    # pallas arm, whose scans live inside its kernel) share one shape
+    seg_start = jnp.concatenate([jnp.array([True]), ~same_prev])
+    seg_last = jnp.concatenate([~same_prev, jnp.array([True])])
+    incl = jnp.cumsum(s_hits, dtype=jnp.uint32)
+    excl = incl - s_hits
+    seg_base_excl = jax.lax.cummax(jnp.where(seg_start, excl, jnp.uint32(0)))
+    weight = incl - seg_base_excl
+    cand = seg_last & (s_hits > 0)
+    new_sketch = sketch_update(
+        sketch, s_fp_lo, s_fp_hi, weight, cand, sketch_ways,
+        use_pallas=sketch_pallas, interpret=sketch_interpret,
+    )
+    return (*base, new_sketch)
 
 
 def _slab_step_sorted(
@@ -969,30 +1007,37 @@ def _slab_step_sorted(
     interpret: bool = False,
     burst_ratio: jnp.ndarray | None = None,
     multi_algo: bool = True,
+    sketch: jnp.ndarray | None = None,
+    sketch_ways: int = 0,
 ):
     """Core step with on-device decision; returns results in slot-sorted
     order plus the permutation (callers unsort on device or on the host)
     and the uint32[HEALTH_WIDTH] health vector. use_pallas=True runs the
     Mosaic way-scan + fused INCRBY+decide kernels (ops/pallas_slab.py)
     for everything between the gathers; False runs the XLA twin with the
-    jnp decide math."""
+    jnp decide math. A non-None sketch appends the updated hotkey planes
+    as one extra trailing element (conditional arity — _finish_update)."""
     now = now.astype(jnp.int32)
-    state, s_before, s_after, (s_hits, s_limit, s_div), order, health, fused = (
-        _slab_update_sorted(
-            state,
-            batch,
-            now,
-            ways,
-            count_health,
-            use_pallas=use_pallas,
-            near_ratio=near_ratio,
-            fuse_decide=use_pallas,
-            lean_decide=lean_decide,
-            interpret=interpret,
-            burst_ratio=burst_ratio,
-            multi_algo=multi_algo,
-        )
+    outs = _slab_update_sorted(
+        state,
+        batch,
+        now,
+        ways,
+        count_health,
+        use_pallas=use_pallas,
+        near_ratio=near_ratio,
+        fuse_decide=use_pallas,
+        lean_decide=lean_decide,
+        interpret=interpret,
+        burst_ratio=burst_ratio,
+        multi_algo=multi_algo,
+        sketch=sketch,
+        sketch_ways=sketch_ways,
     )
+    new_sketch = None
+    if sketch is not None:
+        *outs, new_sketch = outs
+    state, s_before, s_after, (s_hits, s_limit, s_div), order, health, fused = outs
 
     if fused is not None:
         decision = fused
@@ -1006,7 +1051,8 @@ def _slab_step_sorted(
             now=now,
             near_ratio=near_ratio,
         )
-    return state, s_before, s_after, decision, order, health
+    base = (state, s_before, s_after, decision, order, health)
+    return base if sketch is None else (*base, new_sketch)
 
 
 def _slab_step(
@@ -1056,8 +1102,8 @@ PACKED_OUT_ROWS = 9
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "use_pallas", "multi_algo"),
-    donate_argnames=("state",),
+    static_argnames=("ways", "use_pallas", "multi_algo", "sketch_ways"),
+    donate_argnames=("state", "sketch"),
 )
 def slab_step_packed(
     state: SlabState,
@@ -1065,12 +1111,23 @@ def slab_step_packed(
     ways: int = DEFAULT_WAYS,
     use_pallas: bool = False,
     multi_algo: bool = True,
+    sketch: jnp.ndarray | None = None,
+    sketch_ways: int = 0,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
+    # sketch=None is the HOTKEYS_ENABLED=false arm: no sketch leaves enter
+    # the pytree, so the traced program is byte-identical to the
+    # pre-hotkeys engine (same static-gate discipline as multi_algo); a
+    # real sketch array appends the updated planes as a 4th return element
     batch, now, near_ratio, burst_ratio = _unpack(packed)
-    state, s_before, s_after, d, order, health = _slab_step_sorted(
+    outs = _slab_step_sorted(
         state, batch, now, near_ratio, ways, use_pallas,
         burst_ratio=burst_ratio, multi_algo=multi_algo,
+        sketch=sketch, sketch_ways=sketch_ways,
     )
+    new_sketch = None
+    if sketch is not None:
+        *outs, new_sketch = outs
+    state, s_before, s_after, d, order, health = outs
     out = jnp.stack(
         [
             d.code.astype(jnp.uint32),
@@ -1084,7 +1141,8 @@ def slab_step_packed(
             order.astype(jnp.uint32),
         ]
     )
-    return state, out, health
+    base = (state, out, health)
+    return base if sketch is None else (*base, new_sketch)
 
 
 # --- compact transfer modes -------------------------------------------------
@@ -1141,8 +1199,8 @@ def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray, j
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "out_dtype", "use_pallas", "multi_algo"),
-    donate_argnames=("state",),
+    static_argnames=("ways", "out_dtype", "use_pallas", "multi_algo", "sketch_ways"),
+    donate_argnames=("state", "sketch"),
 )
 def slab_step_after(
     state: SlabState,
@@ -1151,25 +1209,36 @@ def slab_step_after(
     out_dtype=jnp.uint32,
     use_pallas: bool = False,
     multi_algo: bool = True,
+    sketch: jnp.ndarray | None = None,
+    sketch_ways: int = 0,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Stateful update only; returns (post-increment counters in arrival
     order, saturating-cast to out_dtype, uint32[HEALTH_WIDTH] health). The
     caller guarantees max(limit) + max(hits) < dtype max. use_pallas runs
-    the Mosaic way-scan + fused INCRBY kernel (no decide outputs)."""
+    the Mosaic way-scan + fused INCRBY kernel (no decide outputs). A
+    non-None sketch (the HOTKEYS_ENABLED arm) appends the updated hotkey
+    planes as a 4th return element; None compiles the byte-identical
+    pre-hotkeys program (slab_step_packed's gate commentary)."""
     batch, now, _, burst_ratio = _unpack(packed)
-    state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
+    outs = _slab_update_sorted(
         state, batch, now, ways, use_pallas=use_pallas,
         burst_ratio=burst_ratio, multi_algo=multi_algo,
+        sketch=sketch, sketch_ways=sketch_ways,
     )
+    new_sketch = None
+    if sketch is not None:
+        *outs, new_sketch = outs
+    state, _before, s_after, _inputs, order, health, _ = outs
     after = _unsort(s_after, order)
     cap = jnp.uint32(jnp.iinfo(out_dtype).max)
-    return state, jnp.minimum(after, cap).astype(out_dtype), health
+    base = (state, jnp.minimum(after, cap).astype(out_dtype), health)
+    return base if sketch is None else (*base, new_sketch)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "use_pallas", "count_health", "multi_algo"),
-    donate_argnames=("state",),
+    static_argnames=("ways", "use_pallas", "count_health", "multi_algo", "sketch_ways"),
+    donate_argnames=("state", "sketch"),
 )
 def slab_step_decided(
     state: SlabState,
@@ -1178,6 +1247,8 @@ def slab_step_decided(
     use_pallas: bool = False,
     count_health: bool = True,
     multi_algo: bool = True,
+    sketch: jnp.ndarray | None = None,
+    sketch_ways: int = 0,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Full on-device decision; only the 1-byte code per item (1=OK,
     2=OVER_LIMIT, arrival order) plus the uint32[HEALTH_WIDTH] health come
@@ -1185,14 +1256,20 @@ def slab_step_decided(
     fire-and-forget callers that drop the vector (the bench). The pallas
     kernel runs lean: only the code tile is computed and written (the XLA
     twin's unused decision fields are dead-code-eliminated by the
-    compiler anyway)."""
+    compiler anyway). A non-None sketch appends the updated hotkey planes
+    as a 4th return element (slab_step_packed's gate commentary)."""
     batch, now, near_ratio, burst_ratio = _unpack(packed)
-    state, _before, _after, d, order, health = _slab_step_sorted(
+    outs = _slab_step_sorted(
         state, batch, now, near_ratio, ways, use_pallas, count_health,
         lean_decide=use_pallas, burst_ratio=burst_ratio,
-        multi_algo=multi_algo,
+        multi_algo=multi_algo, sketch=sketch, sketch_ways=sketch_ways,
     )
-    return state, _unsort(d.code, order).astype(jnp.uint8), health
+    new_sketch = None
+    if sketch is not None:
+        *outs, new_sketch = outs
+    state, _before, _after, d, order, health = outs
+    base = (state, _unsort(d.code, order).astype(jnp.uint8), health)
+    return base if sketch is None else (*base, new_sketch)
 
 
 # --- warm-restart export/import (persist/) ----------------------------------

@@ -117,6 +117,7 @@ def create_limiter(
         ladder = settings.buckets()
         if ladder is not None:
             kwargs["buckets"] = ladder
+        hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
@@ -136,6 +137,8 @@ def create_limiter(
             dispatch_loop=settings.dispatch_loop,
             lease_table=lease_table,
             gcra_burst_ratio=settings.gcra_burst(),
+            hotkey_lanes=hk_lanes if hk_enabled else 0,
+            hotkey_k=hk_k,
             **kwargs,
         )
     if backend == "tpu-sidecar":
@@ -394,6 +397,23 @@ class Runner:
                 LeaseRegistryStats(
                     engine.lease_registry, self.scope.scope("lease")
                 )
+            )
+        # Heavy-hitter telemetry (HOTKEYS_ENABLED; ops/sketch.py): the
+        # HotkeyStats generator IS the sketch drain cadence — each stats
+        # flush pulls the planes, publishes ratelimit.hotkeys.* and the
+        # ranked top-K behind GET /debug/hotkeys (witness-resolved to
+        # descriptor keys by the cache), and halves the counts so the head
+        # tracks current traffic.
+        if engine is not None and getattr(engine, "hotkeys_enabled", False):
+            from .backends.tpu import HotkeyStats
+
+            self.stats_store.add_stat_generator(
+                HotkeyStats(engine, self.scope.scope("hotkeys"))
+            )
+        if hasattr(cache, "hotkeys_debug"):
+            self.server.add_debug_endpoint(
+                "/debug/hotkeys",
+                lambda: json.dumps(cache.hotkeys_debug(), indent=2),
             )
         # Watermark degraded probe: slab pressure/saturation shows up in
         # the /healthcheck body next to the fallback/overload reasons.

@@ -341,6 +341,23 @@ class Settings:
     # FAULT_INJECT=sidecar.submit:error:0.2,sidecar.submit:delay_ms:500
     fault_inject: str = ""
     fault_inject_seed: int = 0
+    # --- in-kernel heavy-hitter telemetry (ops/sketch.py) ---
+    # HOTKEYS_ENABLED: maintain a device-side space-saving top-K sketch
+    # beside the slab (a few uint32 lanes updated per launch with the same
+    # bounded W-wide scan shape as eviction), drained on the stats cadence
+    # into ratelimit.hotkeys.* gauges, GET /debug/hotkeys, the FLAG_HOTKEY
+    # journey flag, and (with LEASE_ENABLED) sketch-driven adaptive lease
+    # pre-seeding. false is the byte-identical rollback arm: no sketch
+    # array enters the launch pytree, so the traced program is exactly the
+    # pre-hotkeys one (pinned by test, same discipline as the multi_algo /
+    # DISPATCH_LOOP gates).
+    hotkeys_enabled: bool = True
+    # HOTKEY_K: how many ranked entries each drain reports
+    hotkey_k: int = 16
+    # HOTKEY_LANES: sketch width (power of two); the set associativity is
+    # min(SLAB_WAYS, lanes). 128 = one TPU lane register of head keys —
+    # top-16 reporting with 8x slack for churn.
+    hotkey_lanes: int = 128
 
     def latency_buckets(self) -> tuple[float, ...] | None:
         """Parsed METRICS_LATENCY_BUCKETS_MS, or None for the default.
@@ -524,6 +541,24 @@ class Settings:
             ttl_fraction,
             near_ratio,
         )
+
+    def hotkey_config(self) -> tuple[bool, int, int]:
+        """Validated (enabled, k, lanes) for the heavy-hitter sketch.
+        Junk fails the boot like every other knob — a typo'd lane count
+        must not silently become 'no hot-key telemetry'."""
+        k = int(self.hotkey_k)
+        lanes = int(self.hotkey_lanes)
+        if k < 1:
+            raise ValueError(f"HOTKEY_K must be >= 1, got {k}")
+        if lanes < 1 or lanes & (lanes - 1):
+            raise ValueError(
+                f"HOTKEY_LANES must be a positive power of two, got {lanes}"
+            )
+        if k > lanes:
+            raise ValueError(
+                f"HOTKEY_K ({k}) must not exceed HOTKEY_LANES ({lanes})"
+            )
+        return bool(self.hotkeys_enabled), k, lanes
 
     def sidecar_addresses(self) -> list[str]:
         """The frontend's device-owner failover list: parsed SIDECAR_ADDRS
@@ -864,6 +899,9 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("gcra_burst_ratio", "GCRA_BURST_RATIO", float),
     ("fault_inject", "FAULT_INJECT", str),
     ("fault_inject_seed", "FAULT_INJECT_SEED", int),
+    ("hotkeys_enabled", "HOTKEYS_ENABLED", _parse_bool),
+    ("hotkey_k", "HOTKEY_K", int),
+    ("hotkey_lanes", "HOTKEY_LANES", int),
 ]
 
 

@@ -1,0 +1,159 @@
+"""Fleet-wide Prometheus exposition merge (FRONTEND_PROCS>1).
+
+A process fleet (cmd/service_cmd.py) serves N frontend workers plus one
+device owner, each with its OWN debug port — SO_REUSEPORT on a shared
+debug port would split scrapes randomly across processes, so the master
+offsets them (worker i at DEBUG_PORT+1+i, owner at DEBUG_PORT+1+N) and
+keeps DEBUG_PORT for itself. One Prometheus scrape config entry should
+still see ONE service: the master's ``GET /metrics?fleet=1`` scrapes
+every member's /metrics and serves the merge this module computes.
+
+Merge semantics, per family type (stats/prometheus.py renders them):
+
+  counter     sum across members — counts of events are additive.
+  gauge       sum across members (queue depths, occupancy, outstanding
+              liability all add), EXCEPT names where summing lies —
+              high-water marks, epochs, 0/1 capability flags, live
+              quantile estimates — which take the max (``GAUGE_MAX``).
+  histogram   per-``le`` bucket sums plus ``_sum``/``_count`` sums:
+              cumulative bucket counts merge exactly.
+  summary     ``_sum``/``_count`` sum; quantile samples take the max —
+              quantiles are NOT mergeable without the underlying
+              samples, and worst-member is the honest conservative
+              bound for an alerting scrape (documented approximation).
+
+The module is deliberately jax-free and socket-only (urllib): the fleet
+master must aggregate without importing the device stack, and
+tools/metrics_lint.py imports it to validate merged output offline.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.request
+
+from .prometheus import CONTENT_TYPE, _fmt  # noqa: F401 - re-exported
+
+# gauge names where a sum across processes is a lie: high-water marks,
+# map epochs, 0/1 capability flags (native codec available, replication
+# connected, hotkeys enabled), and live quantile estimates. Matched
+# against the FULL prometheus sample name.
+GAUGE_MAX = re.compile(
+    r"(_hwm|_high_watermark|_watermark|_epoch|_available|_enabled"
+    r"|_connected|_p99_ms|_p50_ms)$"
+)
+
+_TYPE_LINE = re.compile(r"^# TYPE (\S+) (\S+)\s*$")
+_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?) (\S+)$")
+
+
+def _base_name(sample_key: str) -> str:
+    """``p_bucket{le="5"}`` -> ``p_bucket`` — the label-less sample name."""
+    return sample_key.split("{", 1)[0]
+
+
+def parse_exposition(text: str):
+    """Parse one text exposition into ``(types, families)`` where
+    ``types`` maps family name -> type and ``families`` maps family name
+    -> ordered ``{sample_key: float}``. Sample lines are attributed to
+    the most recent ``# TYPE`` family (the renderer always emits TYPE
+    immediately before its samples); strays land in an ``""``-typed
+    family of their own and merge as sums."""
+    types: dict[str, str] = {}
+    families: dict[str, dict[str, float]] = {}
+    current = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        m = _TYPE_LINE.match(line)
+        if m:
+            name, kind = m.group(1), m.group(2)
+            types.setdefault(name, kind)
+            families.setdefault(name, {})
+            current = name
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = _SAMPLE.match(line)
+        if not m:
+            continue  # tolerate junk — a merge endpoint must not 500
+        key, raw = m.group(1), m.group(2)
+        base = _base_name(key)
+        # a sample belongs to `current` only if its name extends the
+        # family name (p, p_sum, p_count, p_bucket); otherwise it is a
+        # stray from a renderer that skipped the TYPE line
+        family = (
+            current
+            if current is not None and base.startswith(current)
+            else base
+        )
+        if family not in families:
+            types.setdefault(family, "")
+            families[family] = {}
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        families[family][key] = value
+    return types, families
+
+
+def merge_expositions(texts) -> str:
+    """Merge member expositions into one fleet-wide exposition (see the
+    module docstring for per-type semantics). Preserves each family's
+    first-seen sample order — bucket ``le`` ordering survives — and
+    emits families sorted by name, matching the renderer."""
+    types: dict[str, str] = {}
+    merged: dict[str, dict[str, float]] = {}
+    for text in texts:
+        t, families = parse_exposition(text)
+        for name, kind in t.items():
+            types.setdefault(name, kind)
+        for name, samples in families.items():
+            out = merged.setdefault(name, {})
+            kind = types.get(name, "")
+            for key, value in samples.items():
+                if key not in out:
+                    out[key] = value
+                    continue
+                if kind == "gauge":
+                    if GAUGE_MAX.search(_base_name(key)):
+                        out[key] = max(out[key], value)
+                    else:
+                        out[key] += value
+                elif kind == "summary" and "quantile=" in key:
+                    out[key] = max(out[key], value)
+                else:
+                    # counters, histogram buckets/_sum/_count, summary
+                    # _sum/_count, untyped strays: additive
+                    out[key] += value
+    lines: list[str] = []
+    for name in sorted(merged):
+        kind = types.get(name, "")
+        if kind:
+            lines.append(f"# TYPE {name} {kind}")
+        for key, value in merged[name].items():
+            lines.append(f"{key} {_fmt(value)}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def scrape(url: str, timeout: float = 2.0) -> str:
+    """Fetch one member's /metrics body; raises on transport failure."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+        return resp.read().decode("utf-8", errors="replace")
+
+
+def fleet_metrics(ports, host: str = "127.0.0.1", timeout: float = 2.0):
+    """Scrape each member debug port and return ``(merged_text,
+    errors)`` — errors is ``[(port, reason)]`` for members that did not
+    answer (a dead-and-restarting worker must not fail the whole
+    scrape; its counters simply sit the round out)."""
+    texts = []
+    errors = []
+    for port in ports:
+        try:
+            texts.append(scrape(f"http://{host}:{port}/metrics", timeout))
+        except Exception as e:  # noqa: BLE001 - partial fleet still merges
+            errors.append((port, str(e)))
+    return merge_expositions(texts), errors

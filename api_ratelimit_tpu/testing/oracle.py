@@ -375,3 +375,133 @@ def parity_report(
         "false_ok": int(np.sum(~got_over & want_over)),
         "oracle_over_frac": float(np.mean(want_over)),
     }
+
+
+class SketchOracle:
+    """Exact sequential host model of the in-kernel heavy-hitter sketch
+    (ops/sketch.py): per launch, matched candidates scatter-add their
+    segment weight (phase A), then ONE unmatched candidate per sketch set
+    — the lexicographic (weight, fp_hi, fp_lo) maximum, a content-based
+    rank that needs no knowledge of the device sort — replaces the
+    argmin-count way with count = victim + weight (phase B, the
+    space-saving inheritance). The differential fuzz campaign
+    (tests/test_hotkeys_fuzz.py) holds the device planes to this model
+    bit-for-bit across launches AND drains.
+
+    Beyond the planes, the oracle tracks per lane what the bound proofs
+    need: `inherited` (the count the resident key inherited at insert)
+    and `acc` (the weight actually accumulated since insert), so between
+    decays count == inherited + acc exactly, and the classic space-saving
+    error statement — estimate overshoots a resident key's true stream
+    weight by at most its inherited count, and never undercounts the
+    weight it received since insertion — is assertable per lane."""
+
+    def __init__(self, lanes: int, ways: int):
+        ways = int(ways)
+        lanes = int(lanes)
+        if lanes <= 0 or lanes & (lanes - 1):
+            raise ValueError(f"lanes must be a positive power of two: {lanes}")
+        if ways <= 0 or lanes % ways:
+            raise ValueError(f"{lanes} lanes don't split into {ways}-way sets")
+        self.lanes, self.ways = lanes, ways
+        self.n_sets = lanes // ways
+        self.fp_lo = np.zeros(lanes, dtype=np.uint32)
+        self.fp_hi = np.zeros(lanes, dtype=np.uint32)
+        self.count = np.zeros(lanes, dtype=np.uint32)
+        self.inherited = np.zeros(lanes, dtype=np.uint64)
+        self.acc = np.zeros(lanes, dtype=np.uint64)
+
+    @property
+    def planes(self) -> np.ndarray:
+        """uint32[3, lanes] — directly comparable to the drained device
+        sketch (ops/sketch.py plane order)."""
+        return np.stack([self.fp_lo, self.fp_hi, self.count])
+
+    def _occupied(self) -> np.ndarray:
+        # the kernels test occupancy on the int32 view (counts stay below
+        # 2^31 by the drain-halving cadence); mirror the view, not intent
+        return self.count.view(np.int32) > 0
+
+    def update(self, candidates):
+        """One launch: candidates = [(fp_lo, fp_hi, weight)] — one entry
+        per DISTINCT key in the batch (the sorted segment ends), weight =
+        the key's total hits. Distinctness is the device contract (one
+        segment per fingerprint per launch); asserted because a duplicate
+        would make phase A order-dependent."""
+        fps = {(int(lo), int(hi)) for lo, hi, _w in candidates}
+        assert len(fps) == len(candidates), "duplicate candidate fingerprint"
+        occ0 = self._occupied()
+        cnt0 = self.count.copy()
+        matched_adds = []
+        per_set: dict[int, list[tuple[int, int, int]]] = {}
+        for lo, hi, w in candidates:
+            lo, hi, w = int(lo), int(hi), int(w)
+            set_idx = lo & (self.n_sets - 1)
+            base = set_idx * self.ways
+            sl = slice(base, base + self.ways)
+            match = (
+                occ0[sl]
+                & (self.fp_lo[sl] == np.uint32(lo))
+                & (self.fp_hi[sl] == np.uint32(hi))
+            )
+            if match.any():
+                matched_adds.append((base + int(np.argmax(match)), w))
+            else:
+                per_set.setdefault(set_idx, []).append((w, hi, lo))
+        # phase A: matched candidates accumulate (distinct lanes — order-free)
+        for lane, w in matched_adds:
+            self.count[lane] += np.uint32(w)
+            self.acc[lane] += np.uint64(w)
+        # phase B: one winner per set; victim = argmin of the PRE-launch
+        # int32 counts, first way on ties (the single scan pass both
+        # kernel arms run before either phase)
+        for set_idx, contenders in per_set.items():
+            w, hi, lo = max(contenders)
+            base = set_idx * self.ways
+            vic = base + int(
+                np.argmin(cnt0[base : base + self.ways].view(np.int32))
+            )
+            vic_cnt = cnt0[vic]
+            self.fp_lo[vic] = np.uint32(lo)
+            self.fp_hi[vic] = np.uint32(hi)
+            self.count[vic] = vic_cnt + np.uint32(w)
+            self.inherited[vic] = np.uint64(int(vic_cnt))
+            self.acc[vic] = np.uint64(w)
+
+    def decay(self):
+        """The drain-cadence halving (ops/sketch.py sketch_decay): halve
+        every count, clear fingerprints that decayed to zero. The error
+        ledger halves alongside; acc rebalances so count == inherited +
+        acc stays exact (floor halving preserves inherited <= count)."""
+        self.count >>= np.uint32(1)
+        dead = self.count == 0
+        self.fp_lo[dead] = 0
+        self.fp_hi[dead] = 0
+        self.inherited >>= np.uint64(1)
+        self.inherited[dead] = 0
+        self.acc = self.count.astype(np.uint64) - self.inherited
+
+    def estimate(self, fp_lo: int, fp_hi: int) -> int:
+        """The sketch's current estimate for a key (0 when not resident)."""
+        occ = self._occupied()
+        hit = occ & (self.fp_lo == np.uint32(fp_lo)) & (
+            self.fp_hi == np.uint32(fp_hi)
+        )
+        idx = np.flatnonzero(hit)
+        return int(self.count[idx[0]]) if idx.size else 0
+
+    def topk(self, k: int):
+        """[(fp_lo, fp_hi, count)] hottest first — the sketch_topk order:
+        (count, fp_hi, fp_lo) descending."""
+        occ = np.flatnonzero(self._occupied())
+        if occ.size == 0 or k <= 0:
+            return []
+        order = occ[
+            np.lexsort((self.fp_lo[occ], self.fp_hi[occ], self.count[occ]))[
+                ::-1
+            ]
+        ][:k]
+        return [
+            (int(self.fp_lo[i]), int(self.fp_hi[i]), int(self.count[i]))
+            for i in order
+        ]

@@ -75,6 +75,10 @@ FLAG_OVER_LIMIT = "over_limit"
 # to a standby address (backends/sidecar.py), or this request's write
 # promoted a standby (persist/replication.py) — always tail-worthy
 FLAG_FAILOVER = "failover"
+# a descriptor in this request was ranked hot by the heavy-hitter sketch's
+# last drain (backends/tpu.py drain_hotkeys): "slow AND hot" is the gold
+# tail-sample — contention on the hot head, not a cold-path stall
+FLAG_HOTKEY = "hotkey"
 
 
 class Journey:

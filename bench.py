@@ -911,6 +911,349 @@ def bench_boundary_burst(device, on_tpu: bool, left=lambda: 1e9) -> dict:
     return result
 
 
+def bench_hotkeys(device, on_tpu: bool, left=lambda: 1e9) -> dict:
+    """Heavy-hitter telemetry tier (round 15, ops/sketch.py). Three
+    measurements, each an acceptance claim kept as a number:
+
+      * precision@K: a Zipf(1.5) stream through the slab step with the
+        sketch armed; the drained top-K (sketch_topk on the pulled
+        planes) is scored against the stream's TRUE top-K computed on
+        the host ids (fingerprints expanded through the same fmix pair
+        the device uses). Target >= 0.9.
+      * sketch_overhead_pct: the SAME step program with sketch planes
+        threaded vs sketch=None (the HOTKEYS_ENABLED=false arm whose
+        traced program is byte-identical to the pre-sketch engine),
+        interleaved pass-by-pass so clock drift hits both arms equally.
+        Budget: <= 3%.
+      * lease pre-seed A/B (service level, lease_zipf stream): leasing
+        on with the sketch drain feeding LeaseTable.note_hot_fps vs
+        leasing on with the sketch dark. The claim is FEWER
+        exhaustion-renewals per decision (hot keys start at LEASE_MAX
+        instead of doubling up to it through device round trips) with
+        the granted-but-unconsumed share staying bounded.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from api_ratelimit_tpu.ops.slab import (
+        SlabBatch,
+        _slab_step_sorted,
+        _unsort,
+        make_slab,
+    )
+    from api_ratelimit_tpu.ops.sketch import (
+        make_sketch,
+        sketch_topk,
+        sketch_ways as sketch_ways_fn,
+    )
+
+    t0 = time.perf_counter()
+    lanes, k = 128, 16
+    batch = (1 << 17) if on_tpu else (1 << 13)
+    n_slots = (1 << 22) if on_tpu else (1 << 18)
+    n_keys = (1 << 20) if on_tpu else (1 << 16)
+    n_batches = 16
+    use_pallas = engine_use_pallas(on_tpu)
+    ways = default_ways_bench(on_tpu)
+    s_ways = sketch_ways_fn(ways, lanes)
+    now = int(time.time())
+
+    def fmix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    def expand(ids):
+        return SlabBatch(
+            fp_lo=fmix(ids),
+            fp_hi=fmix(ids ^ jnp.uint32(0x9E3779B9)),
+            hits=jnp.ones_like(ids),
+            limit=jnp.full_like(ids, 1_000_000),
+            divider=jnp.full_like(ids, 3600).astype(jnp.int32),
+            jitter=jnp.zeros_like(ids).astype(jnp.int32),
+        )
+
+    @functools.partial(
+        jax.jit,
+        donate_argnames=("state", "sketch"),
+        static_argnames=("use_pallas",),
+    )
+    def hot_step(state, sketch, ids, use_pallas):
+        # identical program to the headline tier's decided-mode step except
+        # for the sketch leaves — sketch=None IS the rollback arm
+        outs = _slab_step_sorted(
+            state,
+            expand(ids),
+            jnp.int32(now),
+            jnp.float32(0.8),
+            ways=ways,
+            use_pallas=use_pallas,
+            count_health=True,
+            lean_decide=use_pallas,
+            multi_algo=False,
+            sketch=sketch,
+            sketch_ways=s_ways if sketch is not None else 0,
+        )
+        new_sketch = None
+        if sketch is not None:
+            *outs, new_sketch = outs
+        state, _before, _after, d, order, _health = outs
+        over = _unsort(d.code, order) == 2
+        return state, jnp.packbits(over), new_sketch
+
+    # Zipf(1.5): the hot-head regime the sketch exists for (the headline
+    # tier keeps the harsher 1.1 tail for slab pressure; here the question
+    # is whether the head is RANKED right, so the head must exist)
+    rng = np.random.RandomState(15)
+    host_ids = (
+        rng.zipf(1.5, size=batch * n_batches).astype(np.uint64) % n_keys
+    ).reshape(n_batches, batch).astype(np.uint32)
+    staged = [jax.device_put(host_ids[i], device) for i in range(n_batches)]
+    for s in staged:
+        s.block_until_ready()
+
+    result: dict = {
+        "lanes": lanes,
+        "k": k,
+        "sketch_ways": s_ways,
+        "pallas": use_pallas,
+        "batch": batch,
+        "n_batches": n_batches,
+        "n_keys": n_keys,
+        "zipf_s": 1.5,
+    }
+
+    # --- precision@K: one full pass, drain, score against ground truth ---
+    state = jax.device_put(make_slab(n_slots), device)
+    sketch = jax.device_put(make_sketch(lanes), device)
+    for i in range(n_batches):
+        state, _bits, sketch = hot_step(state, sketch, staged[i], use_pallas)
+    planes = np.asarray(sketch)
+    head = sketch_topk(planes, k)
+    counts = np.bincount(host_ids.ravel(), minlength=n_keys)
+    true_ids = np.argsort(counts)[::-1][:k].astype(np.uint32)
+    true_fps = {
+        (int(lo), int(hi))
+        for lo, hi in zip(
+            fmix32_np(true_ids),
+            fmix32_np(true_ids ^ np.uint32(0x9E3779B9)),
+        )
+    }
+    got = sum(1 for lo, hi, _cnt in head if (lo, hi) in true_fps)
+    result["precision"] = {
+        "precision_at_k": round(got / k, 4),
+        "stream": int(batch * n_batches),
+        "true_head_count": int(counts[true_ids[0]]),
+        "sketch_head_count": head[0][2] if head else 0,
+        "tracked": int(np.count_nonzero(planes[2])),
+    }
+    print(f"[hotkeys] precision: {result['precision']}", file=sys.stderr)
+
+    # --- sketch_overhead_pct: interleaved on/off passes over one stream ---
+    if left() < 30:
+        result["overhead"] = {"skipped": "budget"}
+    else:
+        arms = {
+            "off": {"state": jax.device_put(make_slab(n_slots), device),
+                    "sketch": None, "times": []},
+            "on": {"state": jax.device_put(make_slab(n_slots), device),
+                   "sketch": jax.device_put(make_sketch(lanes), device),
+                   "times": []},
+        }
+        for arm in arms.values():  # compile + warm both programs first
+            arm["state"], _b, arm["sketch"] = hot_step(
+                arm["state"], arm["sketch"], staged[0], use_pallas
+            )
+            jax.block_until_ready(arm["state"])
+        n_rounds = 5
+        for _ in range(n_rounds):
+            if left() < 20:
+                break
+            for name in ("off", "on"):  # interleaved: drift hits both
+                arm = arms[name]
+                t_pass = time.perf_counter()
+                for i in range(n_batches):
+                    arm["state"], _b, arm["sketch"] = hot_step(
+                        arm["state"], arm["sketch"], staged[i], use_pallas
+                    )
+                jax.block_until_ready(arm["state"])
+                arm["times"].append(time.perf_counter() - t_pass)
+        t_off = float(np.median(arms["off"]["times"]))
+        t_on = float(np.median(arms["on"]["times"]))
+        per_pass = n_batches * batch
+        result["overhead"] = {
+            "sketch_overhead_pct": round((t_on / t_off - 1.0) * 100.0, 2),
+            "rate_off": round(per_pass / t_off),
+            "rate_on": round(per_pass / t_on),
+            "pass_s_off": [round(t, 4) for t in arms["off"]["times"]],
+            "pass_s_on": [round(t, 4) for t in arms["on"]["times"]],
+        }
+        print(f"[hotkeys] overhead: {result['overhead']}", file=sys.stderr)
+        arms.clear()
+    staged, state, sketch = [], None, None  # free HBM before the service arms
+
+    # --- lease pre-seed A/B: sketch-fed note_hot_fps vs sketch dark ---
+    # A STATIC hot head shows nothing: both arms climb the 8→1024 doubling
+    # ladder once during warmup and then coast. The pre-seed's claim is
+    # about keys that BECOME hot (a tenant spikes, the head rotates): the
+    # cold arm pays the full ladder per newly-hot key — each doubling an
+    # exhaustion-renewal device round trip the local path then misses —
+    # while the sketch arm pre-seeds a spiking key to LEASE_MAX at the
+    # next drain. So the stream rotates its Zipf(1.5) head through
+    # n_phases disjoint key universes over the drive.
+    if left() < 60:
+        result["lease_preseed"] = {"skipped": "budget"}
+        return result
+    from api_ratelimit_tpu.models.descriptors import (
+        Descriptor,
+        RateLimitRequest,
+    )
+
+    n_threads = max(4, os.cpu_count() or 1)
+    n_phases = 8
+    n_reqs = (1 << 17) if on_tpu else (1 << 15)
+    rng2 = np.random.default_rng(151)
+    z = rng2.zipf(1.5, size=n_reqs).astype(np.uint64) % 512
+    phase_ids = np.arange(n_reqs) // (n_reqs // n_phases)
+    lease_reqs = [
+        RateLimitRequest(
+            domain="bench",
+            descriptors=(
+                Descriptor.of(
+                    ("api_key", f"k{int(z[i]) + int(phase_ids[i]) * 10_000}")
+                ),
+            ),
+        )
+        for i in range(n_reqs)
+    ]
+    per_thread = n_reqs // n_threads  # each request exactly once, in order
+    # Offered load is PACED, not closed-loop: at full closed-loop speed a
+    # phase's entire doubling ladder completes in ~100ms — inside the
+    # drain latency, so neither arm could ever differ (measured exactly
+    # that in the first cut of this tier). A production spike ramps over
+    # seconds against a 1-10s stats cadence; pacing restores that ratio
+    # (~1s per phase vs a 100ms drain) without faking anything: the
+    # renewal ladder is driven by CONSUMED TOKENS, which pacing preserves.
+    pace_rate = 1000.0  # req/s per thread -> ~4k/s offered, ~8s drive
+
+    def paced_drive(service) -> tuple[int, float, list]:
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+
+        def worker(tid: int) -> int:
+            my = lease_reqs[tid::n_threads][:per_thread]
+            interval = 1.0 / pace_rate
+            t_next = time.perf_counter()
+            local = []
+            for r in my:
+                t_next += interval
+                now_t = time.perf_counter()
+                if t_next > now_t:
+                    time.sleep(t_next - now_t)
+                s = time.perf_counter()
+                service.should_rate_limit(r)
+                local.append((time.perf_counter() - s) * 1e3)
+            with lat_lock:
+                lat.extend(local)
+            return len(my)
+
+        t_drive = time.perf_counter()
+        with ThreadPoolExecutor(n_threads) as ex:
+            total = sum(ex.map(worker, range(n_threads)))
+        return total, time.perf_counter() - t_drive, lat
+
+    def lease_arm(hotkey_lanes: int) -> dict:
+        service, cache, store = _build_service(
+            "hotkeys_lease", _HOTKEYS_LEASE, telemetry=True, on_tpu=on_tpu,
+            lease=True, hotkey_lanes=hotkey_lanes,
+        )
+        for r in lease_reqs[:256]:  # warm: slab, witness, sketch (phase 0)
+            service.should_rate_limit(r)
+        eng = getattr(cache, "engine", None)
+        stop_evt = threading.Event()
+        drainer = None
+        if hotkey_lanes and eng is not None and eng.hotkeys_enabled:
+            eng.drain_hotkeys()  # first drain pre-seeds before the drive
+
+            def drain_loop() -> None:
+                # the stats-cadence stand-in: HotkeyStats drains on flush;
+                # the bench drains on a 100ms timer — an aggressive but
+                # realistic stats cadence, ~10x inside the ~1s phases
+                while not stop_evt.wait(0.1):
+                    try:
+                        eng.drain_hotkeys()
+                    except Exception:
+                        return
+
+            drainer = threading.Thread(target=drain_loop, daemon=True)
+            drainer.start()
+        total, elapsed, lat = paced_drive(service)
+        stop_evt.set()
+        if drainer is not None:
+            drainer.join(1.0)
+        snap = store.debug_snapshot()
+
+        def lease_stat(name: str) -> int:
+            return int(snap.get(f"ratelimit.lease.{name}", 0))
+
+        cache.close()
+        decisions = lease_stat("decisions_seen")
+        local_hits = lease_stat("local_hits")
+        grant_tokens = lease_stat("grant_tokens")
+        arm = {
+            "rate": round(total / elapsed),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "decisions": decisions,
+            "renews": lease_stat("renews"),
+            "renews_per_10k": (
+                round(lease_stat("renews") / decisions * 1e4, 2)
+                if decisions
+                else 0.0
+            ),
+            "grants": lease_stat("grants"),
+            "grant_tokens": grant_tokens,
+            "local_hits": local_hits,
+            "lease_hit_rate": (
+                round(local_hits / decisions, 4) if decisions else 0.0
+            ),
+            "burned_tokens": lease_stat("burned_tokens"),
+            # granted-but-unconsumed share — the overshoot bound: pre-
+            # seeding to LEASE_MAX must not strand most of what it reserves
+            "unused_grant_pct": (
+                round((1.0 - local_hits / grant_tokens) * 100.0, 2)
+                if grant_tokens > local_hits
+                else 0.0
+            ),
+            "hot_preseeded": lease_stat("hot_preseeded"),
+        }
+        if hotkey_lanes and eng is not None and eng.hotkeys_enabled:
+            arm["sketch"] = {
+                "drains": eng.hotkeys_snapshot()["drains"],
+                "hot_fps": len(eng.hot_fps),
+            }
+        return arm
+
+    hot = lease_arm(lanes)
+    cold = lease_arm(0)
+    block = {
+        "stream": {"requests": n_reqs, "phases": n_phases, "zipf_s": 1.5},
+        "hot": hot,
+        "cold": cold,
+    }
+    if cold["renews_per_10k"] > 0:
+        # negative = the pre-seeded arm renews LESS (the claim)
+        block["renews_delta_pct"] = round(
+            (hot["renews_per_10k"] / cold["renews_per_10k"] - 1.0) * 100.0,
+            2,
+        )
+    result["lease_preseed"] = block
+    print(f"[hotkeys] lease_preseed: {block}", file=sys.stderr)
+    result["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return result
+
+
 # ---------------- service-level benches (configs[0..3]) ----------------
 
 _FLAT = """\
@@ -977,6 +1320,19 @@ domain: bench
 descriptors:
   - key: api_key
     rate_limit: {unit: minute, requests_per_unit: 1000000000}
+"""
+
+# The hotkeys tier's lease A/B rides HOUR windows: minute windows put a
+# lease TTL (divider/4 = 15s) and possibly a window boundary INSIDE one
+# arm's ~8s paced drive but not the other's — a wall-clock confound that
+# showed up as one arm mass-expiring (burn + halve + re-preseed churn)
+# purely by run order. Hour windows keep both arms lifecycle-free so the
+# renewal delta measures the pre-seed and nothing else.
+_HOTKEYS_LEASE = """\
+domain: bench
+descriptors:
+  - key: api_key
+    rate_limit: {unit: hour, requests_per_unit: 1000000000}
 """
 
 
@@ -1204,6 +1560,7 @@ def _build_service(
     host_fast_path: bool = True,
     dispatch_loop: bool = True,
     lease: bool = False,
+    hotkey_lanes: int = 0,
 ):
     """One service stack for a scenario; telemetry=False builds the same
     stack with no stats scope on the backend (the A/B for recording
@@ -1211,7 +1568,9 @@ def _build_service(
     (the host_path_overhead_pct A/B arm); dispatch_loop=False pins the
     leader-collects batcher (the dispatch_loop_overhead_pct A/B arm);
     lease=True wires a LeaseTable (LEASE_ENABLED production posture — the
-    lease_zipf scenario's primary arm). Returns (service, cache, store)."""
+    lease_zipf scenario's primary arm); hotkey_lanes>0 arms the in-kernel
+    heavy-hitter sketch (the hotkeys tier's sketch→lease pre-seed arm).
+    Returns (service, cache, store)."""
     import random
 
     from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
@@ -1267,6 +1626,7 @@ def _build_service(
         precompile=True,
         dispatch_loop=dispatch_loop,
         lease_table=lease_table,
+        hotkey_lanes=hotkey_lanes,
     )
     service = RateLimitService(
         runtime=_StaticRuntime(yaml_text),
@@ -3016,6 +3376,20 @@ def main() -> None:
             )
         except Exception as e:
             configs["boundary_burst"] = {"error": str(e)[-300:]}
+    emit()
+
+    # heavy-hitter telemetry (round 15): in-kernel top-K sketch —
+    # precision@K vs the Zipf(1.5) ground truth, the sketch-on vs
+    # sketch-off interleaved overhead A/B, and the sketch→lease pre-seed
+    # grant-efficiency A/B (ops/sketch.py; the observability claims stay
+    # measurements)
+    if left() < 45:
+        configs["hotkeys"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["hotkeys"] = bench_hotkeys(device, on_tpu, left)
+        except Exception as e:
+            configs["hotkeys"] = {"error": str(e)[-300:]}
     emit()
 
     for key, yaml_text in (

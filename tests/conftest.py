@@ -69,6 +69,11 @@ def pytest_configure(config):
         "cluster: partitioned device-owner cluster tests (cluster/; "
         "`make tests_cluster`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "hotkeys: heavy-hitter sketch tests (ops/sketch.py; "
+        "`make tests_hotkeys`)",
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)

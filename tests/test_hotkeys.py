@@ -1,0 +1,392 @@
+"""Heavy-hitter telemetry above the kernel: the HOTKEYS_ENABLED=false
+byte-identity rollback arm (wire rows, slab bytes, verdicts — the
+multi_algo discipline), the drain lifecycle through HotkeyStats, the
+witness-resolved /debug/hotkeys document, FLAG_HOTKEY journey tagging,
+sketch-driven lease pre-seeding, the sidecar OP_HOTKEYS_GET verb, and
+the fleet exposition merge + lint.
+
+The kernel-vs-oracle bit-exactness (sketch planes across launches,
+drains, and both compile arms) lives in tests/test_hotkeys_fuzz.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends.tpu import (
+    HotkeyStats,
+    SlabDeviceEngine,
+    TpuRateLimitCache,
+)
+from api_ratelimit_tpu.limiter import BaseRateLimiter
+from api_ratelimit_tpu.models import Code, Descriptor, RateLimitRequest
+from api_ratelimit_tpu.stats import Store, TestSink
+from api_ratelimit_tpu.tracing import journeys
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+pytestmark = pytest.mark.hotkeys
+
+
+def req(*pairs, domain="algo", hits=1):
+    return RateLimitRequest(
+        domain=domain,
+        descriptors=tuple(Descriptor.of(p) for p in pairs),
+        hits_addend=hits,
+    )
+
+
+YAML = """
+domain: algo
+descriptors:
+  - key: hot
+    rate_limit: {unit: hour, requests_per_unit: 1000000}
+  - key: cold
+    rate_limit: {unit: hour, requests_per_unit: 1000000}
+"""
+
+
+def make_cache(ts, hotkey_lanes=0, hotkey_k=8, stats_scope=None, lease=None):
+    base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+    return TpuRateLimitCache(
+        base,
+        n_slots=1 << 12,
+        buckets=(128,),
+        max_batch=128,
+        use_pallas=False,
+        stats_scope=stats_scope,
+        hotkey_lanes=hotkey_lanes,
+        hotkey_k=hotkey_k,
+        lease_table=lease,
+    )
+
+
+def make_service(hotkey_lanes=0, lease=None, stats_scope=None):
+    from test_algorithms import FakeRuntime
+    from api_ratelimit_tpu.service.ratelimit import RateLimitService
+
+    ts = FakeTimeSource(1_000_000)
+    store = Store(TestSink())
+    scope = (
+        stats_scope if stats_scope is not None else store.scope("ratelimit")
+    )
+    cache = make_cache(
+        ts, hotkey_lanes=hotkey_lanes, stats_scope=scope, lease=lease
+    )
+    runtime = FakeRuntime({"config.algo": YAML})
+    svc = RateLimitService(
+        runtime=runtime,
+        cache=cache,
+        stats_scope=scope.scope("service"),
+        time_source=ts,
+    )
+    return svc, cache, ts
+
+
+def drive(svc, n_hot=30, n_cold=4):
+    """A skewed mix: one dominating key plus a cold tail."""
+    for _ in range(n_hot):
+        assert svc.should_rate_limit(req(("hot", "head")))[0] == Code.OK
+    for i in range(n_cold):
+        assert svc.should_rate_limit(req(("cold", f"t{i}")))[0] == Code.OK
+
+
+class TestRollbackArm:
+    """HOTKEYS_ENABLED=false must be the pre-sketch engine byte for byte:
+    identical wire rows, identical verdicts, identical slab bytes, and a
+    launch tuple with NO sketch planes (the fuzz suite pins the 3-tuple
+    arity; this pins the serving stack above it)."""
+
+    def test_off_and_on_arms_agree_byte_for_byte(self):
+        svc_off, cache_off, _ = make_service(hotkey_lanes=0)
+        svc_on, cache_on, _ = make_service(hotkey_lanes=32)
+        assert cache_off.engine._sketch is None
+        assert not cache_off.engine.hotkeys_enabled
+        assert cache_on.engine.hotkeys_enabled
+
+        captured: dict[str, list] = {"off": [], "on": []}
+        for label, cache in (("off", cache_off), ("on", cache_on)):
+            real = cache._batcher._execute
+            bucket = captured[label]
+
+            def spy(blocks, _real=real, _bucket=bucket):
+                _bucket.append([np.array(b) for b in blocks])
+                return _real(blocks)
+
+            cache._batcher._execute = spy
+        drive(svc_off)
+        drive(svc_on)
+
+        rows_off = np.concatenate(
+            [b for bs in captured["off"] for b in bs], axis=1
+        )
+        rows_on = np.concatenate(
+            [b for bs in captured["on"] for b in bs], axis=1
+        )
+        # same traffic -> same wire rows: the sketch must not perturb the
+        # submit path in either arm
+        np.testing.assert_array_equal(rows_off, rows_on)
+        # identical slab bytes: the sketch is SIBLING state, never slab
+        # state
+        np.testing.assert_array_equal(
+            np.asarray(cache_off.engine._state.table),
+            np.asarray(cache_on.engine._state.table),
+        )
+
+    def test_off_arm_debug_surfaces_stay_dark(self):
+        _svc, cache, _ = make_service(hotkey_lanes=0)
+        assert cache._witness is None
+        doc = cache.hotkeys_debug()
+        assert doc["enabled"] is False and doc["top"] == []
+        assert cache.engine.drain_hotkeys() == []
+
+    def test_mesh_disables_sketch(self):
+        # multi-device slabs shard rows across devices; the sketch scan is
+        # single-device — the engine must disable it loudly, not crash
+        import jax
+
+        from api_ratelimit_tpu.parallel import make_mesh
+
+        assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+        engine = SlabDeviceEngine(
+            time_source=FakeTimeSource(1_000_000),
+            n_slots=1 << 12,
+            buckets=(128,),
+            use_pallas=False,
+            mesh=make_mesh(),
+            hotkey_lanes=32,
+        )
+        assert not engine.hotkeys_enabled
+        assert engine._sketch is None
+        assert engine.drain_hotkeys() == []
+
+
+class TestDrainAndDebug:
+    def test_topk_ranks_the_hot_head_and_witness_resolves(self):
+        svc, cache, _ = make_service(hotkey_lanes=32)
+        drive(svc, n_hot=30, n_cold=4)
+        top = cache.engine.drain_hotkeys()
+        assert top, "a skewed stream must populate the sketch"
+        # hottest first, and the head's estimate dominates the tail keys
+        counts = [c for _, _, c in top]
+        assert counts == sorted(counts, reverse=True)
+        assert counts[0] >= 30
+        doc = cache.hotkeys_debug()
+        assert doc["enabled"] and doc["drains"] == 1
+        head = doc["top"][0]
+        # the witness cache recorded the composed key for the drained fp
+        assert head["key"] is not None and "hot" in head["key"]
+
+    def test_drain_decays_counts(self):
+        svc, cache, _ = make_service(hotkey_lanes=32)
+        drive(svc, n_hot=30, n_cold=0)
+        top1 = cache.engine.drain_hotkeys()
+        top2 = cache.engine.drain_hotkeys()
+        assert top2[0][2] == top1[0][2] // 2
+
+    def test_hotkey_stats_generator_is_the_drain_cadence(self):
+        sink = TestSink()
+        store = Store(sink)
+        svc, cache, _ = make_service(hotkey_lanes=32)
+        gen = HotkeyStats(
+            cache.engine, store.scope("ratelimit").scope("hotkeys")
+        )
+        drive(svc, n_hot=20, n_cold=2)
+        gen.generate_stats()
+        store.flush()
+        assert cache.engine._hotkey_drains == 1
+        assert sink.gauges["ratelimit.hotkeys.tracked"] >= 1
+        assert sink.gauges["ratelimit.hotkeys.top_count"] >= 20
+        assert sink.counters["ratelimit.hotkeys.drains"] == 1
+
+
+class TestJourneyTagging:
+    def test_flag_hotkey_marks_requests_touching_the_drained_head(self):
+        svc, cache, _ = make_service(hotkey_lanes=32)
+        recorder = journeys.JourneyRecorder(slow_ms=1e9)
+        journeys.set_global_recorder(recorder)
+        try:
+            drive(svc, n_hot=20, n_cold=2)
+            # nothing is hot until the first drain publishes the set
+            assert not any(
+                journeys.FLAG_HOTKEY in j.flags
+                for j in recorder.retained()
+            )
+            cache.engine.drain_hotkeys()
+            assert svc.should_rate_limit(req(("hot", "head")))[0] == Code.OK
+            # a key the drained set never saw must NOT be flagged
+            assert (
+                svc.should_rate_limit(req(("cold", "fresh")))[0] == Code.OK
+            )
+        finally:
+            journeys.set_global_recorder(None)
+        flagged = [
+            j for j in recorder.retained()
+            if journeys.FLAG_HOTKEY in j.flags
+        ]
+        assert len(flagged) == 1  # the hot request, not the fresh one
+
+
+class TestLeasePreseed:
+    def test_note_hot_fps_preseeds_to_max(self):
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+
+        sink = TestSink()
+        store = Store(sink)
+        ts = FakeTimeSource(1_000_000)
+        base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+        lease = LeaseTable(
+            base,
+            min_size=8,
+            max_size=256,
+            scope=store.scope("ratelimit").scope("lease"),
+        )
+        lease.note_hot_fps([0xAA, 0xBB])
+        assert lease._sizes[0xAA] == 256 and lease._sizes[0xBB] == 256
+        # already at max: re-seeding is a no-op, not a double count
+        lease.note_hot_fps([0xAA])
+        store.flush()
+        assert sink.counters["ratelimit.lease.hot_preseeded"] == 2
+
+    def test_drain_listener_feeds_the_lease_table(self):
+        from api_ratelimit_tpu.backends.lease import LeaseTable
+
+        ts = FakeTimeSource(1_000_000)
+        base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+        lease = LeaseTable(base, min_size=8, max_size=256)
+        svc, cache, _ = make_service(hotkey_lanes=32, lease=lease)
+        drive(svc, n_hot=25, n_cold=2)
+        cache.engine.drain_hotkeys()
+        # every drained-hot fingerprint now starts its grants at max
+        assert lease._sizes, "the drain listener must pre-seed sizes"
+        assert all(v == 256 for v in lease._sizes.values())
+
+
+class TestSidecarVerb:
+    def test_op_hotkeys_get_roundtrip(self, tmp_path):
+        from api_ratelimit_tpu.backends.sidecar import (
+            OP_HOTKEYS_GET,
+            SidecarEngineClient,
+            SlabSidecarServer,
+            cluster_rpc,
+        )
+
+        ts = FakeTimeSource(1_000_000)
+        engine = SlabDeviceEngine(
+            time_source=ts,
+            n_slots=1 << 12,
+            buckets=(128,),
+            use_pallas=False,
+            block_mode=True,
+            hotkey_lanes=32,
+            hotkey_k=4,
+        )
+        address = str(tmp_path / "slab.sock")
+        server = SlabSidecarServer(address, engine)
+        try:
+            base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+            cache = TpuRateLimitCache(
+                base, engine=SidecarEngineClient(address)
+            )
+            from api_ratelimit_tpu.models.config import (
+                RateLimit,
+                new_rate_limit_stats,
+            )
+            from api_ratelimit_tpu.models import Unit
+            from api_ratelimit_tpu.models.response import RateLimitValue
+
+            store = Store(TestSink())
+            limit = RateLimit(
+                full_key="k_v",
+                stats=new_rate_limit_stats(store.scope("t"), "k_v"),
+                limit=RateLimitValue(
+                    requests_per_unit=1_000_000, unit=Unit.HOUR
+                ),
+            )
+            for _ in range(12):
+                cache.do_limit(req(("k", "v"), domain="d"), [limit])
+            engine.drain_hotkeys()
+            doc = json.loads(cluster_rpc(address, OP_HOTKEYS_GET))
+            assert doc["enabled"] and doc["drains"] == 1
+            assert doc["top"] and doc["top"][0]["count"] >= 12
+            cache.close()
+        finally:
+            server.close()
+
+    def test_op_hotkeys_get_without_sketch(self, tmp_path):
+        from api_ratelimit_tpu.backends.sidecar import (
+            OP_HOTKEYS_GET,
+            SlabSidecarServer,
+            cluster_rpc,
+        )
+
+        engine = SlabDeviceEngine(
+            time_source=FakeTimeSource(1_000_000),
+            n_slots=1 << 12,
+            buckets=(128,),
+            use_pallas=False,
+            block_mode=True,
+        )
+        address = str(tmp_path / "slab.sock")
+        server = SlabSidecarServer(address, engine)
+        try:
+            doc = json.loads(cluster_rpc(address, OP_HOTKEYS_GET))
+            assert doc == {
+                "enabled": False, "k": 16, "lanes": 0, "drains": 0,
+                "top": [],
+            }
+        finally:
+            server.close()
+
+
+class TestFleetMerge:
+    def test_merged_exposition_is_lint_clean(self):
+        """The fleet satellite end to end, minus sockets: render two real
+        stores, merge them (stats/fleet.py), and validate the merged body
+        with the exposition lint (tools/metrics_lint.py)."""
+        import sys
+        from pathlib import Path
+
+        from api_ratelimit_tpu.stats import prometheus
+        from api_ratelimit_tpu.stats.fleet import merge_expositions
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from tools.metrics_lint import lint_exposition
+
+        texts = []
+        for worker in range(2):
+            store = Store(TestSink())
+            scope = store.scope("ratelimit")
+            scope.counter("total_hits").add(10 * (worker + 1))
+            scope.gauge("slab.occupancy_hwm").set(5 + worker)
+            scope.gauge("queue_depth").set(2)
+            h = scope.histogram("rpc_ms", boundaries=(1.0, 5.0))
+            h.record(0.5)
+            h.record(3.0)
+            texts.append(prometheus.render(store))
+        merged = merge_expositions(texts)
+        assert lint_exposition(merged) == []
+        assert "ratelimit_total_hits 30" in merged
+        # counters sum; high-water gauges take the max, additive gauges sum
+        assert "ratelimit_slab_occupancy_hwm 6" in merged
+        assert "ratelimit_queue_depth 4" in merged
+        assert 'ratelimit_rpc_ms_bucket{le="+Inf"} 4' in merged
+
+    def test_lint_exposition_catches_merge_bugs(self):
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from tools.metrics_lint import lint_exposition
+
+        bad = (
+            "# TYPE m histogram\n"
+            'm_bucket{le="1"} 5\n'
+            'm_bucket{le="+Inf"} 3\n'  # not cumulative
+            "orphan 1\n"  # no owning family
+        )
+        findings = lint_exposition(bad)
+        assert any("not cumulative" in f for f in findings)
+        assert any("no owning # TYPE" in f for f in findings)

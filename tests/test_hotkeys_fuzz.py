@@ -1,0 +1,346 @@
+"""Differential fuzz: the in-kernel heavy-hitter sketch vs the exact host
+model (testing/oracle.py SketchOracle), bit-for-bit.
+
+The sketch is deterministic by construction — content-based (weight,
+fp_hi, fp_lo) insert rank, pre-launch argmin victim, drain-halving decay —
+so the campaign holds the device planes to the numpy oracle EXACTLY after
+every launch and every drain, across three arms: the XLA twin with the
+sibling-algorithm step compiled in (the production shape), the XLA
+fixed-window-only step (multi_algo=False gate), and the Pallas scan in
+interpret mode. Streams cover the regimes that stress different parts of
+the update: Zipf (a stable hot head accumulating via phase A), uniform
+(insert churn spread across sets), and adversarial churn (a rotating cold
+wave that maximizes inherit-displacement — the space-saving worst case).
+
+On top of bit-exactness, the oracle's per-lane error ledger (inherited /
+acc) is asserted against the true offered stream: count == inherited +
+acc between decays, and a resident key's accumulated weight never exceeds
+what the stream actually offered it — the two directions of the
+space-saving bound.
+
+Campaign sizing follows the SLAB_FUZZ_EXAMPLES contract
+(tests/test_slab_fuzz.py): HOTKEY_FUZZ_EXAMPLES scales the same
+properties deeper on idle hardware; the tier-1 default stays small.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.ops.sketch import (
+    PLANE_COUNT,
+    PLANE_FP_HI,
+    PLANE_FP_LO,
+    _sketch_scan,
+    make_sketch,
+    pallas_sketch_scan,
+    sketch_decay,
+    sketch_topk,
+    sketch_update,
+    sketch_ways,
+)
+from api_ratelimit_tpu.ops.slab import (
+    ROW_DIVIDER,
+    ROW_FP_HI,
+    ROW_FP_LO,
+    ROW_HITS,
+    ROW_JITTER,
+    ROW_LIMIT,
+    ROW_SCALARS,
+    make_slab,
+    slab_step_packed,
+    validate_ways,
+)
+from api_ratelimit_tpu.testing.oracle import SketchOracle
+
+pytestmark = pytest.mark.hotkeys
+
+FUZZ_EXAMPLES = int(os.environ.get("HOTKEY_FUZZ_EXAMPLES", "0") or 0)
+
+# one slab/sketch geometry per campaign keeps it to one compile per arm;
+# 8-way slab sets, 32 sketch lanes in 4 sets of 8 — small enough that
+# eviction pressure and insert contention are both routine
+N_SLOTS, WAYS, PAD_TO, LANES = 512, 8, 128, 32
+
+
+def _fmix32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def _fp(key_id: int) -> tuple[int, int]:
+    """(fp_lo, fp_hi) per fuzz key — same construction as
+    tests/test_slab_fuzz.py: mixed fp_lo (set spread), unique id in
+    fp_hi's top 16 bits (distinct keys never share a fingerprint)."""
+    return (
+        _fmix32(key_id),
+        (((key_id + 1) & 0xFFFF) << 16) | (_fmix32(key_id ^ 0xA5A5) & 0xFFFF),
+    )
+
+
+def _pack(items, now: int, pad_to: int) -> np.ndarray:
+    packed = np.zeros((7, pad_to), dtype=np.uint32)
+    for i, (fp_lo, fp_hi, hits, limit, div, jit) in enumerate(items):
+        packed[ROW_FP_LO, i] = fp_lo
+        packed[ROW_FP_HI, i] = fp_hi
+        packed[ROW_HITS, i] = hits
+        packed[ROW_LIMIT, i] = limit
+        packed[ROW_DIVIDER, i] = div
+        packed[ROW_JITTER, i] = jit
+    packed[ROW_SCALARS, 0] = np.uint32(now)
+    packed[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+    return packed
+
+
+class _SketchHarness:
+    """Drives slab_step_packed with a live sketch and the SketchOracle in
+    lockstep; after every launch and every drain the device planes must
+    equal the oracle planes bit-for-bit."""
+
+    def __init__(self, multi_algo: bool = True):
+        self.state = make_slab(N_SLOTS)
+        self.ways = validate_ways(N_SLOTS, WAYS)
+        self.skw = sketch_ways(self.ways, LANES)
+        self.sketch = make_sketch(LANES)
+        self.oracle = SketchOracle(LANES, self.skw)
+        self.multi_algo = multi_algo
+        self.offered: dict[tuple[int, int], int] = {}
+
+    def step(self, items, now: int, label=""):
+        assert len(items) <= PAD_TO
+        packed = _pack(items, now, PAD_TO)
+        self.state, _out, _health, self.sketch = slab_step_packed(
+            self.state,
+            jnp.asarray(packed),
+            ways=self.ways,
+            multi_algo=self.multi_algo,
+            sketch=self.sketch,
+            sketch_ways=self.skw,
+        )
+        # host candidates: one per distinct fingerprint, weighted by the
+        # batch's total raw hits for that key — the segment totals the
+        # kernel's cumsum produces (every fuzz item carries hits >= 1, so
+        # every distinct key's segment end survives the hits>0 gate)
+        cands: dict[tuple[int, int], int] = {}
+        for fp_lo, fp_hi, hits, _l, _d, _j in items:
+            assert hits >= 1
+            cands[(fp_lo, fp_hi)] = cands.get((fp_lo, fp_hi), 0) + hits
+            self.offered[(fp_lo, fp_hi)] = (
+                self.offered.get((fp_lo, fp_hi), 0) + hits
+            )
+        self.oracle.update([(lo, hi, w) for (lo, hi), w in cands.items()])
+        np.testing.assert_array_equal(
+            np.asarray(self.sketch), self.oracle.planes, err_msg=str(label)
+        )
+
+    def drain(self, k: int = 8, label=""):
+        """The engine's stats-cadence drain: pull, report, halve,
+        re-upload — topk and the post-decay planes both pinned."""
+        dev = np.asarray(self.sketch).copy()
+        assert sketch_topk(dev, k) == self.oracle.topk(k), label
+        sketch_decay(dev)
+        self.oracle.decay()
+        np.testing.assert_array_equal(
+            dev, self.oracle.planes, err_msg=str(label)
+        )
+        self.sketch = jnp.asarray(dev)
+
+    def assert_error_bounds(self, label=""):
+        """The space-saving statement, per occupied lane: the estimate is
+        exactly inherited + accumulated, and a resident key never
+        accumulated more weight than the stream offered it (decay only
+        shrinks the ledger, so the inequality survives drains)."""
+        o = self.oracle
+        occ = np.flatnonzero(o.count.view(np.int32) > 0)
+        assert (
+            o.count[occ].astype(np.uint64)
+            == o.inherited[occ] + o.acc[occ]
+        ).all(), label
+        for lane in occ:
+            fp = (int(o.fp_lo[lane]), int(o.fp_hi[lane]))
+            offered = self.offered.get(fp)
+            assert offered is not None, (label, fp)
+            assert int(o.acc[lane]) <= offered, (label, fp)
+
+
+def _run_stream(draw_key, rng, examples: int, seed_base: int, drain_every=3):
+    for ex in range(examples):
+        seed = seed_base + ex
+        r = np.random.default_rng(seed)
+        h = _SketchHarness()
+        now = 1_000
+        for step in range(8):
+            n = int(r.integers(8, PAD_TO + 1))
+            items = []
+            for _ in range(n):
+                key = draw_key(r, step)
+                lo, hi = _fp(key)
+                items.append(
+                    (lo, hi, int(r.integers(1, 6)), 1_000, 1, 0)
+                )
+            h.step(items, now, label=(seed, step))
+            now += int(r.integers(0, 3))
+            if (step + 1) % drain_every == 0:
+                h.drain(label=(seed, step))
+        h.assert_error_bounds(label=seed)
+
+
+class TestFuzzStreams:
+    def test_zipf_stream(self):
+        examples = FUZZ_EXAMPLES or 2
+        _run_stream(
+            lambda r, _s: min(int(r.zipf(1.5)), 200), None, examples, 0xA15
+        )
+
+    def test_uniform_stream(self):
+        examples = FUZZ_EXAMPLES or 2
+        _run_stream(
+            lambda r, _s: int(r.integers(1, 300)), None, examples, 0xB27
+        )
+
+    def test_adversarial_churn_stream(self):
+        # a rotating cold wave: every step brings a fresh key-id band, so
+        # nearly every candidate is an unmatched insert displacing a
+        # resident — maximum inherit pressure — with a thin persistent
+        # head mixed in so phase A and phase B interleave in one launch
+        examples = FUZZ_EXAMPLES or 2
+
+        def draw(r, step):
+            if r.random() < 0.2:
+                return int(r.integers(1, 4))  # the persistent head
+            return 1_000 + step * 64 + int(r.integers(0, 64))
+
+        _run_stream(draw, None, examples, 0xC39)
+
+
+class TestArms:
+    def test_multi_algo_off_arm_matches(self):
+        """The fixed-window-only step (multi_algo=False) must produce the
+        identical sketch: the gate changes decision arms, never the
+        segment weights the sketch consumes."""
+        r = np.random.default_rng(7)
+        arms = [_SketchHarness(multi_algo=True), _SketchHarness(multi_algo=False)]
+        now = 1_000
+        for step in range(4):
+            items = [
+                (*_fp(min(int(r.zipf(1.5)), 99)), int(r.integers(1, 6)), 500, 1, 0)
+                for _ in range(48)
+            ]
+            for h in arms:
+                h.step(items, now, label=("arm", step))
+            now += 1
+        np.testing.assert_array_equal(
+            np.asarray(arms[0].sketch), np.asarray(arms[1].sketch)
+        )
+
+    def test_pallas_scan_parity(self):
+        """The Mosaic sketch scan (interpret mode) is bit-identical to the
+        XLA twin on the ways==128 geometry it serves."""
+        examples = FUZZ_EXAMPLES or 2
+        for ex in range(examples):
+            r = np.random.default_rng(0xD00 + ex)
+            b, w = 256, 128
+            rows_cnt = r.integers(0, 50, (b, w), dtype=np.uint64).astype(
+                np.uint32
+            )
+            rows_lo = r.integers(0, 1 << 32, (b, w), dtype=np.uint64).astype(
+                np.uint32
+            ) * (rows_cnt > 0)
+            rows_hi = r.integers(0, 1 << 32, (b, w), dtype=np.uint64).astype(
+                np.uint32
+            ) * (rows_cnt > 0)
+            # half the queries hit a resident fingerprint, half miss
+            q_lo = rows_lo[np.arange(b), r.integers(0, w, b)].copy()
+            q_hi = rows_hi[np.arange(b), r.integers(0, w, b)].copy()
+            miss = r.random(b) < 0.5
+            q_lo[miss] ^= 0xDEAD
+            args = tuple(
+                jnp.asarray(a) for a in (rows_lo, rows_hi, rows_cnt, q_lo, q_hi)
+            )
+            ref = _sketch_scan(*args)
+            got = pallas_sketch_scan(*args, interpret=True)
+            for name, a, b_ in zip(
+                ("m_way", "m_any", "v_way", "v_cnt"), ref, got
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b_), err_msg=f"{ex}:{name}"
+                )
+
+    def test_pallas_update_parity(self):
+        """Whole-update parity: the pallas-scan arm of sketch_update ==
+        the XLA arm, state threaded across several launches."""
+        examples = FUZZ_EXAMPLES or 2
+        for ex in range(examples):
+            r = np.random.default_rng(0xE00 + ex)
+            lanes = 128
+            skw = 128  # the pallas geometry: one set per sublane row
+            sk_x = make_sketch(lanes)
+            sk_p = make_sketch(lanes)
+            for step in range(4):
+                b = 256
+                keys = np.sort(r.integers(1, 64, b))
+                lo = np.array(
+                    [_fp(int(k))[0] for k in keys], dtype=np.uint32
+                )
+                hi = np.array(
+                    [_fp(int(k))[1] for k in keys], dtype=np.uint32
+                )
+                # segment ends over the sorted keys; weight = cumulative
+                # hits within the segment, exactly the kernel's shape
+                hits = r.integers(1, 5, b).astype(np.uint32)
+                seg_last = np.r_[keys[1:] != keys[:-1], True]
+                incl = np.cumsum(hits, dtype=np.uint32)
+                excl = incl - hits
+                seg_start = np.r_[True, keys[1:] != keys[:-1]]
+                base = np.maximum.accumulate(np.where(seg_start, excl, 0))
+                weight = (incl - base).astype(np.uint32)
+                args = (
+                    jnp.asarray(lo),
+                    jnp.asarray(hi),
+                    jnp.asarray(weight),
+                    jnp.asarray(seg_last),
+                )
+                sk_x = sketch_update(sk_x, *args, ways=skw)
+                sk_p = sketch_update(
+                    sk_p, *args, ways=skw, use_pallas=True, interpret=True
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(sk_x), np.asarray(sk_p), err_msg=f"{ex}:{step}"
+                )
+
+    def test_gate_off_shape(self):
+        """sketch=None keeps the pre-hotkeys 3-tuple return — the arity
+        half of the byte-identity gate (the wire/program half is pinned in
+        tests/test_hotkeys.py)."""
+        state = make_slab(N_SLOTS)
+        packed = _pack([( *_fp(1), 1, 10, 1, 0)], 1_000, PAD_TO)
+        out = slab_step_packed(state, jnp.asarray(packed), ways=WAYS)
+        assert len(out) == 3
+
+
+class TestDrainHelpers:
+    def test_topk_rank_is_total_order(self):
+        planes = np.zeros((3, 8), dtype=np.uint32)
+        planes[PLANE_FP_LO] = [1, 2, 3, 4, 0, 0, 0, 0]
+        planes[PLANE_FP_HI] = [9, 9, 8, 7, 0, 0, 0, 0]
+        planes[PLANE_COUNT] = [5, 5, 5, 9, 0, 0, 0, 0]
+        got = sketch_topk(planes, 3)
+        assert got == [(4, 7, 9), (2, 9, 5), (1, 9, 5)]
+
+    def test_decay_clears_dead_fps(self):
+        planes = np.zeros((3, 4), dtype=np.uint32)
+        planes[PLANE_FP_LO] = [11, 22, 0, 33]
+        planes[PLANE_FP_HI] = [1, 2, 0, 3]
+        planes[PLANE_COUNT] = [1, 4, 0, 3]
+        sketch_decay(planes)
+        assert planes[PLANE_COUNT].tolist() == [0, 2, 0, 1]
+        assert planes[PLANE_FP_LO].tolist() == [0, 22, 0, 33]
+        assert planes[PLANE_FP_HI].tolist() == [0, 2, 0, 3]

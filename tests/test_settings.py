@@ -371,6 +371,44 @@ class TestLeaseSettings:
             ).lease_config()
 
 
+class TestHotkeySettings:
+    """HOTKEYS_* knobs (ops/sketch.py heavy-hitter telemetry), following
+    the lease_config() junk-rejection pattern."""
+
+    def test_defaults(self):
+        s = Settings()
+        assert s.hotkeys_enabled is True
+        assert s.hotkey_k == 16
+        assert s.hotkey_lanes == 128
+        assert s.hotkey_config() == (True, 16, 128)
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "HOTKEYS_ENABLED": "false",
+                "HOTKEY_K": "8",
+                "HOTKEY_LANES": "64",
+            }
+        )
+        assert s.hotkey_config() == (False, 8, 64)
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="HOTKEYS_ENABLED"):
+            new_settings({"HOTKEYS_ENABLED": "sideways"})
+        with pytest.raises(ValueError, match="HOTKEY_K"):
+            new_settings({"HOTKEY_K": "many"})
+        with pytest.raises(ValueError, match="HOTKEY_K"):
+            new_settings({"HOTKEY_K": "0"}).hotkey_config()
+        with pytest.raises(ValueError, match="HOTKEY_LANES"):
+            new_settings({"HOTKEY_LANES": "100"}).hotkey_config()
+        with pytest.raises(ValueError, match="HOTKEY_LANES"):
+            new_settings({"HOTKEY_LANES": "-128"}).hotkey_config()
+        with pytest.raises(ValueError, match="HOTKEY_K"):
+            new_settings(
+                {"HOTKEY_K": "64", "HOTKEY_LANES": "32"}
+            ).hotkey_config()
+
+
 class TestReplicationSettings:
     """SIDECAR_ADDRS / REPL_* knobs (persist/replication.py), following
     the lease_config() junk-rejection pattern: a typo'd knob fails the

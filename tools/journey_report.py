@@ -14,6 +14,14 @@ artifact browser), never needing the accelerator stack.
     python -m tools.journey_report --top 20 journeys.json
     curl -s localhost:6070/debug/journeys | python -m tools.journey_report -
     python -m tools.journey_report --json journeys.json   # machine-readable
+    python -m tools.journey_report --hot-only journeys.json  # hotkey tail
+
+Hot-key view (ops/sketch.py heavy-hitter telemetry): journeys whose
+request touched a descriptor the sketch ranked hot carry the "hotkey"
+flag. --hot-only restricts the whole report to those journeys; the
+default report additionally splits every per-stage percentile row into
+hot vs cold populations, so "the p99 is the hot head contending" and
+"the p99 is a cold-path stall" are distinguishable at a glance.
 """
 
 from __future__ import annotations
@@ -35,6 +43,13 @@ STAGE_ORDER = (
     "redeem",
     "scatter",
 )
+
+# tracing/journeys.py FLAG_HOTKEY, duplicated for the same reason
+FLAG_HOTKEY = "hotkey"
+
+
+def is_hot(journey: dict) -> bool:
+    return FLAG_HOTKEY in (journey.get("flags") or ())
 
 
 def _percentile(ordered: list[float], q: float) -> float:
@@ -68,8 +83,7 @@ def collect_journeys(doc: dict) -> list[dict]:
     return list(doc.get("retained") or doc.get("journeys") or [])
 
 
-def build_report(doc: dict, top: int = 10) -> dict:
-    journeys = collect_journeys(doc)
+def _summarize_stages(journeys: list[dict]) -> dict:
     per_stage: dict[str, list[float]] = {}
     for journey in journeys:
         for stage, ms in stage_deltas(journey).items():
@@ -86,11 +100,21 @@ def build_report(doc: dict, top: int = 10) -> dict:
             "p99_ms": round(_percentile(values, 0.99), 4),
             "max_ms": round(values[-1], 4),
         }
+    return stage_summary
+
+
+def build_report(doc: dict, top: int = 10, hot_only: bool = False) -> dict:
+    journeys = collect_journeys(doc)
+    if hot_only:
+        journeys = [j for j in journeys if is_hot(j)]
+    stage_summary = _summarize_stages(journeys)
+    hot = [j for j in journeys if is_hot(j)]
     slowest = sorted(
         journeys, key=lambda j: j.get("duration_ms", 0.0), reverse=True
     )[: max(0, top)]
-    return {
+    report = {
         "journeys": len(journeys),
+        "hot_journeys": len(hot),
         "live_p99_ms": doc.get("live_p99_ms") if isinstance(doc, dict) else None,
         "stages": stage_summary,
         "slowest": [
@@ -107,25 +131,52 @@ def build_report(doc: dict, top: int = 10) -> dict:
             for j in slowest
         ],
     }
+    # the hot/cold per-stage split (omitted under --hot-only, where the
+    # whole report IS the hot population): same percentile rows computed
+    # over the two sub-populations, so a fat device stage can be
+    # attributed to head contention vs cold-path stalls
+    if not hot_only and hot and len(hot) < len(journeys):
+        cold = [j for j in journeys if not is_hot(j)]
+        report["stages_hot"] = _summarize_stages(hot)
+        report["stages_cold"] = _summarize_stages(cold)
+    return report
 
 
-def render_text(report: dict) -> str:
-    lines = [f"[journeys] retained={report['journeys']}"]
-    if report.get("live_p99_ms") is not None:
-        lines[0] += f" live_p99={report['live_p99_ms']:.3f}ms"
-    lines.append("")
+def _stage_table(stages: dict, header: str | None = None) -> list[str]:
+    lines = []
+    if header:
+        lines.append(header)
     lines.append(
         f"{'stage':<10} {'count':>6} {'p50_ms':>10} {'p90_ms':>10} "
         f"{'p99_ms':>10} {'max_ms':>10}"
     )
     for stage in STAGE_ORDER:
-        s = report["stages"].get(stage)
+        s = stages.get(stage)
         if s is None:
             continue
         lines.append(
             f"{stage:<10} {s['count']:>6} {s['p50_ms']:>10.4f} "
             f"{s['p90_ms']:>10.4f} {s['p99_ms']:>10.4f} {s['max_ms']:>10.4f}"
         )
+    return lines
+
+
+def render_text(report: dict) -> str:
+    lines = [
+        f"[journeys] retained={report['journeys']} "
+        f"hot={report.get('hot_journeys', 0)}"
+    ]
+    if report.get("live_p99_ms") is not None:
+        lines[0] += f" live_p99={report['live_p99_ms']:.3f}ms"
+    lines.append("")
+    lines.extend(_stage_table(report["stages"]))
+    if report.get("stages_hot"):
+        lines.append("")
+        lines.extend(
+            _stage_table(report["stages_hot"], "hot (flagged 'hotkey'):")
+        )
+        lines.append("")
+        lines.extend(_stage_table(report["stages_cold"], "cold:"))
     lines.append("")
     lines.append(f"top {len(report['slowest'])} slowest:")
     lines.append(
@@ -156,6 +207,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit the report as JSON"
     )
+    parser.add_argument(
+        "--hot-only",
+        action="store_true",
+        help="restrict the report to journeys flagged 'hotkey' (requests "
+        "that touched a sketch-ranked heavy hitter)",
+    )
     args = parser.parse_args(argv)
     try:
         if args.input == "-":
@@ -166,7 +223,7 @@ def main(argv=None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"journey_report: cannot read {args.input}: {e}", file=sys.stderr)
         return 1
-    report = build_report(doc, top=args.top)
+    report = build_report(doc, top=args.top, hot_only=args.hot_only)
     if args.json:
         print(json.dumps(report, indent=2))
     else:

@@ -157,6 +157,65 @@ def lint_readme(
     return findings
 
 
+def lint_exposition(text: str) -> list[str]:
+    """Validate a Prometheus text exposition — in particular the merged
+    fleet output of stats/fleet.py merge_expositions (the master's
+    ``GET /metrics?fleet=1`` body): every sample must belong to a
+    ``# TYPE``-declared family, no family may be declared twice, no
+    sample name may repeat, and histogram bucket series must be
+    cumulative (monotone non-decreasing toward ``+Inf``). A merge bug —
+    double-declared families from conflicting member types, non-monotone
+    buckets from summing absolutes into cumulatives — fails here before
+    a scraper ever sees it."""
+    findings: list[str] = []
+    declared: dict[str, str] = {}
+    seen_samples: set[str] = set()
+    current: str | None = None
+    bucket_last: dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                findings.append(f"line {lineno}: malformed TYPE line {line!r}")
+                continue
+            name, kind = parts[2], parts[3]
+            if name in declared:
+                findings.append(
+                    f"line {lineno}: family {name!r} declared twice"
+                )
+            declared[name] = kind
+            current = name
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            key, raw = line.rsplit(" ", 1)
+            value = float(raw)
+        except ValueError:
+            findings.append(f"line {lineno}: malformed sample {line!r}")
+            continue
+        base = key.split("{", 1)[0]
+        if current is None or not base.startswith(current):
+            findings.append(
+                f"line {lineno}: sample {key!r} has no owning # TYPE family"
+            )
+        if key in seen_samples:
+            findings.append(f"line {lineno}: duplicate sample {key!r}")
+        seen_samples.add(key)
+        if base.endswith("_bucket") and "le=" in key:
+            prev = bucket_last.get(base)
+            if prev is not None and value < prev:
+                findings.append(
+                    f"line {lineno}: histogram {base!r} buckets are not "
+                    f"cumulative ({value} after {prev})"
+                )
+            bucket_last[base] = value
+    return findings
+
+
 def main() -> int:
     findings = lint() + lint_readme()
     if findings:
