@@ -10,7 +10,8 @@ NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
 .PHONY: all compile native proto tests tests_unit tests_artifact \
         tests_chaos tests_cluster tests_hotkeys tests_integration \
         tests_mp tests_with_redis tests_tpu \
-        bench profile serve check_config clean docker_image docker_tests
+        bench bench_smoke bench_fleet bench_report bench_lint \
+        profile serve check_config clean docker_image docker_tests
 
 all: compile
 
@@ -101,6 +102,37 @@ tests_tpu:
 # Decisions/sec + p99 benchmark; prints one JSON line. Run on TPU.
 bench:
 	$(PY) bench.py
+
+# One-tier smoke run of the bench harness (~2 min on any box): the flat
+# tier at a tiny request budget, every other tier recorded
+# skipped-with-reason, provenance stamped and bench_lint-validated. The
+# recipe the tier-1 bench_smoke test drives (tests/test_bench.py).
+bench_smoke:
+	BENCH_TIERS=flat_per_second BENCH_BUDGET_S=90 \
+	  BENCH_SERVICE_REQUESTS=200 BENCH_PLATFORM=cpu $(PY) bench.py
+
+# Hardware-gated fleet saturation run (tools/bench_driver.py): probe the
+# box, arm what the hardware supports (multi-process tiers need real
+# cores; Pallas tiers need a chip window), boot the FRONTEND_PROCS fleet
+# with per-process CPU slices, drive it with the distributed closed-loop
+# load generator (tools/loadgen.py) and pair client histograms with the
+# server-side fleet scrape. Un-armed tiers land in the artifact as
+# skipped-with-reason — a 1-core box still emits a valid artifact.
+bench_fleet:
+	$(PY) -m tools.bench_driver --fleet --out BENCH_fleet.json
+
+# Provenance-gated perf trajectory across BENCH_r*.json rounds: deltas
+# only within one hardware regime; cross-regime rows print an explicit
+# refusal instead of a percentage (tools/bench_report.py).
+bench_report:
+	$(PY) -m tools.bench_report
+
+# Artifact-discipline linter for bench JSON (tools/bench_lint.py), the
+# bench sibling of metrics_lint: CRC-verified provenance, every skip has
+# a reason, rate-claiming tiers carry non-empty stage evidence. Tier-1
+# runs it over the checked-in rounds via tests/test_bench_lint.py.
+bench_lint:
+	$(PY) -m tools.bench_lint BENCH_r16.json
 
 # Host-path profile: cProfile over the flat_per_second request loop
 # (tools/hotpath_profile.py; --legacy pins the pre-vectorization path).

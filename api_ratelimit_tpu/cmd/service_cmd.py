@@ -92,6 +92,7 @@ def _serve_fleet_aggregator(settings: Settings, member_ports: list[int]):
 
     from ..server.http_server import HttpServer
     from ..stats import fleet as fleet_mod
+    from ..utils import provenance as _provenance
 
     try:
         server = HttpServer("", settings.debug_port, "fleet")
@@ -112,6 +113,11 @@ def _serve_fleet_aggregator(settings: Settings, member_ports: list[int]):
                 {
                     "fleet": True,
                     "member_debug_ports": member_ports,
+                    # the master's own box facts (utils/provenance.py) —
+                    # the supervisor owns no accelerator, so platform is
+                    # honestly cpu/0; members report theirs via
+                    # ratelimit.build.* gauges in the merged exposition
+                    "build": _provenance.build_provenance("cpu", 0),
                     "hint": "GET /metrics?fleet=1 for the merged "
                     "fleet-wide exposition",
                 },
@@ -145,13 +151,31 @@ def _serve_fleet_aggregator(settings: Settings, member_ports: list[int]):
     return server
 
 
+def _affinity_slices() -> list[str]:
+    """Parse the bench driver's fleet CPU plan: BENCH_CPU_AFFINITY_PLAN
+    is ``|``-separated comma-CSV slices ("0|1|2,3"), slice i for worker
+    i and the LAST slice for the device owner (tools/bench_driver.py
+    builds it with cpu_affinity_plan). Empty outside a driven run."""
+    plan = os.environ.get("BENCH_CPU_AFFINITY_PLAN", "").strip()
+    if not plan:
+        return []
+    return [s.strip() for s in plan.split("|") if s.strip()]
+
+
 def run_frontend_fleet(settings: Settings, n: int) -> None:
     """Master process: spawn (owner +) N workers, supervise, tear down."""
     setup_logging(settings)
     stop = threading.Event()
 
+    # per-member CPU pinning for driven bench runs: each child applies
+    # its own slice via BENCH_CPU_AFFINITY (runner.py / sidecar_cmd.py);
+    # the raw plan must not leak into children as-is
+    aff_slices = _affinity_slices()
+
     worker_env = dict(os.environ)
     worker_env["FRONTEND_PROCS"] = "1"
+    worker_env.pop("BENCH_CPU_AFFINITY", None)
+    worker_env.pop("BENCH_CPU_AFFINITY_PLAN", None)
     # debug-port layout: the MASTER keeps DEBUG_PORT for the fleet
     # aggregator below, worker i gets DEBUG_PORT+1+i, the in-house owner
     # DEBUG_PORT+1+N — every process a distinct port, because the debug
@@ -165,6 +189,12 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
         owner_env = dict(os.environ)
         owner_env["FRONTEND_PROCS"] = "1"
         owner_env["DEBUG_PORT"] = str(owner_debug_port)
+        owner_env.pop("BENCH_CPU_AFFINITY", None)
+        owner_env.pop("BENCH_CPU_AFFINITY_PLAN", None)
+        if aff_slices:
+            # the owner takes the LAST slice — on a driven multi-core
+            # run it gets its own core(s), away from the worker herd
+            owner_env["BENCH_CPU_AFFINITY"] = aff_slices[-1]
         owner = subprocess.Popen(
             [sys.executable, "-m", "api_ratelimit_tpu.cmd.sidecar_cmd"],
             env=owner_env,
@@ -186,6 +216,8 @@ def run_frontend_fleet(settings: Settings, n: int) -> None:
         # gRPC/HTTP serve through SO_REUSEPORT on the SHARED ports; the
         # debug listener must stay per-process or scrapes would split
         env["DEBUG_PORT"] = str(settings.debug_port + 1 + i)
+        if aff_slices and i < len(aff_slices):
+            env["BENCH_CPU_AFFINITY"] = aff_slices[i]
         proc = subprocess.Popen(
             [sys.executable, "-m", "api_ratelimit_tpu.cmd.service_cmd"],
             env=env,

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -77,6 +78,20 @@ def main(argv=None) -> None:
     if args.role is not None:
         settings.repl_role = args.role
     setup_logging(settings)
+
+    # bench-driver CPU slice (tools/bench_driver.py): the fleet master
+    # hands each member its cores when a multi-core tier armed; unset
+    # outside a driven run. Pin BEFORE jax init so compile threads land
+    # on the slice too.
+    _aff = os.environ.get("BENCH_CPU_AFFINITY", "").strip()
+    if _aff:
+        try:
+            os.sched_setaffinity(
+                0, {int(c) for c in _aff.split(",") if c.strip()}
+            )
+            logger.info("pinned to cpus {%s} (BENCH_CPU_AFFINITY)", _aff)
+        except (AttributeError, ValueError, OSError) as e:
+            logger.warning("BENCH_CPU_AFFINITY %r not applied: %s", _aff, e)
 
     # Partitioned cluster membership (PARTITIONS>1; cluster/): this owner
     # serves ONE keyspace partition of the boot map — map-stamped SUBMIT
@@ -148,6 +163,22 @@ def main(argv=None) -> None:
             native_info["so_path"],
             native_info["source_present"],
         )
+
+    # build/hardware provenance gauges (ratelimit.build.*) next to
+    # native.available (utils/provenance.py): the device owner is the one
+    # fleet member whose platform/device_count are real accelerator facts,
+    # so stamp them from jax itself — the fleet merge takes the MAX per
+    # gauge, so the owner's tpu platform_id wins over frontend cpu rows.
+    import jax as _jax
+
+    from ..utils import provenance
+
+    _devices = _jax.devices()
+    provenance.register_build_gauges(
+        scope,
+        platform=_devices[0].platform,
+        device_count=len(_devices),
+    )
 
     mesh = None
     if settings.tpu_mesh_devices > 1:

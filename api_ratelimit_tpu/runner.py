@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
 import signal as signal_module
 import sys
@@ -280,6 +281,29 @@ class Runner:
                 info["so_path"],
                 info["source_present"],
             )
+
+        # build/hardware provenance gauges (ratelimit.build.*) next to
+        # native.available: a scraped fleet self-describes the regime it
+        # is measured in (utils/provenance.py; merged by MAX fleet-wide).
+        # A frontend owns no accelerator — it honestly reports cpu/0; the
+        # device owner (cmd/sidecar_cmd.py) reports the real platform.
+        from .utils import provenance
+
+        provenance.register_build_gauges(self.scope)
+
+        # bench-driver affinity plan: when the fleet master armed a
+        # multi-core run it hands each process its CPU slice via this
+        # env knob (tools/bench_driver.py); outside a driven run the
+        # knob is unset and this is a no-op
+        aff = os.environ.get("BENCH_CPU_AFFINITY", "").strip()
+        if aff:
+            try:
+                os.sched_setaffinity(
+                    0, {int(c) for c in aff.split(",") if c.strip()}
+                )
+                logger.info("pinned to cpus {%s} (BENCH_CPU_AFFINITY)", aff)
+            except (AttributeError, ValueError, OSError) as e:
+                logger.warning("BENCH_CPU_AFFINITY %r not applied: %s", aff, e)
 
         local_cache = None
         if settings.local_cache_size_in_bytes > 0:

@@ -36,12 +36,21 @@ from .prometheus import CONTENT_TYPE, _fmt  # noqa: F401 - re-exported
 
 # gauge names where a sum across processes is a lie: high-water marks,
 # map epochs, 0/1 capability flags (native codec available, replication
-# connected, hotkeys enabled), and live quantile estimates. Matched
-# against the FULL prometheus sample name.
+# connected, hotkeys enabled), live quantile estimates — and the whole
+# ratelimit_build_* provenance family (utils/provenance.py): every
+# member reports the same box, and a summed host_cpus would invent
+# cores. Matched against the FULL prometheus sample name.
 GAUGE_MAX = re.compile(
-    r"(_hwm|_high_watermark|_watermark|_epoch|_available|_enabled"
+    r"^ratelimit_build_"
+    r"|(_hwm|_high_watermark|_watermark|_epoch|_available|_enabled"
     r"|_connected|_p99_ms|_p50_ms)$"
 )
+
+# synthetic counter family the merge itself emits when a member's
+# exposition carried unparseable lines: a truncated or garbled worker
+# degrades to a partial merge WITH a visible drop count, never a 500
+# and never a silent hole in the fleet view
+DROPPED_FAMILY = "ratelimit_fleet_merge_dropped_lines"
 
 _TYPE_LINE = re.compile(r"^# TYPE (\S+) (\S+)\s*$")
 _SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?) (\S+)$")
@@ -52,16 +61,22 @@ def _base_name(sample_key: str) -> str:
     return sample_key.split("{", 1)[0]
 
 
-def parse_exposition(text: str):
+def parse_exposition(text: str, report: dict | None = None):
     """Parse one text exposition into ``(types, families)`` where
     ``types`` maps family name -> type and ``families`` maps family name
     -> ordered ``{sample_key: float}``. Sample lines are attributed to
     the most recent ``# TYPE`` family (the renderer always emits TYPE
     immediately before its samples); strays land in an ``""``-typed
-    family of their own and merge as sums."""
+    family of their own and merge as sums.
+
+    Junk lines (truncated samples, non-numeric values) are tolerated —
+    a merge endpoint must not 500 — but no longer silently: pass a
+    ``report`` dict and ``report["dropped_lines"]`` accumulates the
+    count of lines that carried no usable sample."""
     types: dict[str, str] = {}
     families: dict[str, dict[str, float]] = {}
     current = None
+    dropped = 0
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -77,7 +92,8 @@ def parse_exposition(text: str):
             continue  # HELP / comments
         m = _SAMPLE.match(line)
         if not m:
-            continue  # tolerate junk — a merge endpoint must not 500
+            dropped += 1
+            continue
         key, raw = m.group(1), m.group(2)
         base = _base_name(key)
         # a sample belongs to `current` only if its name extends the
@@ -94,20 +110,33 @@ def parse_exposition(text: str):
         try:
             value = float(raw)
         except ValueError:
+            dropped += 1
             continue
         families[family][key] = value
+    if report is not None:
+        report["dropped_lines"] = report.get("dropped_lines", 0) + dropped
     return types, families
 
 
-def merge_expositions(texts) -> str:
+def merge_expositions(texts, report: dict | None = None) -> str:
     """Merge member expositions into one fleet-wide exposition (see the
     module docstring for per-type semantics). Preserves each family's
     first-seen sample order — bucket ``le`` ordering survives — and
-    emits families sorted by name, matching the renderer."""
+    emits families sorted by name, matching the renderer.
+
+    A member whose exposition is malformed or truncated degrades to a
+    PARTIAL merge: its parseable families still contribute, the
+    unusable lines are counted, and when any were dropped the merged
+    body carries a synthetic ``ratelimit_fleet_merge_dropped_lines``
+    counter so dashboards see the hole. ``report`` (optional dict)
+    receives ``dropped_lines`` (total) and ``per_text`` (per input)."""
     types: dict[str, str] = {}
     merged: dict[str, dict[str, float]] = {}
+    per_text: list[int] = []
     for text in texts:
-        t, families = parse_exposition(text)
+        tr: dict = {}
+        t, families = parse_exposition(text, tr)
+        per_text.append(tr.get("dropped_lines", 0))
         for name, kind in t.items():
             types.setdefault(name, kind)
         for name, samples in families.items():
@@ -128,6 +157,13 @@ def merge_expositions(texts) -> str:
                     # counters, histogram buckets/_sum/_count, summary
                     # _sum/_count, untyped strays: additive
                     out[key] += value
+    total_dropped = sum(per_text)
+    if report is not None:
+        report["dropped_lines"] = total_dropped
+        report["per_text"] = per_text
+    if total_dropped and DROPPED_FAMILY not in merged:
+        types[DROPPED_FAMILY] = "counter"
+        merged[DROPPED_FAMILY] = {DROPPED_FAMILY: float(total_dropped)}
     lines: list[str] = []
     for name in sorted(merged):
         kind = types.get(name, "")
@@ -148,12 +184,23 @@ def fleet_metrics(ports, host: str = "127.0.0.1", timeout: float = 2.0):
     """Scrape each member debug port and return ``(merged_text,
     errors)`` — errors is ``[(port, reason)]`` for members that did not
     answer (a dead-and-restarting worker must not fail the whole
-    scrape; its counters simply sit the round out)."""
+    scrape; its counters simply sit the round out) AND for members that
+    answered with a partially unparseable body (their good families
+    still merged; the reason records how many lines were dropped)."""
     texts = []
+    text_ports = []
     errors = []
     for port in ports:
         try:
             texts.append(scrape(f"http://{host}:{port}/metrics", timeout))
+            text_ports.append(port)
         except Exception as e:  # noqa: BLE001 - partial fleet still merges
             errors.append((port, str(e)))
-    return merge_expositions(texts), errors
+    report: dict = {}
+    merged = merge_expositions(texts, report)
+    for port, dropped in zip(text_ports, report.get("per_text", [])):
+        if dropped:
+            errors.append(
+                (port, f"partial parse: {dropped} line(s) dropped")
+            )
+    return merged, errors

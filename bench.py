@@ -1699,6 +1699,12 @@ def bench_service(
     # coalescing in the batcher on any host.
     n_threads = max(4, os.cpu_count() or 1)
     per_thread = max(25, (3200 if on_tpu else 800) // n_threads)
+    # BENCH_SERVICE_REQUESTS: total-request override for the smoke tier
+    # (tests/test_bench.py bench_smoke) — tiny drives keep the artifact
+    # schema exercisable under pytest without a real measurement window
+    req_target = int(os.environ.get("BENCH_SERVICE_REQUESTS", "0") or 0)
+    if req_target:
+        per_thread = max(1, req_target // n_threads)
     service, cache, store = _build_service(
         config_key, yaml_text, telemetry=True, on_tpu=on_tpu,
         lease=measure_lease,
@@ -1727,6 +1733,7 @@ def bench_service(
         # descriptors_per_request makes cross-round workload changes visible
         # — round 2 added the shadow descriptor to near_limit_local_cache)
         "rate": round(total * decisions_per_request / elapsed),
+        "n": int(total),
         "p50_ms": round(float(np.percentile(lat, 50)), 3),
         "p99_ms": p99,
         "descriptors_per_request": decisions_per_request,
@@ -2667,6 +2674,12 @@ _MP_OWNER_SRC = """\
 import os, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("XLA_FLAGS", None)
+aff = os.environ.get("BENCH_CPU_AFFINITY", "")
+if aff:
+    try:
+        os.sched_setaffinity(0, {{int(c) for c in aff.split(",")}})
+    except (AttributeError, ValueError, OSError):
+        pass
 sys.path.insert(0, {repo!r})
 import numpy as np
 from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
@@ -2698,6 +2711,12 @@ _MP_WORKER_SRC = """\
 import json, os, sys, threading, time
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("XLA_FLAGS", None)
+aff = os.environ.get("BENCH_CPU_AFFINITY", "")
+if aff:
+    try:
+        os.sched_setaffinity(0, {{int(c) for c in aff.split(",")}})
+    except (AttributeError, ValueError, OSError):
+        pass
 sys.path.insert(0, {repo!r})
 import random
 from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
@@ -2793,13 +2812,23 @@ def _run_mp_arm(td: str, tag: str, procs: int, n_threads: int, shm: bool,
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_CPU_AFFINITY", None)
+    # real per-process CPU affinity when the tier armed on a multi-core
+    # box: owner gets the last slice, worker i gets slice i — "procs=4"
+    # must mean four cores, not four names for one core
+    from tools import bench_driver as _bd
+
+    plan = _bd.cpu_affinity_plan(_bd.provenance.host_cpus(), procs + 1)
     sock = os.path.join(td, f"{tag}.sock")
     ctl = os.path.join(td, f"{tag}_ctl")
     go_path = os.path.join(td, f"{tag}.go")
+    owner_env = dict(env)
+    if plan is not None:
+        owner_env["BENCH_CPU_AFFINITY"] = _bd.affinity_env(plan[-1])
     owner = subprocess.Popen(
         [sys.executable, "-c", _MP_OWNER_SRC.format(repo=repo), sock, ctl,
          "1" if shm else "0"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=owner_env,
     )
     workers = []
     outs = [os.path.join(td, f"{tag}_w{i}.json") for i in range(procs)]
@@ -2810,12 +2839,15 @@ def _run_mp_arm(td: str, tag: str, procs: int, n_threads: int, shm: bool,
                 raise TimeoutError("mp owner never came up")
             time.sleep(0.02)
         for i in range(procs):
+            w_env = dict(env)
+            if plan is not None:
+                w_env["BENCH_CPU_AFFINITY"] = _bd.affinity_env(plan[i])
             workers.append(subprocess.Popen(
                 [sys.executable, "-c", _MP_WORKER_SRC.format(repo=repo),
                  sock, "1" if shm else "0", str(n_threads),
                  str(duration_s), go_path, outs[i]],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                env=env,
+                env=w_env,
             ))
         deadline = time.monotonic() + 240
         while not all(os.path.exists(o + ".ready") for o in outs):
@@ -2852,6 +2884,9 @@ def _run_mp_arm(td: str, tag: str, procs: int, n_threads: int, shm: bool,
     row = {
         "procs": procs,
         "threads_per_proc": n_threads,
+        # the worker→cpu pin map actually applied ([] slices = no pin);
+        # null = single-core box, nothing to pin
+        "cpu_affinity": plan,
         "n": int(lats.size),
         "rate": round(float(lats.size) / max(elapsed, 1e-9)),
         "p50_ms": round(float(np.percentile(lats, 50)), 3) if lats.size else 0,
@@ -3004,12 +3039,14 @@ def bench_cluster_scale(on_tpu: bool, left=lambda: 1e9) -> dict:
     from api_ratelimit_tpu.cluster.partition_map import PartitionMap
     from api_ratelimit_tpu.cluster.router import PartitionedEngineClient
 
+    from api_ratelimit_tpu.utils import provenance as _prov
+
     duration = float(os.environ.get("BENCH_CLUSTER_SECONDS", "3"))
     n_threads = int(os.environ.get("BENCH_CLUSTER_THREADS", "8"))
     rounds = 2
     tmp = tempfile.mkdtemp(prefix="bench-cluster-")
     out: dict = {
-        "host_cpus": os.cpu_count(),
+        "host_cpus": _prov.host_cpus(),
         "duration_s": duration,
         "threads": n_threads,
         "rows": {},
@@ -3097,8 +3134,10 @@ def bench_service_mp(on_tpu: bool, left=lambda: 1e9) -> dict:
     compares against."""
     import tempfile
 
+    from api_ratelimit_tpu.utils import provenance as _prov
+
     result: dict = {
-        "host_cpus": os.cpu_count(),
+        "host_cpus": _prov.host_cpus(),
         "duration_s": 3.0,
         "total_threads": 4,
         "rows": {},
@@ -3290,6 +3329,39 @@ def main() -> None:
         ).stdout.strip()
     except Exception:
         rev = ""
+    # hardware-gated tier arming (tools/bench_driver.py): the probe facts
+    # decide which tiers can produce MEANINGFUL numbers here — the
+    # multi-process tiers below skip-with-reason on a 1-core box instead
+    # of recording scheduler time-slicing as a scaling result (the
+    # r11/r13 caveat, made structural). The CRC'd provenance block rides
+    # every emitted line so the artifact self-describes its regime.
+    from api_ratelimit_tpu.utils import provenance as _provenance
+    from tools import bench_driver as _bench_driver
+
+    hw = {
+        "host_cpus": _provenance.host_cpus(),
+        "platform": device.platform,
+        "device_count": len(jax.devices()),
+    }
+    arming = _bench_driver.arm_tiers(hw, force=os.environ.get("BENCH_ARM"))
+    # BENCH_TIERS: CSV tier selection (the bench_smoke recipe runs just
+    # flat_per_second); unselected tiers are skip-marked, never absent
+    tiers_csv = os.environ.get("BENCH_TIERS", "").strip()
+    selected = (
+        {t.strip() for t in tiers_csv.split(",") if t.strip()}
+        if tiers_csv
+        else None
+    )
+
+    def tier_selected(name: str) -> bool:
+        return selected is None or name in selected
+
+    def skip_not_selected() -> dict:
+        return {"skipped": f"not selected (BENCH_TIERS={tiers_csv})"}
+
+    def skip_disarmed(tier: str) -> dict:
+        return {"skipped": arming[tier]["reason"]}
+
     result = {
         "metric": "rate_limit_decisions_per_sec_zipf10M",
         "value": 0,
@@ -3299,6 +3371,10 @@ def main() -> None:
         "git_rev": rev,
         "probe": probe_diag,
         "budget_s": budget,
+        "provenance": _provenance.build_provenance(
+            device.platform, len(jax.devices())
+        ),
+        "tiers": arming,
         "configs": configs,
     }
 
@@ -3335,26 +3411,34 @@ def main() -> None:
         emit()
 
     engine_extras = None
-    try:
-        engine, engine_extras = bench_engine_zipf(device, on_tpu, left, publish_engine)
+    if not tier_selected("zipf_10M_engine"):
+        engine = skip_not_selected()
         configs["zipf_10M_engine"] = engine
-        result["value"] = engine["rate"]
-        result["vs_baseline"] = round(engine["rate"] / TARGET, 4)
-    except Exception as e:
-        # the artifact must land even when the headline tier dies (OOM,
-        # Mosaic failure outside run_path's guard, tunnel loss mid-run) —
-        # merged INTO whatever publish_engine already measured, never
-        # replacing it
-        engine = configs.setdefault("zipf_10M_engine", {})
-        engine["error"] = str(e)[-400:]
-        import traceback
+    else:
+        try:
+            engine, engine_extras = bench_engine_zipf(
+                device, on_tpu, left, publish_engine
+            )
+            configs["zipf_10M_engine"] = engine
+            result["value"] = engine["rate"]
+            result["vs_baseline"] = round(engine["rate"] / TARGET, 4)
+        except Exception as e:
+            # the artifact must land even when the headline tier dies (OOM,
+            # Mosaic failure outside run_path's guard, tunnel loss mid-run)
+            # — merged INTO whatever publish_engine already measured, never
+            # replacing it
+            engine = configs.setdefault("zipf_10M_engine", {})
+            engine["error"] = str(e)[-400:]
+            import traceback
 
-        traceback.print_exc()
+            traceback.print_exc()
     emit()
 
     # the set-associative acceptance sweep: live-key load 10% -> 120% of
     # capacity, proving occupancy is a smooth gauge (no admission cliff)
-    if left() < 60:
+    if not tier_selected("slab_occupancy"):
+        configs["slab_occupancy"] = skip_not_selected()
+    elif left() < 60:
         configs["slab_occupancy"] = {"skipped": "budget"}
     else:
         try:
@@ -3367,7 +3451,9 @@ def main() -> None:
 
     # algorithm tier (round 12): window-edge burst across fixed vs
     # sliding vs GCRA, plus the concurrency-cap connection-churn tier
-    if left() < 45:
+    if not tier_selected("boundary_burst"):
+        configs["boundary_burst"] = skip_not_selected()
+    elif left() < 45:
         configs["boundary_burst"] = {"skipped": "budget"}
     else:
         try:
@@ -3383,7 +3469,9 @@ def main() -> None:
     # sketch-off interleaved overhead A/B, and the sketch→lease pre-seed
     # grant-efficiency A/B (ops/sketch.py; the observability claims stay
     # measurements)
-    if left() < 45:
+    if not tier_selected("hotkeys"):
+        configs["hotkeys"] = skip_not_selected()
+    elif left() < 45:
         configs["hotkeys"] = {"skipped": "budget"}
     else:
         try:
@@ -3400,6 +3488,9 @@ def main() -> None:
         ("shadow_mode", _SHADOW),
         ("lease_zipf", _LEASE_ZIPF),
     ):
+        if not tier_selected(key):
+            configs[key] = skip_not_selected()
+            continue
         if left() < 50:
             configs[key] = {"skipped": "budget"}
             continue
@@ -3443,7 +3534,9 @@ def main() -> None:
             configs[key] = {"error": str(e)[-300:]}
         emit()
 
-    if left() < 120:
+    if not tier_selected("sidecar"):
+        configs["sidecar"] = skip_not_selected()
+    elif left() < 120:
         configs["sidecar"] = {"skipped": "budget"}
     else:
         # the tier mutates this dict round by round and emit()s after each,
@@ -3458,8 +3551,14 @@ def main() -> None:
 
     # warm-standby failover (round 10): SIGKILL the primary device owner
     # under closed-loop load, report the blip p99 + the replication-off
-    # A/B arm — the availability claim stays a measurement, not a promise
-    if left() < 60:
+    # A/B arm — the availability claim stays a measurement, not a promise.
+    # Hardware-gated: owner + standby + driver threads time-slicing one
+    # core would report scheduler jitter as the failover blip.
+    if not tier_selected("failover_blip"):
+        configs["failover_blip"] = skip_not_selected()
+    elif not arming["failover_blip"]["armed"]:
+        configs["failover_blip"] = skip_disarmed("failover_blip")
+    elif left() < 60:
         configs["failover_blip"] = {"skipped": "budget"}
     else:
         try:
@@ -3471,7 +3570,13 @@ def main() -> None:
     # partitioned cluster (round 13): aggregate dec/s + p99 vs partition
     # count with the pre-cluster K=1 client as the interleaved rollback
     # arm — the scale-out claim stays a measurement
-    if left() < 90:
+    if not tier_selected("cluster_scale"):
+        configs["cluster_scale"] = skip_not_selected()
+    elif not arming["cluster_scale"]["armed"]:
+        # K partitions on one core would measure time-slicing, not
+        # scale-out — the r13 caveat, now a skip-with-reason
+        configs["cluster_scale"] = skip_disarmed("cluster_scale")
+    elif left() < 90:
         configs["cluster_scale"] = {"skipped": "budget"}
     else:
         try:
@@ -3483,7 +3588,13 @@ def main() -> None:
     # cross-process frontends (round 11): the FRONTEND_PROCS sweep with
     # the shm-ring vs socket-RPC arms interleaved at each level — the
     # GIL-split claim stays a measurement
-    if left() < 120:
+    if not tier_selected("service_mp"):
+        configs["service_mp"] = skip_not_selected()
+    elif not arming["service_mp"]["armed"]:
+        # the FRONTEND_PROCS sweep on one core measures the scheduler,
+        # not the GIL split — the r11 caveat, now a skip-with-reason
+        configs["service_mp"] = skip_disarmed("service_mp")
+    elif left() < 120:
         configs["service_mp"] = {"skipped": "budget"}
     else:
         try:
@@ -3507,12 +3618,21 @@ def main() -> None:
     # (MULTICHIP_r*.json is the real correctness gate) and must never
     # starve the tiers above (it burned round 3's artifact).
     try:
-        if left() < 60:
+        if "skipped" in engine:
+            pass  # the engine tier itself was deselected; nothing to shard
+        elif left() < 60:
             engine["sharded"] = {"skipped": "budget"}
+        elif not tier_selected("sharded"):
+            engine["sharded"] = skip_not_selected()
         elif max(n_mesh, len(jax.devices())) > 1:
             engine["sharded"] = bench_engine_sharded(
                 min(n_mesh or len(jax.devices()), len(jax.devices())), on_tpu
             )
+        elif not arming["sharded"]["armed"]:
+            # the virtual CPU-mesh shape check forks a full 8-device
+            # subprocess; on one core it starves the box for minutes to
+            # validate shapes MULTICHIP_r*.json already pins
+            engine["sharded"] = skip_disarmed("sharded")
         elif left() > 140:
             engine["sharded"] = _sharded_in_subprocess(8)
         else:

@@ -245,3 +245,63 @@ class TestWatchdog:
             sys.path.remove(REPO)
         t = bench._start_watchdog(3600.0, {}, lambda: None, _exit=lambda c: None)
         assert t.daemon
+
+
+class TestBenchSmoke:
+    """Tier-1 smoke of the full harness path (make bench_smoke): one tier
+    at a tiny request budget, every other tier explicitly skip-marked,
+    the provenance stamp verifying, the arming matrix present with the
+    1-core reasons, and the whole artifact bench_lint-clean."""
+
+    def test_smoke_artifact_schema(self):
+        env = _bench_env()
+        env["BENCH_TIERS"] = "flat_per_second"
+        env["BENCH_BUDGET_S"] = "90"
+        env["BENCH_SERVICE_REQUESTS"] = "200"
+        proc = subprocess.run(
+            [sys.executable, BENCH],
+            capture_output=True,
+            timeout=400,
+            env=env,
+            cwd=REPO,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        assert lines
+        last = json.loads(lines[-1])
+
+        # the provenance stamp verifies and matches the forced platform
+        sys.path.insert(0, REPO)
+        try:
+            from api_ratelimit_tpu.utils import provenance
+            from tools import bench_lint
+        finally:
+            sys.path.remove(REPO)
+        assert provenance.verify(last["provenance"]), last.get("provenance")
+        assert last["provenance"]["platform"] == "cpu"
+        # BENCH_TIERS is a stamped knob: the forced selection is visible
+        assert last["provenance"]["knobs"]["BENCH_TIERS"] == "flat_per_second"
+
+        # the arming matrix rides every artifact; on a 1-core box the
+        # multi-process tiers carry the host_cpus reason verbatim
+        tiers = last["tiers"]
+        if last["provenance"]["host_cpus"] == 1:
+            for tier in ("service_mp", "cluster_scale"):
+                assert not tiers[tier]["armed"]
+                assert "host_cpus=1 < 2" in tiers[tier]["reason"]
+
+        # the selected tier measured with real stage evidence...
+        flat = last["configs"]["flat_per_second"]
+        assert "skipped" not in flat
+        assert flat["n"] > 0 and flat["rate"] > 0
+        assert flat["stages"]["service_ms"]["count"] > 0
+        # ...and every other tier is skip-marked, never absent
+        for tier, body in last["configs"].items():
+            if tier == "flat_per_second":
+                continue
+            assert "skipped" in body, (tier, body)
+            assert "not selected" in body["skipped"], (tier, body)
+
+        # the artifact passes its own linter end to end
+        assert bench_lint.lint_artifact(last) == []

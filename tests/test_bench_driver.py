@@ -1,0 +1,274 @@
+"""Hardware-gated bench driver (tools/bench_driver.py): the arming
+matrix, the CPU-affinity plan, and the staged-run machinery generalized
+out of chipwatch. The contract under test is the one BENCH_r07..r15
+carried as prose caveats: a tier whose hardware prerequisites are not
+met must land in the artifact as skipped-with-a-reason that names the
+failed requirement — never as a misleading number."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import bench_driver
+
+
+class TestArmTiers:
+    def test_one_core_box_disarms_multiprocess_tiers(self):
+        """The acceptance regime: host_cpus=1 must disarm every
+        multi-process tier with the literal host_cpus reason."""
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 1, "platform": "cpu", "device_count": 1}
+        )
+        for tier in (
+            "service_mp",
+            "cluster_scale",
+            "failover_blip",
+            "fleet_saturation",
+            "sharded",
+        ):
+            assert not arming[tier]["armed"], tier
+            assert (
+                "host_cpus=1 < 2 (multi-process tier needs real cores)"
+                in arming[tier]["reason"]
+            ), (tier, arming[tier])
+
+    def test_cpu_box_disarms_device_tiers_with_window_reason(self):
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 8, "platform": "cpu", "device_count": 1}
+        )
+        for tier in ("pallas_slab", "device_sketch", "multichip_mesh"):
+            assert not arming[tier]["armed"], tier
+            assert "platform=cpu != tpu (no chip window)" in (
+                arming[tier]["reason"]
+            )
+        # ...while the multi-process tiers arm with the observed facts
+        for tier in ("service_mp", "cluster_scale", "fleet_saturation"):
+            assert arming[tier]["armed"], tier
+            assert "host_cpus=8" in arming[tier]["reason"]
+
+    def test_single_chip_tpu_arms_slab_but_not_mesh(self):
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 8, "platform": "tpu", "device_count": 1}
+        )
+        assert arming["pallas_slab"]["armed"]
+        assert arming["device_sketch"]["armed"]
+        assert not arming["multichip_mesh"]["armed"]
+        assert "device_count=1 < 2" in arming["multichip_mesh"]["reason"]
+
+    def test_sharded_device_escape_hatch(self):
+        """sharded needs host_cpus>=2 OR devices>=2: a 1-core box with a
+        real 2-device mesh still arms it."""
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 1, "platform": "tpu", "device_count": 2}
+        )
+        assert arming["sharded"]["armed"]
+        # and without the devices, the cpu requirement stands
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 1, "platform": "tpu", "device_count": 1}
+        )
+        assert not arming["sharded"]["armed"]
+
+    def test_bench_arm_forces_with_visible_reason(self):
+        """A forced run must be visibly a forced run in the artifact."""
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 1, "platform": "cpu", "device_count": 1},
+            force="service_mp,pallas_slab",
+        )
+        assert arming["service_mp"]["armed"]
+        assert arming["service_mp"]["reason"] == "forced by BENCH_ARM"
+        assert arming["pallas_slab"]["armed"]
+        assert not arming["cluster_scale"]["armed"]
+
+    def test_bench_arm_all(self):
+        arming = bench_driver.arm_tiers(
+            {"host_cpus": 1, "platform": "cpu", "device_count": 1},
+            force="all",
+        )
+        assert all(st["armed"] for st in arming.values())
+        assert all(
+            st["reason"] == "forced by BENCH_ARM" for st in arming.values()
+        )
+
+    def test_every_tier_has_a_nonempty_reason(self):
+        """The reason string is artifact contract (bench_lint checks the
+        skips carry it verbatim) — no tier may arm or skip silently."""
+        for hw in (
+            {"host_cpus": 1, "platform": "cpu", "device_count": 1},
+            {"host_cpus": 16, "platform": "tpu", "device_count": 4},
+        ):
+            for tier, st in bench_driver.arm_tiers(hw).items():
+                assert isinstance(st["reason"], str) and st["reason"], tier
+
+
+class TestAffinityPlan:
+    def test_one_core_returns_none(self):
+        assert bench_driver.cpu_affinity_plan(1, 4) is None
+
+    def test_round_robin_partition(self, monkeypatch):
+        monkeypatch.setattr(
+            os, "sched_getaffinity", lambda pid: {0, 1, 2, 3}
+        )
+        plan = bench_driver.cpu_affinity_plan(4, 2)
+        assert plan == [[0, 2], [1, 3]]
+        # disjoint slices covering the inventory
+        flat = [c for s in plan for c in s]
+        assert sorted(flat) == [0, 1, 2, 3]
+
+    def test_more_procs_than_cpus_wraps(self, monkeypatch):
+        monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0, 1})
+        plan = bench_driver.cpu_affinity_plan(2, 4)
+        assert len(plan) == 4
+        assert all(slice_ for slice_ in plan)  # every proc gets a pin
+
+    def test_affinity_env_round_trip(self, monkeypatch):
+        assert bench_driver.affinity_env([0, 2]) == "0,2"
+        applied = {}
+        monkeypatch.setattr(
+            os,
+            "sched_setaffinity",
+            lambda pid, cpus: applied.setdefault("cpus", set(cpus)),
+        )
+        monkeypatch.setenv(bench_driver.AFFINITY_ENV, "0,2")
+        assert bench_driver.apply_affinity_from_env()
+        assert applied["cpus"] == {0, 2}
+
+    def test_apply_affinity_ignores_junk(self, monkeypatch):
+        """A bad mask must never kill a measurement child."""
+        monkeypatch.setenv(bench_driver.AFFINITY_ENV, "zero,one")
+        assert not bench_driver.apply_affinity_from_env()
+        monkeypatch.delenv(bench_driver.AFFINITY_ENV)
+        assert not bench_driver.apply_affinity_from_env()
+
+
+class TestProbe:
+    def test_bench_platform_short_circuits(self, monkeypatch):
+        """Forced runs must not pay a subprocess probe."""
+        monkeypatch.setenv("BENCH_PLATFORM", "tpu")
+
+        def boom(*a, **k):
+            raise AssertionError("probe subprocess ran despite the force")
+
+        monkeypatch.setattr(bench_driver.subprocess, "run", boom)
+        hw = bench_driver.probe_hardware()
+        assert hw["platform"] == "tpu"
+        assert hw["probe"] == "forced by BENCH_PLATFORM"
+        assert hw["host_cpus"] >= 1
+
+    def test_failed_probe_defaults_to_cpu(self, monkeypatch):
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+
+        def boom(*a, **k):
+            raise OSError("no interpreter")
+
+        monkeypatch.setattr(bench_driver.subprocess, "run", boom)
+        hw = bench_driver.probe_hardware()
+        assert hw["platform"] == "cpu"
+        assert "defaulting to cpu" in hw["probe"]
+
+
+class TestRunStage:
+    """Outcome classification on real (tiny) subprocesses, per the
+    chipwatch contract: rc==0 without the marker is "fallback", and the
+    marker search is scoped to bytes THIS run appended."""
+
+    def test_ok_and_fallback_and_fail(self, tmp_path):
+        lp = str(tmp_path / "stage.log")
+        ok = bench_driver.run_stage(
+            "t_ok",
+            [sys.executable, "-c", "print('MARK_OK_7391')"],
+            30,
+            "MARK_OK_7391",
+            log_path=lp,
+        )
+        assert ok == "ok"
+        fb = bench_driver.run_stage(
+            "t_fb",
+            [sys.executable, "-c", "print('no marker here')"],
+            30,
+            "MARK_OK_7391",
+            log_path=lp,
+        )
+        assert fb == "fallback"
+        fail = bench_driver.run_stage(
+            "t_fail",
+            [sys.executable, "-c", "raise SystemExit(3)"],
+            30,
+            "MARK_OK_7391",
+            log_path=lp,
+        )
+        assert fail == "fail"
+
+    def test_stale_marker_does_not_satisfy(self, tmp_path):
+        """A marker left in the append-only log by a previous run must
+        not make the next run "ok"."""
+        lp = str(tmp_path / "stage.log")
+        with open(lp, "w") as f:
+            f.write("MARK_STALE_22\n")
+        outcome = bench_driver.run_stage(
+            "t_stale",
+            [sys.executable, "-c", "print('fresh, markerless')"],
+            30,
+            "MARK_STALE_22",
+            log_path=lp,
+        )
+        assert outcome == "fallback"
+
+    def test_timeout_kills_and_classifies(self, tmp_path):
+        lp = str(tmp_path / "stage.log")
+        outcome = bench_driver.run_stage(
+            "t_to",
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            1.0,
+            "NEVER",
+            log_path=lp,
+        )
+        assert outcome == "timeout"
+
+    def test_harvest_last_complete_json_line(self, tmp_path):
+        lp = str(tmp_path / "h.log")
+        with open(lp, "w") as f:
+            f.write('{"metric": "old"}\n')
+        offset = os.path.getsize(lp)
+        with open(lp, "a") as f:
+            f.write("noise\n")
+            f.write('{"metric": "new", "configs": {}}\n')
+            f.write('{"metric": "truncated", "configs"')  # no newline
+        doc = bench_driver.harvest_json_line(lp, offset)
+        assert doc == {"metric": "new", "configs": {}}
+        # offset-scoping: the pre-offset line is invisible
+        assert bench_driver.harvest_json_line(lp, offset) != {"metric": "old"}
+
+
+@pytest.mark.slow
+class TestProbeOnlyCli:
+    def test_probe_only_prints_matrix(self):
+        """--probe-only end to end: the printed doc must carry the full
+        arming matrix with reasons (what the acceptance run reads)."""
+        import subprocess
+
+        env = dict(os.environ)
+        env["BENCH_PLATFORM"] = "cpu"  # skip the jax subprocess probe
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.bench_driver", "--probe-only"],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        # log lines precede the indented JSON doc; it starts at the
+        # first line that IS "{"
+        lines = out.stdout.splitlines()
+        start = lines.index("{")
+        doc = json.loads("\n".join(lines[start:]))
+        assert set(doc) == {"hardware", "tiers"}
+        assert set(doc["tiers"]) == set(bench_driver.TIER_REQUIREMENTS)
+        for st in doc["tiers"].values():
+            assert st["reason"]

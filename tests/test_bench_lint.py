@@ -1,0 +1,250 @@
+"""Artifact-discipline tier (tier-1, jax-free): tools/bench_lint.py,
+tools/bench_report.py, utils/provenance.py and the loadgen histogram
+math. The sibling of tests/test_metrics_lint.py — the checked-in
+BENCH_r*.json rounds are linted here on every run, so a hand-edited or
+truncated artifact fails CI the same way a README metric-name drift
+does."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from api_ratelimit_tpu.utils import provenance
+from tools import bench_lint, bench_report
+
+
+def _good_doc():
+    return {
+        "metric": "rate_limit_decisions_per_sec_zipf10M",
+        "platform": "cpu",
+        "git_rev": "abc1234",
+        "provenance": provenance.build_provenance("cpu", 1),
+        "tiers": {
+            "service_mp": {
+                "armed": False,
+                "reason": "host_cpus=1 < 2 (multi-process tier needs real cores)",
+            },
+        },
+        "configs": {
+            "flat_per_second": {
+                "rate": 3000,
+                "n": 800,
+                "stages": {"service_ms": {"count": 800, "p50": 1.0}},
+            },
+            "service_mp": {
+                "skipped": "host_cpus=1 < 2 (multi-process tier needs real cores)"
+            },
+        },
+    }
+
+
+class TestProvenance:
+    def test_round_trip_verifies(self):
+        block = provenance.build_provenance("tpu", 4)
+        assert provenance.verify(block)
+        assert block["platform"] == "tpu"
+        assert block["device_count"] == 4
+        assert block["host_cpus"] >= 1
+
+    def test_tamper_fails_crc(self):
+        block = provenance.build_provenance("cpu", 1)
+        tampered = dict(block, host_cpus=block["host_cpus"] + 63)
+        assert not provenance.verify(tampered)
+        assert not provenance.verify(None)
+        assert not provenance.verify({"platform": "cpu"})
+
+    def test_marker_encodes_the_regime(self):
+        block = provenance.build_provenance("cpu", 1)
+        marker = provenance.platform_marker(block)
+        assert marker.startswith(f"cpu/dev1/cpus{block['host_cpus']}/")
+        # a lost core is a different regime
+        other = provenance.build_provenance("cpu", 1)
+        other["host_cpus"] += 1
+        assert provenance.platform_marker(other) != marker
+
+    def test_host_cpus_override_is_a_visible_knob(self, monkeypatch):
+        monkeypatch.setenv("BENCH_HOST_CPUS", "8")
+        assert provenance.host_cpus() == 8
+        block = provenance.build_provenance("cpu", 1)
+        assert block["knobs"]["BENCH_HOST_CPUS"] == "8"
+
+
+class TestBenchLint:
+    def test_clean_doc_lints_clean(self):
+        assert bench_lint.lint_artifact(_good_doc()) == []
+
+    def test_missing_provenance_is_a_finding(self):
+        doc = _good_doc()
+        del doc["provenance"]
+        findings = bench_lint.lint_artifact(doc)
+        assert any("provenance block missing" in f for f in findings)
+        # --legacy semantics: same doc, relaxed requirement
+        assert bench_lint.lint_artifact(doc, require_provenance=False) == []
+
+    def test_tampered_provenance_is_a_finding(self):
+        doc = _good_doc()
+        doc["provenance"]["host_cpus"] += 1
+        findings = bench_lint.lint_artifact(doc)
+        assert any("does not verify" in f for f in findings)
+
+    def test_bare_skip_is_a_finding(self):
+        doc = _good_doc()
+        doc["configs"]["cluster_scale"] = {"skipped": ""}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("skipped without a reason" in f for f in findings)
+
+    def test_rate_without_stage_evidence_is_a_finding(self):
+        doc = _good_doc()
+        doc["configs"]["flat_per_second"]["stages"] = {}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("stages block empty" in f for f in findings)
+
+    def test_disarmed_tier_with_measurements_is_a_finding(self):
+        doc = _good_doc()
+        doc["configs"]["service_mp"] = {"rate": 999, "procs": 4}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("disarmed" in f and "measurements" in f for f in findings)
+
+    def test_checked_in_r16_lints_clean(self):
+        path = os.path.join(REPO, "BENCH_r16.json")
+        assert bench_lint.lint_file(path) == []
+
+    def test_legacy_rounds_lint_under_legacy_flag(self):
+        """The pre-stamp rounds stay lintable (and renderable) without
+        being silently trusted: strict mode flags them, --legacy passes."""
+        path = os.path.join(REPO, "BENCH_r11.json")
+        strict = bench_lint.lint_file(path)
+        assert any("provenance" in f for f in strict)
+        assert bench_lint.lint_file(path, require_provenance=False) == []
+
+    def test_cli_exit_codes(self):
+        ok = subprocess.run(
+            [sys.executable, "-m", "tools.bench_lint", "BENCH_r16.json"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert ok.returncode == 0, ok.stderr[-300:]
+        strict = subprocess.run(
+            [sys.executable, "-m", "tools.bench_lint", "BENCH_r11.json"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert strict.returncode == 1
+        legacy = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.bench_lint",
+                "--legacy",
+                "BENCH_r11.json",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert legacy.returncode == 0, legacy.stderr[-300:]
+
+
+class TestBenchReport:
+    def test_trajectory_covers_every_checked_in_round(self):
+        rows = bench_report.build_rows(REPO)
+        rounds = {r["round"] for r in rows}
+        # the full r06..r16 span renders (earlier rounds too, where present)
+        for n in (6, 7, 11, 12, 16):
+            assert n in rounds, f"BENCH_r{n:02d}.json missing from rows"
+        by_round = {r["round"]: r for r in rows}
+        assert by_round[16]["source"] == "stamped"
+        assert by_round[7]["marker"] == "legacy/cpu/box-r07-2.2x-slower"
+        assert by_round[6]["marker"] == "legacy/cpu/box-r01"
+
+    def test_box_swap_refuses_comparison(self):
+        rows = bench_report.build_rows(REPO)
+        comparisons = bench_report.trajectory(rows)
+        gate = {(c["from"], c["to"]): c for c in comparisons}
+        assert not gate[(6, 7)]["comparable"]
+        assert "not comparable" in gate[(6, 7)]["refusal"]
+        assert gate[(11, 12)]["comparable"]
+        assert "engine_rate" in gate[(11, 12)]["delta_pct"]
+
+    def test_diff_refuses_cross_regime_with_exit_2(self):
+        rows = bench_report.build_rows(REPO)
+        code, text = bench_report.diff_rounds(rows, "r06", "r07")
+        assert code == 2
+        assert "REFUSED" in text
+        code, text = bench_report.diff_rounds(rows, "r11", "r12")
+        assert code == 0
+        assert "engine_rate" in text
+
+    def test_stamped_vs_legacy_refuses_even_on_same_box_story(self):
+        """A legacy row can never compare against a stamped one — the
+        legacy marker prefix makes collision impossible by design."""
+        rows = bench_report.build_rows(REPO)
+        code, text = bench_report.diff_rounds(rows, "15", "16")
+        assert code == 2 and "REFUSED" in text
+
+    def test_cli_smoke(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.bench_report", "--json"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-300:]
+        doc = json.loads(out.stdout)
+        assert doc["rounds"] and doc["trajectory"]
+        diff = subprocess.run(
+            [sys.executable, "-m", "tools.bench_report", "--diff", "r06", "r07"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert diff.returncode == 2
+        assert "REFUSED" in diff.stdout
+
+
+class TestLoadgenHistograms:
+    def test_merge_and_percentile(self):
+        from tools import loadgen
+
+        h1 = loadgen._new_hist()
+        h2 = loadgen._new_hist()
+        for ms in (0.5, 0.5, 2.0):
+            loadgen._observe(h1, ms)
+        for ms in (40.0, 40.0, 1e9):  # last lands in +Inf overflow
+            loadgen._observe(h2, ms)
+        merged = loadgen.merge_hists([h1, h2])
+        assert sum(merged) == 6
+        assert sum(h1) == 3 and sum(h2) == 3  # inputs untouched
+        p50 = loadgen.percentile_from_hist(merged, 0.50)
+        p99 = loadgen.percentile_from_hist(merged, 0.99)
+        assert p50 <= p99
+        from api_ratelimit_tpu.stats.store import DEFAULT_LATENCY_BUCKETS_MS
+
+        # the overflow observation clamps to the last finite edge
+        assert p99 == float(DEFAULT_LATENCY_BUCKETS_MS[-1])
+        assert loadgen.percentile_from_hist(loadgen._new_hist(), 0.99) == 0.0
+
+    def test_request_body_is_v3_shape(self):
+        from tools import loadgen
+
+        body = json.loads(loadgen._request_body("bench", "api_key", "k7"))
+        assert body["domain"] == "bench"
+        assert body["descriptors"][0]["entries"][0] == {
+            "key": "api_key",
+            "value": "k7",
+        }

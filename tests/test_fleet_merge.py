@@ -1,0 +1,185 @@
+"""Fleet exposition merge edge cases (stats/fleet.py).
+
+The fleet master's ``GET /metrics?fleet=1`` is ONE scrape target for N+1
+processes; the merge's per-name semantics are load-bearing: a summed
+high-water mark invents memory, a summed epoch invents config versions,
+and a summed ``ratelimit_build_host_cpus`` invents cores. And a worker
+that answers with a truncated or garbled body must degrade to a partial
+merge with a VISIBLE drop count, never a 500 and never a silent hole."""
+
+from api_ratelimit_tpu.stats.fleet import (
+    DROPPED_FAMILY,
+    GAUGE_MAX,
+    fleet_metrics,
+    merge_expositions,
+    parse_exposition,
+)
+
+
+def _line_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    raise AssertionError(f"{name} not in merged output:\n{text}")
+
+
+class TestMaxVsSum:
+    def test_hwm_and_epoch_take_max_counters_sum(self):
+        a = (
+            "# TYPE ratelimit_q_depth gauge\n"
+            "ratelimit_q_depth 4\n"
+            "# TYPE ratelimit_q_depth_hwm gauge\n"
+            "ratelimit_q_depth_hwm 9\n"
+            "# TYPE ratelimit_map_epoch gauge\n"
+            "ratelimit_map_epoch 3\n"
+            "# TYPE ratelimit_total_hits counter\n"
+            "ratelimit_total_hits 100\n"
+        )
+        b = (
+            "# TYPE ratelimit_q_depth gauge\n"
+            "ratelimit_q_depth 2\n"
+            "# TYPE ratelimit_q_depth_hwm gauge\n"
+            "ratelimit_q_depth_hwm 5\n"
+            "# TYPE ratelimit_map_epoch gauge\n"
+            "ratelimit_map_epoch 4\n"
+            "# TYPE ratelimit_total_hits counter\n"
+            "ratelimit_total_hits 50\n"
+        )
+        merged = merge_expositions([a, b])
+        # plain gauges (queue depth) add; marks and epochs take the max
+        assert _line_value(merged, "ratelimit_q_depth") == 6
+        assert _line_value(merged, "ratelimit_q_depth_hwm") == 9
+        assert _line_value(merged, "ratelimit_map_epoch") == 4
+        assert _line_value(merged, "ratelimit_total_hits") == 150
+
+    def test_build_family_takes_max_not_sum(self):
+        """Every member reports the same box: 4 workers summing
+        host_cpus=1 into 4 would manufacture the exact lie the arming
+        matrix exists to prevent."""
+        member = (
+            "# TYPE ratelimit_build_host_cpus gauge\n"
+            "ratelimit_build_host_cpus 1\n"
+            "# TYPE ratelimit_build_platform_id gauge\n"
+            "ratelimit_build_platform_id 0\n"
+        )
+        owner = (
+            "# TYPE ratelimit_build_host_cpus gauge\n"
+            "ratelimit_build_host_cpus 1\n"
+            "# TYPE ratelimit_build_platform_id gauge\n"
+            "ratelimit_build_platform_id 1\n"
+        )
+        merged = merge_expositions([member, member, member, owner])
+        assert _line_value(merged, "ratelimit_build_host_cpus") == 1
+        # the device owner's tpu platform_id (1) wins over frontend cpu
+        assert _line_value(merged, "ratelimit_build_platform_id") == 1
+
+    def test_gauge_max_regex_shape(self):
+        assert GAUGE_MAX.search("ratelimit_build_git_rev_hash")
+        assert GAUGE_MAX.search("ratelimit_slab_occupancy_hwm")
+        assert GAUGE_MAX.search("ratelimit_native_available")
+        assert not GAUGE_MAX.search("ratelimit_total_hits")
+        assert not GAUGE_MAX.search("ratelimit_queue_depth")
+
+
+class TestMalformedExposition:
+    GOOD = (
+        "# TYPE ratelimit_ok counter\n"
+        "ratelimit_ok 7\n"
+    )
+    BAD = (
+        "# TYPE ratelimit_ok counter\n"
+        "ratelimit_ok 5\n"
+        "ratelimit_truncated{le=\n"
+        "ratelimit_notanumber NaNope\n"
+    )
+
+    def test_parse_counts_dropped_lines(self):
+        report: dict = {}
+        _, families = parse_exposition(self.BAD, report)
+        assert report["dropped_lines"] == 2
+        assert families["ratelimit_ok"]["ratelimit_ok"] == 5.0
+
+    def test_partial_merge_with_synthetic_drop_counter(self):
+        report: dict = {}
+        merged = merge_expositions([self.GOOD, self.BAD], report)
+        # the parseable families of the garbled member still merged
+        assert _line_value(merged, "ratelimit_ok") == 12
+        assert report["dropped_lines"] == 2
+        assert report["per_text"] == [0, 2]
+        # and the merge emitted the visible synthetic counter
+        assert f"# TYPE {DROPPED_FAMILY} counter" in merged
+        assert _line_value(merged, DROPPED_FAMILY) == 2
+
+    def test_clean_merge_emits_no_drop_counter(self):
+        merged = merge_expositions([self.GOOD, self.GOOD])
+        assert DROPPED_FAMILY not in merged
+
+    def test_merged_output_passes_the_exposition_lint(self):
+        """The degraded merge is still a well-formed exposition."""
+        from tools.metrics_lint import lint_exposition
+
+        merged = merge_expositions([self.GOOD, self.BAD])
+        assert lint_exposition(merged) == []
+
+    def test_fleet_metrics_reports_partial_parse(self, monkeypatch):
+        import api_ratelimit_tpu.stats.fleet as fleet_mod
+
+        bodies = {7001: self.GOOD, 7002: self.BAD}
+
+        def fake_scrape(url, timeout=2.0):
+            port = int(url.split(":")[2].split("/")[0])
+            if port == 7003:
+                raise OSError("connection refused")
+            return bodies[port]
+
+        monkeypatch.setattr(fleet_mod, "scrape", fake_scrape)
+        merged, errors = fleet_metrics([7001, 7002, 7003])
+        assert _line_value(merged, "ratelimit_ok") == 12
+        reasons = dict(errors)
+        assert "connection refused" in reasons[7003]
+        assert reasons[7002] == "partial parse: 2 line(s) dropped"
+        assert 7001 not in reasons
+
+
+class TestHistogramMerge:
+    def test_bucket_sums_preserve_le_order(self):
+        member = (
+            "# TYPE ratelimit_lat_ms histogram\n"
+            'ratelimit_lat_ms_bucket{le="1"} 3\n'
+            'ratelimit_lat_ms_bucket{le="5"} 7\n'
+            'ratelimit_lat_ms_bucket{le="+Inf"} 9\n'
+            "ratelimit_lat_ms_sum 31\n"
+            "ratelimit_lat_ms_count 9\n"
+        )
+        merged = merge_expositions([member, member])
+        assert _line_value(merged, 'ratelimit_lat_ms_bucket{le="1"}') == 6
+        assert _line_value(merged, 'ratelimit_lat_ms_bucket{le="+Inf"}') == 18
+        assert _line_value(merged, "ratelimit_lat_ms_count") == 18
+        # first-seen ordering survives: le=1 before le=5 before +Inf
+        idx = {
+            key: i
+            for i, line in enumerate(merged.splitlines())
+            for key in [line.split(" ")[0]]
+        }
+        assert (
+            idx['ratelimit_lat_ms_bucket{le="1"}']
+            < idx['ratelimit_lat_ms_bucket{le="5"}']
+            < idx['ratelimit_lat_ms_bucket{le="+Inf"}']
+        )
+
+    def test_summary_quantiles_take_worst_member(self):
+        a = (
+            "# TYPE ratelimit_rt summary\n"
+            'ratelimit_rt{quantile="0.99"} 4.0\n'
+            "ratelimit_rt_sum 10\n"
+            "ratelimit_rt_count 5\n"
+        )
+        b = (
+            "# TYPE ratelimit_rt summary\n"
+            'ratelimit_rt{quantile="0.99"} 9.0\n'
+            "ratelimit_rt_sum 20\n"
+            "ratelimit_rt_count 7\n"
+        )
+        merged = merge_expositions([a, b])
+        assert _line_value(merged, 'ratelimit_rt{quantile="0.99"}') == 9.0
+        assert _line_value(merged, "ratelimit_rt_count") == 12

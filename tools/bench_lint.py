@@ -1,0 +1,194 @@
+"""Lint BENCH artifact schema — the sibling of tools/metrics_lint.py.
+
+A BENCH_r*.json row is a claim; this linter is what keeps claims
+honest before they enter the trajectory that tools/bench_report.py
+renders. It fails on:
+
+  * provenance-free rows: a stamped artifact must carry the CRC'd
+    provenance block (api_ratelimit_tpu/utils/provenance.py) and the
+    block must verify — a hand-edited or truncated block is a finding;
+  * bare skips: every ``{"skipped": ...}`` marker anywhere in the
+    artifact must carry a non-empty reason string ("budget",
+    "host_cpus=1 < 2 ...") — a tier that silently didn't run reads as
+    a tier that ran;
+  * empty evidence: a service tier that claims a rate must carry its
+    stage histogram block with a positive request count;
+  * arming drift: when the artifact carries a tier-arming matrix, every
+    un-armed tier that appears in configs must actually be skip- or
+    error-marked, not carry numbers a disarmed tier cannot have earned.
+
+``--legacy`` relaxes the provenance requirement for pre-round-16
+artifacts (BENCH_r01..r15 predate the stamp); everything else still
+applies, which is how the old rows stay render-able by bench_report
+without being silently trusted as comparable.
+
+Run standalone (``python tools/bench_lint.py BENCH_r16.json``; exit 1
+on findings) or via the tier-1 pytest wrapper. No jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from api_ratelimit_tpu.utils import provenance
+
+# every stamped bench.py artifact carries these; fleet artifacts carry
+# their own metric name but the same stamp
+REQUIRED_TOP = ("metric", "configs", "platform", "git_rev")
+
+
+def _iter_skips(node, path=""):
+    """Yield (path, reason) for every {"skipped": reason} marker."""
+    if isinstance(node, dict):
+        if "skipped" in node:
+            yield path, node["skipped"]
+        for k, v in node.items():
+            if k != "skipped":
+                yield from _iter_skips(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            yield from _iter_skips(v, f"{path}[{i}]")
+
+
+def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
+    """Returns human-readable findings (empty = clean)."""
+    findings: list = []
+    if not isinstance(doc, dict):
+        return ["artifact is not a JSON object"]
+
+    is_fleet = doc.get("metric") == "fleet_saturation"
+    if not is_fleet:
+        for field in REQUIRED_TOP:
+            if field not in doc:
+                findings.append(f"missing required top-level field {field!r}")
+
+    block = doc.get("provenance")
+    if require_provenance:
+        if block is None:
+            findings.append(
+                "provenance block missing (run through bench.py/"
+                "bench_driver, or lint with --legacy for pre-r16 rows)"
+            )
+        elif not provenance.verify(block):
+            findings.append(
+                "provenance block present but does not verify "
+                "(missing fields or CRC mismatch)"
+            )
+        elif not is_fleet and doc.get("platform") and str(
+            block.get("platform")
+        ) != str(doc.get("platform")):
+            findings.append(
+                f"provenance platform {block.get('platform')!r} disagrees "
+                f"with artifact platform {doc.get('platform')!r}"
+            )
+
+    # every skip marker must carry a real reason
+    for path, reason in _iter_skips(doc):
+        if not isinstance(reason, str) or not reason.strip():
+            findings.append(
+                f"{path or '<root>'}: skipped without a reason "
+                f"(got {reason!r})"
+            )
+
+    # a service tier claiming a rate must carry non-empty stage evidence
+    configs = doc.get("configs") or {}
+    if isinstance(configs, dict):
+        for tier, body in configs.items():
+            if not isinstance(body, dict) or "rate" not in body:
+                continue
+            stages = body.get("stages")
+            if stages is None:
+                continue  # engine-level tiers have no stage split
+            if not isinstance(stages, dict) or not stages:
+                findings.append(
+                    f"configs.{tier}: rate claimed but stages block empty"
+                )
+                continue
+            count = body.get("n") or stages.get("count") or next(
+                (
+                    v.get("count")
+                    for v in stages.values()
+                    if isinstance(v, dict) and v.get("count")
+                ),
+                None,
+            )
+            if not count:
+                findings.append(
+                    f"configs.{tier}: rate claimed but no positive request "
+                    f"count in stages"
+                )
+
+    # arming drift: a disarmed tier must not carry numbers
+    tiers = doc.get("tiers")
+    if isinstance(tiers, dict):
+        for tier, st in tiers.items():
+            if not isinstance(st, dict):
+                findings.append(f"tiers.{tier}: malformed arming entry")
+                continue
+            if "armed" not in st or not str(st.get("reason", "")).strip():
+                findings.append(
+                    f"tiers.{tier}: arming entry needs 'armed' and a "
+                    f"non-empty 'reason'"
+                )
+                continue
+            body = configs.get(tier) if isinstance(configs, dict) else None
+            if (
+                not st["armed"]
+                and isinstance(body, dict)
+                and "skipped" not in body
+                and "error" not in body
+            ):
+                findings.append(
+                    f"configs.{tier}: tier is disarmed "
+                    f"({st['reason']}) but carries measurements"
+                )
+    return findings
+
+
+def lint_file(path: str, require_provenance: bool = True) -> list:
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    lines = [ln for ln in text.splitlines() if ln.strip().startswith("{")]
+    if not lines:
+        return [f"{path}: no JSON line found"]
+    try:
+        doc = json.loads(lines[-1])
+    except ValueError as e:
+        return [f"{path}: last JSON line does not parse ({e})"]
+    return [
+        f"{path}: {finding}"
+        for finding in lint_artifact(doc, require_provenance)
+    ]
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    legacy = "--legacy" in argv
+    paths = [a for a in argv if a != "--legacy"]
+    if not paths:
+        print("usage: bench_lint.py [--legacy] BENCH_rNN.json ...",
+              file=sys.stderr)
+        return 2
+    findings: list = []
+    for path in paths:
+        findings.extend(lint_file(path, require_provenance=not legacy))
+    if findings:
+        for finding in findings:
+            print(f"bench-lint: {finding}", file=sys.stderr)
+        print(f"bench-lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(f"bench-lint: OK ({len(paths)} artifact(s) checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""Render the BENCH_r*.json perf trajectory with comparability gating.
+
+Every round's artifact is a claim taken in a hardware regime; comparing
+rows across regimes is how the "~2.2x slower box" caveat PERF.md has
+carried as prose since round 7 becomes a silent lie in a table. This
+report makes the gate structural:
+
+  * each round resolves to a **platform marker** — from the CRC'd
+    provenance block when the row is stamped (round 16 onward,
+    utils/provenance.py), or from the ``LEGACY_BOXES`` map for older
+    rows (rounds 1–6 ran on the original bench box; rounds 7–15 on the
+    replacement box measured ~2.2x slower on the same rev — the box
+    swap is the reason the map exists);
+  * the trajectory table prints every round with its marker, and the
+    round-over-round delta column is only computed when BOTH markers
+    match — a regime change prints an explicit ``not comparable`` line
+    instead of a percentage;
+  * ``--diff A B`` compares two rounds metric by metric and **refuses**
+    (exit 2) when their markers differ — the acceptance behavior: you
+    cannot diff r06 against r07 without forcing.
+
+Usage:
+    python -m tools.bench_report                 # trajectory table
+    python -m tools.bench_report --json          # machine-readable
+    python -m tools.bench_report --diff r11 r12  # gated pairwise diff
+
+No jax import — reading evidence must never need an accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from api_ratelimit_tpu.utils import provenance
+
+_ROUND_FILE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+# The box history behind pre-stamp rounds (PERF.md rounds 1-15): rounds
+# 1-6 ran on the original 1-core bench box; from round 7 the environment
+# moved to a replacement box that measured ~2.2x slower on an unchanged
+# rev (PERF.md r07 "the box, not the code"). Markers deliberately do NOT
+# collide with stamped markers (prefix "legacy/"), so an old row can
+# never silently compare against a stamped one even on lookalike
+# hardware — the legacy rows carry no cpu_model evidence to check.
+LEGACY_BOXES = [
+    (1, 6, "box-r01"),
+    (7, 15, "box-r07-2.2x-slower"),
+]
+
+
+def _legacy_box(round_no: int) -> str:
+    for lo, hi, name in LEGACY_BOXES:
+        if lo <= round_no <= hi:
+            return name
+    return f"box-unknown-r{round_no:02d}"
+
+
+def discover(repo: str = REPO) -> list:
+    """All (round_no, path) pairs, sorted by round."""
+    out = []
+    for name in os.listdir(repo):
+        m = _ROUND_FILE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(repo, name)))
+    return sorted(out)
+
+
+def load_artifact(path: str):
+    """Parse one round file: whole-file JSON, else the last complete
+    JSON line, else the artifact line embedded in a driver-wrapper
+    ``tail`` field (rounds 1-5 are wrapper captures)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+    if isinstance(doc, dict) and "tail" in doc and "configs" not in doc:
+        # driver wrapper: the bench line is the last parseable JSON
+        # object embedded in the captured tail
+        for line in reversed(str(doc["tail"]).splitlines()):
+            line = line.strip()
+            if line.startswith('{"metric"'):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        return doc
+    return doc
+
+
+def marker_for(round_no: int, doc) -> dict:
+    """Resolve one round's comparability marker. Stamped rows use the
+    verified provenance block; unverifiable or legacy rows fall back to
+    the box-history map and say so."""
+    block = (doc or {}).get("provenance")
+    if provenance.verify(block):
+        return {
+            "marker": provenance.platform_marker(block),
+            "source": "stamped",
+        }
+    platform = (doc or {}).get("platform") or "?"
+    return {
+        "marker": f"legacy/{platform}/{_legacy_box(round_no)}",
+        "source": (
+            "legacy box map"
+            if block is None
+            else "legacy box map (provenance present but unverifiable)"
+        ),
+    }
+
+
+def _count_skips(node) -> int:
+    if isinstance(node, dict):
+        return ("skipped" in node) + sum(
+            _count_skips(v) for k, v in node.items() if k != "skipped"
+        )
+    if isinstance(node, list):
+        return sum(_count_skips(v) for v in node)
+    return 0
+
+
+# the comparable headline metrics, as (label, extractor) pairs
+def _metrics(doc: dict) -> dict:
+    cfg = doc.get("configs") or {}
+    eng = cfg.get("zipf_10M_engine") or {}
+    flat = cfg.get("flat_per_second") or {}
+    out = {}
+    if isinstance(eng, dict) and isinstance(eng.get("rate"), (int, float)):
+        out["engine_rate"] = eng["rate"]
+    if isinstance(flat, dict):
+        if isinstance(flat.get("rate"), (int, float)):
+            out["flat_rate"] = flat["rate"]
+        if isinstance(flat.get("p99_ms"), (int, float)):
+            out["flat_p99_ms"] = flat["p99_ms"]
+    return out
+
+
+def build_rows(repo: str = REPO) -> list:
+    rows = []
+    for round_no, path in discover(repo):
+        doc = load_artifact(path)
+        entry = {
+            "round": round_no,
+            "file": os.path.basename(path),
+            "parsed": isinstance(doc, dict),
+        }
+        if not isinstance(doc, dict):
+            entry.update({"marker": "unparseable", "source": "none"})
+            rows.append(entry)
+            continue
+        entry.update(marker_for(round_no, doc))
+        entry["git_rev"] = doc.get("git_rev", "")
+        entry["metrics"] = _metrics(doc)
+        entry["skips"] = _count_skips(doc)
+        rows.append(entry)
+    return rows
+
+
+def trajectory(rows: list) -> list:
+    """Round-over-round comparisons, gated on marker equality. Each item
+    is either a computed delta set or an explicit refusal."""
+    out = []
+    prev = None
+    for row in rows:
+        if not row["parsed"] or not row.get("metrics"):
+            prev = None if not row["parsed"] else prev
+            continue
+        if prev is not None:
+            if prev["marker"] != row["marker"]:
+                out.append(
+                    {
+                        "from": prev["round"],
+                        "to": row["round"],
+                        "comparable": False,
+                        "refusal": (
+                            f"not comparable ({prev['marker']} vs "
+                            f"{row['marker']})"
+                        ),
+                    }
+                )
+            else:
+                deltas = {}
+                for k, v in row["metrics"].items():
+                    pv = prev["metrics"].get(k)
+                    if isinstance(pv, (int, float)) and pv:
+                        deltas[k] = round((v - pv) / pv * 100.0, 1)
+                out.append(
+                    {
+                        "from": prev["round"],
+                        "to": row["round"],
+                        "comparable": True,
+                        "delta_pct": deltas,
+                    }
+                )
+        prev = row
+    return out
+
+
+def render(rows: list, comparisons: list) -> str:
+    lines = []
+    lines.append(
+        f"{'round':>5}  {'rev':<8} {'engine_rate':>12} {'flat_rate':>10} "
+        f"{'flat_p99':>9} {'skips':>5}  marker"
+    )
+    for row in rows:
+        if not row["parsed"]:
+            lines.append(
+                f"{row['round']:>5}  {'-':<8} {'unparseable':>12} "
+                f"{'-':>10} {'-':>9} {'-':>5}  {row['marker']}"
+            )
+            continue
+        m = row.get("metrics", {})
+        lines.append(
+            f"{row['round']:>5}  {row.get('git_rev') or '-':<8} "
+            f"{m.get('engine_rate', '-'):>12} {m.get('flat_rate', '-'):>10} "
+            f"{m.get('flat_p99_ms', '-'):>9} {row.get('skips', 0):>5}  "
+            f"{row['marker']} [{row['source']}]"
+        )
+    lines.append("")
+    lines.append("round-over-round (marker-gated):")
+    for c in comparisons:
+        if c["comparable"]:
+            detail = ", ".join(
+                f"{k} {v:+.1f}%" for k, v in sorted(c["delta_pct"].items())
+            ) or "no shared metrics"
+            lines.append(f"  r{c['from']:02d} -> r{c['to']:02d}: {detail}")
+        else:
+            lines.append(
+                f"  r{c['from']:02d} -> r{c['to']:02d}: {c['refusal']}"
+            )
+    return "\n".join(lines)
+
+
+def diff_rounds(rows: list, a: str, b: str):
+    """Pairwise gated diff. Returns (exit_code, text)."""
+
+    def find(token: str):
+        token = token.lstrip("r")
+        try:
+            n = int(token)
+        except ValueError:
+            return None
+        for row in rows:
+            if row["round"] == n:
+                return row
+        return None
+
+    ra, rb = find(a), find(b)
+    if ra is None or rb is None:
+        return 2, f"unknown round(s): {a!r}, {b!r}"
+    if not (ra["parsed"] and rb["parsed"]):
+        return 2, "one of the rounds is unparseable"
+    if ra["marker"] != rb["marker"]:
+        return 2, (
+            f"REFUSED: r{ra['round']:02d} and r{rb['round']:02d} were "
+            f"measured in different regimes —\n"
+            f"  r{ra['round']:02d}: {ra['marker']} [{ra['source']}]\n"
+            f"  r{rb['round']:02d}: {rb['marker']} [{rb['source']}]\n"
+            f"a cross-regime percentage would be a hardware comparison "
+            f"wearing a perf-trajectory costume"
+        )
+    lines = [
+        f"r{ra['round']:02d} -> r{rb['round']:02d} ({ra['marker']}):"
+    ]
+    for k, va in sorted(ra["metrics"].items()):
+        vb = rb["metrics"].get(k)
+        if isinstance(vb, (int, float)) and va:
+            lines.append(
+                f"  {k}: {va} -> {vb} ({(vb - va) / va * 100.0:+.1f}%)"
+            )
+    if len(lines) == 1:
+        lines.append("  no shared metrics")
+    return 0, "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"))
+    args = ap.parse_args(argv)
+    rows = build_rows(args.repo)
+    if not rows:
+        print("no BENCH_r*.json artifacts found", file=sys.stderr)
+        return 1
+    if args.diff:
+        code, text = diff_rounds(rows, *args.diff)
+        print(text)
+        return code
+    comparisons = trajectory(rows)
+    if args.json:
+        print(json.dumps({"rounds": rows, "trajectory": comparisons}))
+    else:
+        print(render(rows, comparisons))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,12 +28,19 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the staged-run machinery (descendant-tree kill, offset-scoped marker
+# search, ok/fail/timeout/fallback classification) generalized into the
+# bench-driver subsystem; chipwatch keeps its chain semantics and env
+# overrides on top of it
+from tools import bench_driver as _driver
 STATE_PATH = "/tmp/chipwatch_state.json"
 # The probe must resolve the platform EXACTLY like the stages do
 # (respect_jax_platforms_env, then ask jax) and compare the last line
@@ -135,49 +142,20 @@ def probe(timeout_s: float = 90.0) -> bool:
 
 
 def _descendants(root: int) -> list:
-    """All live PIDs whose parent chain reaches `root` (/proc walk).
-
-    killpg alone is not enough here: intermediate wrapper processes can
-    re-group children, so a timed-out stage's grandchildren (bench
-    sidecar workers, pytest children) may sit in a different process
-    group while still holding the TPU runtime open."""
-    ppid: dict = {}
-    for ent in os.listdir("/proc"):
-        if not ent.isdigit():
-            continue
-        try:
-            with open(f"/proc/{ent}/stat") as f:
-                ppid[int(ent)] = int(f.read().rsplit(")", 1)[1].split()[1])
-        except (OSError, ValueError, IndexError):
-            continue
-    out, frontier = [], {root}
-    while frontier:
-        nxt = {p for p, pp in ppid.items() if pp in frontier and p not in out}
-        out.extend(nxt)
-        frontier = nxt
-    return out
+    """/proc PPID-walk descendant listing (tools/bench_driver.py)."""
+    return _driver.descendants(root)
 
 
 def _kill_tree(pid: int) -> None:
-    # Snapshot descendants BEFORE killing: the moment the direct child
-    # dies, its children reparent to init and the PPID walk can no
-    # longer find them.
-    victims = _descendants(pid)
-    try:
-        os.killpg(pid, signal.SIGKILL)
-    except (ProcessLookupError, PermissionError):
-        pass
-    for p in victims + _descendants(pid):
-        try:
-            os.kill(p, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+    """Snapshot-then-kill of the whole descendant tree (bench_driver)."""
+    _driver.kill_tree(pid)
 
 
 def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> str:
-    """Returns "ok" | "fail" | "timeout" | "fallback" (rc==0, no marker)."""
-    log(f"stage {name}: start (timeout {timeout_s:.0f}s)")
-    logpath = f"/tmp/chip_{name}.log"
+    """Returns "ok" | "fail" | "timeout" | "fallback" (rc==0, no marker).
+
+    The execution machinery lives in tools/bench_driver.run_stage;
+    chipwatch adds the chain's env overrides on top."""
     env = dict(os.environ)
     if name == "pallas_tests":
         env["TPU_TESTS"] = "1"
@@ -190,38 +168,15 @@ def run_stage(name: str, argv: list, timeout_s: float, marker: str) -> str:
         # it); slow-compile time is the usual cost, not measurement.
         env["BENCH_PLATFORM"] = "tpu"
         env.setdefault("BENCH_BUDGET_S", "780")
-    offset = os.path.getsize(logpath) if os.path.exists(logpath) else 0
-    with open(logpath, "ab") as lf:
-        lf.write(f"\n===== {time.ctime()} =====\n".encode())
-        lf.flush()
-        try:
-            # New session so a timeout can kill grandchildren too (bench
-            # sidecar workers, pytest children) — an orphan holding the
-            # TPU runtime would wedge every later probe in this driver.
-            proc = subprocess.Popen(
-                argv,
-                cwd=REPO,
-                stdout=lf,
-                stderr=subprocess.STDOUT,
-                env=env,
-                start_new_session=True,
-            )
-            rc = proc.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            _kill_tree(proc.pid)
-            proc.wait()
-            log(f"stage {name}: TIMEOUT after {timeout_s:.0f}s (log {logpath})")
-            return "timeout"
-    with open(logpath, "rb") as f:
-        f.seek(offset)
-        appended = f.read().decode(errors="replace")
-    ok = rc == 0 and marker in appended
-    log(f"stage {name}: rc={rc} marker_found={marker in appended} (log {logpath})")
-    if ok:
-        return "ok"
-    # rc==0 without the marker means the stage silently ran on the CPU
-    # fallback — a window problem, not a stage bug.
-    return "fail" if rc != 0 else "fallback"
+    return _driver.run_stage(
+        name,
+        argv,
+        timeout_s,
+        marker,
+        env=env,
+        log_path=f"/tmp/chip_{name}.log",
+        log_prefix="chipwatch",
+    )
 
 
 MAX_STAGE_FAILURES = 3

@@ -1,0 +1,329 @@
+"""Distributed closed-loop HTTP load generator for the frontend fleet.
+
+A single-process driver cannot saturate a FRONTEND_PROCS fleet: the
+fleet exists to split the GIL across processes, so a load plane sharing
+one GIL measures itself. This generator spawns N worker PROCESSES (each
+its own interpreter via ``-m tools.loadgen --worker``), each running M
+closed-loop threads that POST v3 RateLimitRequest JSON to the fleet's
+shared ``/json`` port; per-process latency histograms on the service's
+own bucket ladder (stats/store.py DEFAULT_LATENCY_BUCKETS_MS) are
+written to report files and merged client-side — bucket counts are
+additive across processes, exactly like the server-side fleet merge in
+stats/fleet.py. When ``fleet_metrics_url`` is given, the run brackets
+the measured window with fleet scrapes and reports the server-side
+decision-counter delta next to the client-observed rate, so over- or
+under-counting on either side is visible in one artifact.
+
+jax-free and stdlib-only (urllib): the load plane must boot in
+milliseconds and never compete with the fleet for an accelerator.
+
+Usage:
+    python -m tools.loadgen --url http://127.0.0.1:8080/json \
+        --procs 4 --threads 4 --seconds 5 --domain bench --key api_key
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the service's own latency ladder (stats/store.py) so client-side and
+# server-side histograms line up bucket for bucket
+from api_ratelimit_tpu.stats.store import DEFAULT_LATENCY_BUCKETS_MS
+
+
+def _new_hist() -> list:
+    # one count per finite bucket + one overflow slot (+Inf)
+    return [0] * (len(DEFAULT_LATENCY_BUCKETS_MS) + 1)
+
+
+def _observe(hist: list, ms: float) -> None:
+    for i, edge in enumerate(DEFAULT_LATENCY_BUCKETS_MS):
+        if ms <= edge:
+            hist[i] += 1
+            return
+    hist[-1] += 1
+
+
+def merge_hists(hists) -> list:
+    merged = _new_hist()
+    for h in hists:
+        for i, c in enumerate(h):
+            merged[i] += c
+    return merged
+
+
+def percentile_from_hist(hist: list, q: float) -> float:
+    """Upper-bound percentile estimate off the bucket counts (the same
+    conservative read a Prometheus scrape of the ladder would give).
+    Returns the +Inf bucket as the last finite edge."""
+    total = sum(hist)
+    if not total:
+        return 0.0
+    rank = q * total
+    seen = 0
+    for i, c in enumerate(hist):
+        seen += c
+        if seen >= rank:
+            if i < len(DEFAULT_LATENCY_BUCKETS_MS):
+                return float(DEFAULT_LATENCY_BUCKETS_MS[i])
+            return float(DEFAULT_LATENCY_BUCKETS_MS[-1])
+    return float(DEFAULT_LATENCY_BUCKETS_MS[-1])
+
+
+def _request_body(domain: str, key: str, value: str) -> bytes:
+    return json.dumps(
+        {
+            "domain": domain,
+            "descriptors": [{"entries": [{"key": key, "value": value}]}],
+        }
+    ).encode()
+
+
+def run_worker_process(spec: dict) -> dict:
+    """One driver process: closed-loop threads against the fleet port.
+    Per-status counts + one merged latency histogram; 429s are SUCCESSES
+    for the load plane (the limiter answered), transport errors are not."""
+    try:
+        # per-process pin from the parent's affinity plan (best-effort)
+        aff = os.environ.get("BENCH_CPU_AFFINITY", "").strip()
+        if aff:
+            os.sched_setaffinity(0, {int(c) for c in aff.split(",")})
+    except (AttributeError, ValueError, OSError):
+        pass
+    url = spec["url"]
+    n_threads = int(spec["threads"])
+    duration = float(spec["duration_s"])
+    bodies = [
+        _request_body(spec["domain"], spec["key"], f"k{i}")
+        for i in range(int(spec["n_keys"]))
+    ]
+    hist = _new_hist()
+    status_counts: dict = {}
+    errors = [0]
+    lock = threading.Lock()
+    t_end = time.monotonic() + duration
+
+    def worker(tid: int) -> None:
+        local_hist = _new_hist()
+        local_status: dict = {}
+        local_errors = 0
+        my = bodies[tid::n_threads] or bodies
+        i = 0
+        while time.monotonic() < t_end:
+            body = my[i % len(my)]
+            i += 1
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:  # noqa: S310
+                    resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                code = e.code
+            except Exception:  # noqa: BLE001 - transport failure IS the metric
+                local_errors += 1
+                continue
+            _observe(local_hist, (time.perf_counter() - t0) * 1e3)
+            local_status[code] = local_status.get(code, 0) + 1
+        with lock:
+            for j, c in enumerate(local_hist):
+                hist[j] += c
+            for code, c in local_status.items():
+                status_counts[code] = status_counts.get(code, 0) + c
+            errors[0] += local_errors
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    return {
+        "pid": os.getpid(),
+        "n": sum(hist),
+        "elapsed_s": round(elapsed, 3),
+        "hist": hist,
+        "status_counts": {str(k): v for k, v in status_counts.items()},
+        "transport_errors": errors[0],
+    }
+
+
+def _scrape(url: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+        return resp.read().decode("utf-8", errors="replace")
+
+
+def _counter_totals(text: str) -> dict:
+    """Fleet-exposition counter totals (plus histogram/summary _count
+    series), keyed by sample name — the server-side half of the pairing."""
+    from api_ratelimit_tpu.stats import fleet
+
+    _types, families = fleet.parse_exposition(text)
+    totals: dict = {}
+    for name, samples in families.items():
+        kind = _types.get(name, "")
+        for key, value in samples.items():
+            if kind == "counter" or key.endswith("_count"):
+                totals[key] = totals.get(key, 0.0) + value
+    return totals
+
+
+def run_distributed(
+    url: str,
+    procs: int,
+    threads: int,
+    duration_s: float,
+    domain: str = "bench",
+    key: str = "api_key",
+    n_keys: int = 512,
+    fleet_metrics_url: str | None = None,
+    affinity_plan=None,
+) -> dict:
+    """Spawn ``procs`` worker processes, merge their report files, and
+    (optionally) bracket the window with server-side fleet scrapes."""
+    spec = {
+        "url": url,
+        "threads": threads,
+        "duration_s": duration_s,
+        "domain": domain,
+        "key": key,
+        "n_keys": n_keys,
+    }
+    before = None
+    if fleet_metrics_url:
+        try:
+            before = _counter_totals(_scrape(fleet_metrics_url))
+        except Exception:  # noqa: BLE001 - scrape is evidence, not a gate
+            before = None
+    workers = []
+    outs = []
+    td = tempfile.mkdtemp(prefix="loadgen-")
+    for i in range(procs):
+        out_path = os.path.join(td, f"w{i}.json")
+        outs.append(out_path)
+        env = dict(os.environ)
+        if affinity_plan is not None and i < len(affinity_plan):
+            env["BENCH_CPU_AFFINITY"] = ",".join(
+                str(c) for c in affinity_plan[i]
+            )
+        workers.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "tools.loadgen",
+                    "--worker",
+                    json.dumps({**spec, "out": out_path}),
+                ],
+                cwd=REPO,
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    reports = []
+    deadline = time.monotonic() + duration_s + 120.0
+    for w, out_path in zip(workers, outs):
+        try:
+            w.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            w.kill()
+            w.wait()
+        if os.path.exists(out_path):
+            with open(out_path) as f:
+                reports.append(json.load(f))
+    after = None
+    if fleet_metrics_url and before is not None:
+        try:
+            after = _counter_totals(_scrape(fleet_metrics_url))
+        except Exception:  # noqa: BLE001
+            after = None
+    hist = merge_hists([r["hist"] for r in reports])
+    n = sum(hist)
+    elapsed = max((r["elapsed_s"] for r in reports), default=0.0)
+    status: dict = {}
+    for r in reports:
+        for code, c in r["status_counts"].items():
+            status[code] = status.get(code, 0) + c
+    result = {
+        "procs": procs,
+        "procs_reporting": len(reports),
+        "threads_per_proc": threads,
+        "n": n,
+        "rate": round(n / elapsed) if elapsed else 0,
+        "p50_ms": percentile_from_hist(hist, 0.50),
+        "p99_ms": percentile_from_hist(hist, 0.99),
+        "hist_buckets_ms": list(DEFAULT_LATENCY_BUCKETS_MS),
+        "hist": hist,
+        "status_counts": status,
+        "transport_errors": sum(r["transport_errors"] for r in reports),
+    }
+    if before is not None and after is not None:
+        deltas = {
+            k: round(after[k] - before.get(k, 0.0), 3)
+            for k in after
+            if after[k] - before.get(k, 0.0) > 0
+        }
+        # the headline pairing: what the SERVERS counted over the window
+        # next to what the CLIENTS observed
+        result["fleet_counter_deltas"] = dict(
+            sorted(deltas.items(), key=lambda kv: -kv[1])[:24]
+        )
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", help="internal: run one worker process")
+    ap.add_argument("--url", default="http://127.0.0.1:8080/json")
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--domain", default="bench")
+    ap.add_argument("--key", default="api_key")
+    ap.add_argument("--keys", type=int, default=512)
+    ap.add_argument("--fleet-url", help="master GET /metrics?fleet=1 URL")
+    args = ap.parse_args(argv)
+    if args.worker:
+        spec = json.loads(args.worker)
+        report = run_worker_process(spec)
+        tmp = spec["out"] + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f)
+        os.replace(tmp, spec["out"])
+        return 0
+    result = run_distributed(
+        url=args.url,
+        procs=args.procs,
+        threads=args.threads,
+        duration_s=args.seconds,
+        domain=args.domain,
+        key=args.key,
+        n_keys=args.keys,
+        fleet_metrics_url=args.fleet_url,
+    )
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
