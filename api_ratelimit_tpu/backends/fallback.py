@@ -159,6 +159,7 @@ class FallbackLimiter:
         scope=None,
         local_max_keys: int = 1 << 16,
         lease_table=None,
+        fed_shares=None,
     ):
         """lease_table: optional backends.lease.LeaseTable. When set, every
         descriptor is first offered to its outstanding lease (the device
@@ -166,7 +167,17 @@ class FallbackLimiter:
         remainder is answered by the configured rung — so an outage
         degrades lease-by-lease as TTLs run out instead of flipping the
         whole instance to the rung at once. An expired/exhausted lease
-        falls through to the rung exactly like the fail-open contract."""
+        falls through to the rung exactly like the fail-open contract.
+
+        fed_shares: optional cluster/federation.py FederationCoordinator.
+        Same discipline one level up: a descriptor whose (key, window) is
+        covered by the local federation share ledger — home budget this
+        cluster owns, or an outstanding share another cluster's home
+        pre-committed — is served from that REAL global budget, so a
+        cluster cut off from its peers keeps answering within its granted
+        slice before the failure-mode rung sees anything. Leases win over
+        shares (they're closer to the device truth); an exhausted share
+        falls through to the rung."""
         if mode not in FAILURE_MODES:
             raise ValueError(
                 f"failure mode must be one of {FAILURE_MODES}, got {mode!r}"
@@ -183,6 +194,7 @@ class FallbackLimiter:
                 base_limiter, max_keys=local_max_keys
             )
         self._lease = lease_table
+        self._fed = fed_shares
         self._lock = threading.Lock()
         self._degraded = False
         self._reason = ""
@@ -254,6 +266,30 @@ class FallbackLimiter:
                 if limit is None:
                     continue
                 status = self._lease.consume_for_fallback(
+                    request.domain,
+                    descriptor,
+                    limit,
+                    hits_addend,
+                    lease_response,
+                )
+                if status is not None:
+                    lease_statuses[i] = status
+        # Federation-share degradation (cluster/federation.py): the same
+        # real-budget discipline across clusters — descriptors covered by
+        # the local share ledger keep consuming global budget this cluster
+        # already owns (home headroom or outstanding peer-granted shares),
+        # so a WAN partition degrades share-by-share, bounded by the
+        # outstanding grants, before the rung answers anything. Leases
+        # take precedence: they carry the device owner's exact counters.
+        if self._fed is not None:
+            hits_addend = max(1, request.hits_addend)
+            for i, descriptor in enumerate(request.descriptors):
+                if i in lease_statuses:
+                    continue
+                limit = limits[i] if i < len(limits) else None
+                if limit is None:
+                    continue
+                status = self._fed.consume_for_fallback(
                     request.domain,
                     descriptor,
                     limit,

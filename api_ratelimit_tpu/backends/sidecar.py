@@ -153,6 +153,15 @@ OP_RESHARD_PUSH = 7  # u32 len | pack_table_bytes section -> merge stats
 # single-owner debug surface and the router's per-partition aggregation
 # (cluster/router.py cluster_snapshot) ride the same verb.
 OP_HOTKEYS_GET = 8
+# global-quota-federation exchange (cluster/federation.py): payload is
+# u32 fence-epoch | u16 name_len | borrower name; the connection then
+# becomes a framed request/response exchange (replication frame codec,
+# fed kinds) starting with the grantor's full-snapshot resync frame —
+# the second op that leaves the request/response rhythm, same shape as
+# OP_REPL_SUBSCRIBE. Owners without a FederationCoordinator answer the
+# standard error frame (FED_ENABLED=false serves the byte-identical
+# pre-federation protocol).
+OP_FED_EXCHANGE = 9
 # header flags (the u16 after op): bit 0 = B3 trace trailer appended,
 # bit 1 = lease-ops trailer appended (before the trace trailer),
 # bit 2 = u32 epoch trailer appended (after the lease trailer, before the
@@ -303,6 +312,7 @@ class SlabSidecarServer:
         repl=None,
         shm_control_path: str = "",
         cluster=None,
+        fed=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
 
@@ -344,6 +354,10 @@ class SlabSidecarServer:
         self._faults = fault_injector
         self._repl = repl
         self._cluster = cluster
+        # fed: optional cluster.federation.FederationCoordinator — when
+        # set, OP_FED_EXCHANGE connections become its exchange loops
+        # (borrower peers dialing this cluster's share ledger)
+        self._fed = fed
         # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
         # frontend PROCESSES publish row blocks straight into this
         # engine's dispatch loop through shared-memory rings registered
@@ -466,6 +480,18 @@ class SlabSidecarServer:
                         # the connection becomes this subscriber's ship
                         # loop; it never returns to request/response
                         self._repl.serve_subscriber(conn)
+                        return
+                    if op == OP_FED_EXCHANGE:
+                        if self._fed is None:
+                            conn.sendall(
+                                self._error("federation not configured")
+                            )
+                            return
+                        if net:
+                            conn.settimeout(None)
+                        # the connection becomes this borrower's exchange
+                        # loop; it never returns to request/response
+                        self._fed.serve_exchange(conn)
                         return
                     if op in (
                         OP_MAP_GET,

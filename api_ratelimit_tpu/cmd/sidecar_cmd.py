@@ -322,6 +322,48 @@ def main(argv=None) -> None:
             on_promote=lambda: [hook() for hook in on_promote_hooks],
         )
 
+    # Global quota federation (FED_ENABLED; cluster/federation.py): the
+    # device owner hosts this cluster's share ledger — peers dial our
+    # sidecar listener's OP_FED_EXCHANGE verb for grants and settlements,
+    # and our pump dials theirs. Built BEFORE the snapshotter so the
+    # ledger rides the fed.snap section of the warm-restart set.
+    # FED_ENABLED=false builds none of this: the pre-federation owner,
+    # byte-identical on the wire (the pinned rollback arm).
+    fed = None
+    (
+        fed_on,
+        fed_self,
+        fed_peers,
+        fed_min,
+        fed_max,
+        fed_interval,
+        fed_lag,
+        fed_ttl,
+    ) = settings.fed_config()
+    if fed_on:
+        from ..cluster.federation import FederationCoordinator
+
+        fed = FederationCoordinator(
+            fed_self,
+            fed_peers,
+            time_source=RealTimeSource(),
+            share_min=fed_min,
+            share_max=fed_max,
+            settle_interval_ms=fed_interval,
+            max_lag_ms=fed_lag,
+            share_ttl_ms=fed_ttl,
+            scope=scope,
+            fault_injector=fault_injector,
+        )
+        logger.warning(
+            "federation cluster %r joining %s (settle interval %.0fms, "
+            "share ttl %.0fms)",
+            fed_self,
+            sorted(fed_peers),
+            fed_interval,
+            fed._ttl_s * 1000.0,
+        )
+
     # Warm restart (persist/): the sidecar IS the device owner, so the
     # snapshot/restore cycle lives here — restore the shared slab before
     # accepting the first frontend connection, snapshot on the
@@ -348,6 +390,10 @@ def main(argv=None) -> None:
             # stamp this owner's keyspace slice into every shard header
             # so snapshot_inspect can tell which slice a file holds
             partition=snap_partition,
+            # the federation share ledger rides the snapshot set
+            # (fed.snap, FLAG_FED) so a restart never re-serves budget
+            # other clusters already hold
+            fed=fed,
         )
         if repl is None or not repl.is_standby:
             # explicit primary (or no replication): the original contract
@@ -369,6 +415,10 @@ def main(argv=None) -> None:
         health.add_degraded_probe(repl.degraded_reason)
     if snapshotter is not None:
         health.add_degraded_probe(snapshotter.stale_reason)
+    if fed is not None:
+        # WAN settlement lag past FED_MAX_LAG_MS: degraded-only — the
+        # cluster keeps serving its granted slice while divergence grows
+        health.add_degraded_probe(fed.degraded_reason)
 
     debug = new_debug_server(
         "",
@@ -403,6 +453,19 @@ def main(argv=None) -> None:
             )
 
         debug.add_get("/debug/hotkeys", handle_hotkeys)
+    if fed is not None:
+        import json as _fed_json
+
+        def handle_federation(h) -> None:
+            # the per-cluster ledger view: peer links, outstanding
+            # shares, settlement lag, the live overshoot bound
+            h._write(
+                200,
+                _fed_json.dumps(fed.describe(), indent=2).encode(),
+                content_type="application/json",
+            )
+
+        debug.add_get("/debug/federation", handle_federation)
     debug.serve_background()
     store.start_flushing()
     # shm submit rings (SHM_RINGS; backends/shm_ring.py): same-host
@@ -438,7 +501,13 @@ def main(argv=None) -> None:
         repl=repl,
         shm_control_path=shm_control,
         cluster=cluster_node,
+        fed=fed,
     )
+    if fed is not None:
+        # start the settle pump only once our own listener is up (a
+        # federation booting together must be able to find each other —
+        # same discipline as the replication auto role)
+        fed.start()
     if repl is not None:
         # resolve the auto role / start the standby subscription only
         # once our own listener is up (an auto pair booting together must
@@ -473,6 +542,10 @@ def main(argv=None) -> None:
         signal.signal(sig, on_signal)
     stop.wait()
     server.close()
+    if fed is not None:
+        # stop the settle pump before the final drain snapshot so the
+        # fed.snap section captures a quiescent ledger
+        fed.close()
     if repl is not None:
         repl.close()
     if snapshotter is not None:

@@ -117,15 +117,20 @@ def encode_frame(kind: int, epoch: int, seq: int, payload: bytes) -> bytes:
     )
 
 
-def read_frame(recv_exact) -> tuple[int, int, int, bytes]:
+def read_frame(
+    recv_exact, kinds: tuple = (KIND_SNAPSHOT, KIND_DELTA)
+) -> tuple[int, int, int, bytes]:
     """Read one frame via recv_exact(n) -> bytes; returns
     (kind, epoch, seq, payload). Raises ReplProtocolError on a malformed
-    or corrupt frame (the resync trigger)."""
+    or corrupt frame (the resync trigger). ``kinds`` is the acceptable
+    kind whitelist — replication's by default; the federation exchange
+    (cluster/federation.py) reuses this codec verbatim with its own
+    kind set."""
     raw = recv_exact(_FRAME_HDR.size)
     magic, kind, _pad, _res, epoch, seq, payload_len = _FRAME_HDR.unpack(raw)
     if magic != REPL_MAGIC:
         raise ReplProtocolError(f"bad replication frame magic {magic:#x}")
-    if kind not in (KIND_SNAPSHOT, KIND_DELTA):
+    if kind not in kinds:
         raise ReplProtocolError(f"bad replication frame kind {kind}")
     if payload_len > MAX_FRAME_PAYLOAD:
         raise ReplProtocolError(

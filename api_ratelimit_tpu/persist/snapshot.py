@@ -95,6 +95,12 @@ FLAG_LEASE_TABLE = 1
 # it); files without the flag parse exactly as before — byte-identical
 # unpartitioned format.
 FLAG_PARTITION = 2
+# FLAG_FED (cluster/federation.py): the federation share ledger — one
+# row per (fp, window) holding this cluster's quota-share state (tokens
+# granted in, spent locally, settled to the grantor, outstanding to
+# borrowers). Same (n, 8) uint32 shape as the other table kinds; the
+# flag keeps it from masquerading as a slab shard or lease table.
+FLAG_FED = 4
 FLAG_WAYS_SHIFT = 16
 
 _PARTITION_EXT = struct.Struct("<IIII")
@@ -112,6 +118,24 @@ LEASE_ROW_WIDTH = 8
     LEASE_COL_FLOOR,
     LEASE_COL_EXPIRE,
 ) = range(7)
+
+# Mirror of cluster/federation.py's share-ledger row layout (tests pin
+# equality). GRANTED/SPENT/SETTLED are the borrower-side share state for
+# the row's (fp, window); OUT is the grantor-side unsettled tokens still
+# outstanding at peers; SPENT doubles as the restored-counter watermark
+# (apply_fed_floors) — on the home cluster it holds the full committed
+# count (local spend + grants out), the never-double-grant floor.
+FED_ROW_WIDTH = 8
+(
+    FED_COL_FP_LO,
+    FED_COL_FP_HI,
+    FED_COL_WINDOW,
+    FED_COL_GRANTED,
+    FED_COL_SPENT,
+    FED_COL_SETTLED,
+    FED_COL_OUT,
+    FED_COL_EXPIRE,
+) = range(8)
 
 _HEADER = struct.Struct("<8sIIqQIIIIQ")
 _HEADER_CRC = struct.Struct("<I")
@@ -657,6 +681,65 @@ def apply_lease_floors(
         fp_lo, fp_hi = row[LEASE_COL_FP_LO], row[LEASE_COL_FP_HI]
         window = row[LEASE_COL_WINDOW]
         floor = row[LEASE_COL_FLOOR]
+        hit = False
+        for table in tables:
+            match = np.flatnonzero(
+                (table[:, COL_FP_LO] == fp_lo)
+                & (table[:, COL_FP_HI] == fp_hi)
+                & (table[:, COL_WINDOW] == window)
+            )
+            for idx in match:
+                hit = True
+                if table[idx, COL_COUNT] < floor:
+                    table[idx, COL_COUNT] = floor
+                    floored += 1
+        if not hit:
+            unmatched += 1
+    return floored, unmatched
+
+
+def reconcile_fed_shares(table: np.ndarray, now: int) -> tuple[np.ndarray, dict]:
+    """Reconcile a restored federation share ledger (cluster/federation.py
+    export_rows layout) against the current clock: TTL-dead rows and rows
+    with neither live borrowed balance (GRANTED > SPENT) nor unsettled
+    grantor-side outstanding (OUT > 0) are dropped — fully-settled state
+    carries no quota liability across a restart. Survivors re-seed the
+    coordinator and floor the restored slab counters. Returns
+    (kept rows, {'restored', 'dropped'})."""
+    table = np.asarray(table, dtype=np.uint32)
+    if table.ndim != 2 or table.shape[1] < FED_COL_EXPIRE + 1:
+        raise SnapshotError(
+            f"cannot reconcile fed share table of shape {table.shape}: "
+            f"need at least {FED_COL_EXPIRE + 1} row columns"
+        )
+    expire_at = table[:, FED_COL_EXPIRE].astype(np.int64)
+    granted = table[:, FED_COL_GRANTED].astype(np.int64)
+    spent = table[:, FED_COL_SPENT].astype(np.int64)
+    settled = table[:, FED_COL_SETTLED].astype(np.int64)
+    outstanding = table[:, FED_COL_OUT].astype(np.int64) > 0
+    fully_settled = (granted <= spent) & (settled >= spent) & ~outstanding
+    keep = (expire_at > np.int64(now)) & ~fully_settled
+    return table[keep], {
+        "restored": int(np.sum(keep)),
+        "dropped": int(np.sum(~keep)),
+    }
+
+
+def apply_fed_floors(
+    tables: list[np.ndarray], fed_rows: np.ndarray
+) -> tuple[int, int]:
+    """The federation analog of apply_lease_floors: every live share row
+    floors its slab row's counter at the SPENT watermark — on the home
+    cluster that is the full committed count (local spend + grants out),
+    so a slab snapshot older than a grant can never restore a counter
+    below budget other clusters are still serving from. Mutates the
+    reconciled tables in place; returns (rows floored, share rows whose
+    slab row was not found)."""
+    floored = unmatched = 0
+    for row in np.asarray(fed_rows, dtype=np.uint32):
+        fp_lo, fp_hi = row[FED_COL_FP_LO], row[FED_COL_FP_HI]
+        window = row[FED_COL_WINDOW]
+        floor = row[FED_COL_SPENT]
         hit = False
         for table in tables:
             match = np.flatnonzero(

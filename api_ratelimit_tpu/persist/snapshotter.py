@@ -36,14 +36,18 @@ import time
 import numpy as np
 
 from .snapshot import (
+    FED_ROW_WIDTH,
+    FLAG_FED,
     FLAG_LEASE_TABLE,
     LEASE_ROW_WIDTH,
     ROW_WIDTH,
     SNAPSHOT_VERSION,
     SnapshotError,
+    apply_fed_floors,
     apply_lease_floors,
     load_snapshot,
     migrate_rows_to_sets,
+    reconcile_fed_shares,
     reconcile_leases,
     reconcile_rows,
     write_snapshot,
@@ -70,6 +74,13 @@ def lease_snapshot_path(directory: str) -> str:
     registry is global, not per-shard), written with FLAG_LEASE_TABLE so
     it can never masquerade as a slab shard."""
     return os.path.join(directory, "leases.snap")
+
+
+def fed_snapshot_path(directory: str) -> str:
+    """The federation share-ledger section of the snapshot set (one file —
+    the ledger is global, not per-shard), written with FLAG_FED so it can
+    never masquerade as a slab shard or a lease table."""
+    return os.path.join(directory, "fed.snap")
 
 
 class SlabSnapshotter:
@@ -99,6 +110,7 @@ class SlabSnapshotter:
         scope=None,
         fault_injector=None,
         partition: tuple | None = None,
+        fed=None,
     ):
         if interval_ms <= 0:
             raise ValueError(
@@ -112,6 +124,10 @@ class SlabSnapshotter:
         # inspector can tell which slice a file holds; None keeps the
         # byte-identical unpartitioned format
         self._partition = partition
+        # optional cluster/federation.py FederationCoordinator: its share
+        # ledger rides the snapshot set (FLAG_FED section) so a restart
+        # never re-serves budget another cluster already holds
+        self._fed = fed
         self._interval_s = float(interval_ms) / 1e3
         # default staleness: 3 missed intervals — one in-flight write plus
         # real slack before the health surface starts reporting degraded
@@ -140,6 +156,7 @@ class SlabSnapshotter:
         self._g_bytes = self._g_age = None
         self._g_rows = self._g_dropped_expired = self._g_dropped_window = None
         self._g_leases = self._g_dropped_leases = None
+        self._g_fed = self._g_dropped_fed = None
         self._h_write = None
         if scope is not None:
             snap = scope.scope("snapshot")
@@ -153,6 +170,8 @@ class SlabSnapshotter:
             self._g_dropped_window = snap.gauge("restore_dropped_window")
             self._g_leases = snap.gauge("restore_leases")
             self._g_dropped_leases = snap.gauge("restore_dropped_leases")
+            self._g_fed = snap.gauge("restore_fed_shares")
+            self._g_dropped_fed = snap.gauge("restore_dropped_fed_shares")
             self._h_write = snap.histogram("write_ms")
             scope.add_stat_generator(self)
         os.makedirs(directory, exist_ok=True)
@@ -234,6 +253,24 @@ class SlabSnapshotter:
                             fault_injector=self._faults,
                             flags=FLAG_LEASE_TABLE,
                         )
+                # federation share-ledger section: the same liability
+                # discipline one level up — shares this cluster granted
+                # out, holds, or has committed locally ride the snapshot
+                # set so a restart floors restored counters at the live
+                # share watermarks instead of re-serving granted budget.
+                # Federation-free deployments keep the exact pre-fed
+                # snapshot set.
+                if self._fed is not None:
+                    fed_rows = self._fed.export_rows()
+                    fed_path = fed_snapshot_path(self._dir)
+                    if fed_rows.shape[0] or os.path.exists(fed_path):
+                        total += write_snapshot(
+                            fed_path,
+                            fed_rows,
+                            created_at=now,
+                            fault_injector=self._faults,
+                            flags=FLAG_FED,
+                        )
             except Exception as e:
                 self.write_errors_total += 1
                 if self._c_errors is not None:
@@ -309,6 +346,7 @@ class SlabSnapshotter:
                     totals[k] += stats[k]
                 tables.append(table)
             lease_stats = self._restore_leases(tables, now)
+            fed_stats = self._restore_fed(tables, now)
             self._engine.import_tables(tables)
         except (SnapshotError, OSError, ValueError) as e:
             self.load_rejected_total += 1
@@ -343,6 +381,7 @@ class SlabSnapshotter:
             ),
             **totals,
             **lease_stats,
+            **fed_stats,
         }
         return self.restore_stats
 
@@ -395,6 +434,63 @@ class SlabSnapshotter:
                 "lease liabilities restored: %d live (%d TTL-dead/settled "
                 "dropped), %d slab counters floored, %d liabilities "
                 "unmatched",
+                rec["restored"],
+                rec["dropped"],
+                floored,
+                unmatched,
+            )
+        return stats
+
+    def _restore_fed(self, tables: list[np.ndarray], now: int) -> dict:
+        """The federation-share half of restore: reconcile fed.snap against
+        the clock (TTL-dead and fully-settled shares drop —
+        snapshot.restore_dropped_fed_shares), floor the reconciled slab
+        counters at each live share's committed watermark (a restart must
+        never re-serve budget other clusters already hold), and re-seed
+        the coordinator's ledger (federation.import_rows also raises the
+        restart fence floor so pre-crash settlements are rejected as
+        stale-epoch). A bad fed file degrades to a slab-only restore
+        (counted in load_rejected), never a cold boot."""
+        path = fed_snapshot_path(self._dir)
+        stats = {"restored_fed_shares": 0, "dropped_fed_shares": 0}
+        if self._fed is None or not os.path.exists(path):
+            return stats
+        try:
+            header, rows = load_snapshot(path, fault_injector=self._faults)
+            if header.flags != FLAG_FED:
+                raise SnapshotError(
+                    f"{path}: flags {header.flags} is not a federation "
+                    f"share ledger"
+                )
+            if header.row_width != FED_ROW_WIDTH:
+                raise SnapshotError(
+                    f"{path}: fed row width {header.row_width} != "
+                    f"{FED_ROW_WIDTH}"
+                )
+            kept, rec = reconcile_fed_shares(rows, now)
+        except (SnapshotError, OSError, ValueError) as e:
+            self.load_rejected_total += 1
+            if self._c_rejected is not None:
+                self._c_rejected.inc()
+            _log.warning(
+                "federation share snapshot rejected (slab restores without "
+                "share floors): %s",
+                e,
+            )
+            return stats
+        floored, unmatched = apply_fed_floors(tables, kept)
+        self._fed.import_rows(kept, now)
+        stats = {
+            "restored_fed_shares": rec["restored"],
+            "dropped_fed_shares": rec["dropped"],
+        }
+        if self._g_fed is not None:
+            self._g_fed.set(rec["restored"])
+            self._g_dropped_fed.set(rec["dropped"])
+        if rec["restored"] or rec["dropped"]:
+            _log.info(
+                "federation shares restored: %d live (%d TTL-dead/settled "
+                "dropped), %d slab counters floored, %d shares unmatched",
                 rec["restored"],
                 rec["dropped"],
                 floored,

@@ -198,6 +198,7 @@ class Runner:
         self.fault_injector = None
         self.snapshotter = None
         self.lease_table = None
+        self.federation = None
         self._ready = threading.Event()
 
     def get_stats_store(self) -> Store:
@@ -392,6 +393,49 @@ class Runner:
                     self.lease_table.degraded_reason
                 )
 
+        # Global quota federation (FED_ENABLED; cluster/federation.py):
+        # an in-process device owner (BACKEND_TYPE=tpu) hosts its own
+        # FederationCoordinator — the share ledger peers exchange
+        # settlement frames against. Sidecar FRONTENDS don't build one
+        # (the device-owner process, cmd/sidecar_cmd.py, owns the ledger
+        # exactly like it owns the slab). FED_ENABLED=false keeps every
+        # layer byte-identical to the pre-federation build (the pinned
+        # rollback arm).
+        self.federation = None
+        (
+            fed_on,
+            fed_self,
+            fed_peers,
+            fed_min,
+            fed_max,
+            fed_interval,
+            fed_lag,
+            fed_ttl,
+        ) = settings.fed_config()
+        if fed_on and settings.backend_type == "tpu":
+            from .cluster.federation import FederationCoordinator
+
+            self.federation = FederationCoordinator(
+                fed_self,
+                fed_peers,
+                time_source=RealTimeSource(),
+                share_min=fed_min,
+                share_max=fed_max,
+                settle_interval_ms=fed_interval,
+                max_lag_ms=fed_lag,
+                share_ttl_ms=fed_ttl,
+                scope=self.scope,
+                fault_injector=self.fault_injector,
+            )
+            self.federation.bind_base(base)
+            self.server.health.add_degraded_probe(
+                self.federation.degraded_reason
+            )
+            self.server.add_debug_endpoint(
+                "/debug/federation",
+                lambda: json.dumps(self.federation.describe(), indent=2),
+            )
+
         cache = create_limiter(
             settings, base, self.stats_store, self.fault_injector,
             self.overload, self.lease_table,
@@ -479,6 +523,7 @@ class Runner:
                 time_source=RealTimeSource(),
                 scope=self.scope,
                 fault_injector=self.fault_injector,
+                fed=self.federation,
             )
             self.snapshotter.restore()
             self.snapshotter.start()
@@ -508,8 +553,11 @@ class Runner:
                 base_limiter=base,
                 scope=self.scope,
                 # outstanding leases answer before the rung does: real
-                # device-granted budget outlives the device (lease.py)
+                # device-granted budget outlives the device (lease.py);
+                # federation shares answer next — global budget this
+                # cluster already owns survives a WAN cut (federation.py)
                 lease_table=self.lease_table,
+                fed_shares=self.federation,
             )
             self.server.health.set_degraded_probe(
                 self.fallback.degraded_reason
@@ -547,6 +595,8 @@ class Runner:
 
         self.server.add_debug_endpoint("/rlconfig", dump_config)
         self.server.register_service(self.service, self.scope.scope("service"))
+        if self.federation is not None:
+            self.federation.start()
         self.runtime.start_watching()
         self.stats_store.start_flushing()
 
@@ -577,6 +627,11 @@ class Runner:
     def _teardown(self) -> None:
         if self.runtime is not None:
             self.runtime.stop()
+        if self.federation is not None:
+            # stop the settle pump BEFORE the final drain snapshot so the
+            # fed.snap section captures a quiescent ledger
+            federation, self.federation = self.federation, None
+            federation.close()
         if self.snapshotter is not None:
             # drain handoff: quiesce the engine and take the final
             # snapshot — the state the next process warm-boots from

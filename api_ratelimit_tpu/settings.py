@@ -358,6 +358,43 @@ class Settings:
     # min(SLAB_WAYS, lanes). 128 = one TPU lane register of head keys —
     # top-16 reporting with 8x slack for churn.
     hotkey_lanes: int = 128
+    # --- global quota federation (cluster/federation.py) ---
+    # FED_ENABLED turns on multi-cluster quota federation: each key's
+    # home cluster (deterministic over the sorted FED_PEERS membership)
+    # owns the global limit and hands *quota shares* to borrower
+    # clusters over OP_FED_EXCHANGE — the lease algebra one level up,
+    # so global overshoot is bounded by outstanding inter-cluster
+    # shares. false (the default) is the byte-identical rollback arm:
+    # no coordinator is built, no wire op is served, the decide path is
+    # exactly the pre-federation pipeline (pinned by test, same
+    # discipline as HOST_FAST_PATH / DISPATCH_LOOP / LEASE_ENABLED).
+    fed_enabled: bool = False
+    # FED_SELF: this cluster's name in the membership (must appear in
+    # FED_PEERS). Required when FED_ENABLED.
+    fed_self: str = ""
+    # FED_PEERS: full cluster membership incl. this cluster, as
+    # comma-separated name=sidecar-address entries, e.g.
+    #   us=/run/us.sock,eu=tcp://10.0.0.2:7070
+    # Home assignment hashes over the SORTED names, so every member
+    # must configure the identical set.
+    fed_peers: str = ""
+    # adaptive share sizing bounds: a borrower's first share request for
+    # a key asks FED_SHARE_MIN tokens, doubles on renew-after-exhaustion
+    # up to FED_SHARE_MAX, and shrinks toward 1 while settlement is
+    # degraded or the home pool nears the limit (the lease ladder)
+    fed_share_min: int = 8
+    fed_share_max: int = 1024
+    # settlement cadence: borrowers ship cumulative spent watermarks to
+    # each home every FED_SETTLE_INTERVAL_MS
+    fed_settle_interval_ms: float = 50.0
+    # settlement lag past this flips the sticky fed.degraded probe and
+    # shrinks local share sizing toward 1; 0 defaults to five settle
+    # intervals (the repl_config discipline)
+    fed_max_lag_ms: float = 0.0
+    # share lease TTL: a grant not settled/renewed within this window is
+    # reclaimed by the grantor (the peer-death bound); 0 defaults to
+    # ten settle intervals
+    fed_share_ttl_ms: float = 0.0
 
     def latency_buckets(self) -> tuple[float, ...] | None:
         """Parsed METRICS_LATENCY_BUCKETS_MS, or None for the default.
@@ -627,6 +664,95 @@ class Settings:
                 f"({self.sidecar_socket!r})"
             )
         return role, interval, max_lag if max_lag > 0 else 5.0 * interval
+
+    def fed_config(self) -> tuple[bool, str, dict, int, int, float, float, float]:
+        """Validated (enabled, self_name, peers, share_min, share_max,
+        settle_interval_ms, max_lag_ms, share_ttl_ms) for global quota
+        federation (cluster/federation.py); enabled=False builds no
+        coordinator (the byte-identical rollback arm). Junk fails the
+        boot like every other knob — a typo'd membership must not
+        silently become a different home assignment, and a lag bound
+        below the settle cadence would flap the fed.degraded probe
+        every interval. max_lag 0 defaults to five settle intervals,
+        share TTL 0 to ten."""
+        share_min = int(self.fed_share_min)
+        share_max = int(self.fed_share_max)
+        if share_min < 1:
+            raise ValueError(f"FED_SHARE_MIN must be >= 1, got {share_min}")
+        if share_max < share_min:
+            raise ValueError(
+                f"FED_SHARE_MAX ({share_max}) must be >= FED_SHARE_MIN "
+                f"({share_min})"
+            )
+        interval = float(self.fed_settle_interval_ms)
+        if interval <= 0:
+            raise ValueError(
+                f"FED_SETTLE_INTERVAL_MS must be > 0, got {interval}"
+            )
+        max_lag = float(self.fed_max_lag_ms)
+        if max_lag < 0:
+            raise ValueError(f"FED_MAX_LAG_MS must be >= 0, got {max_lag}")
+        if 0 < max_lag < interval:
+            raise ValueError(
+                f"FED_MAX_LAG_MS ({max_lag}) must not sit below "
+                f"FED_SETTLE_INTERVAL_MS ({interval})"
+            )
+        ttl = float(self.fed_share_ttl_ms)
+        if ttl < 0:
+            raise ValueError(f"FED_SHARE_TTL_MS must be >= 0, got {ttl}")
+        if 0 < ttl < interval:
+            raise ValueError(
+                f"FED_SHARE_TTL_MS ({ttl}) must not sit below "
+                f"FED_SETTLE_INTERVAL_MS ({interval})"
+            )
+        max_lag = max_lag if max_lag > 0 else 5.0 * interval
+        ttl = ttl if ttl > 0 else 10.0 * interval
+        if not self.fed_enabled:
+            return False, "", {}, share_min, share_max, interval, max_lag, ttl
+        self_name = self.fed_self.strip()
+        if not self_name:
+            raise ValueError("FED_ENABLED needs FED_SELF to name this cluster")
+        raw = self.fed_peers.strip()
+        if not raw:
+            raise ValueError(
+                "FED_ENABLED needs FED_PEERS to name the full membership "
+                "(comma-separated name=address, incl. this cluster)"
+            )
+        peers: dict = {}
+        from .backends.sidecar import parse_sidecar_address
+
+        for entry in raw.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, sep, addr = entry.partition("=")
+            name, addr = name.strip(), addr.strip()
+            if not sep or not name or not addr:
+                raise ValueError(
+                    f"bad FED_PEERS entry {entry!r}: want name=address"
+                )
+            if name in peers:
+                raise ValueError(f"duplicate FED_PEERS name {name!r}")
+            try:
+                parse_sidecar_address(addr)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad FED_PEERS address for {name!r}: {e}"
+                ) from e
+            peers[name] = addr
+        if len(peers) < 2:
+            raise ValueError(
+                f"FED_PEERS must name at least two clusters, got {len(peers)}"
+            )
+        if self_name not in peers:
+            raise ValueError(
+                f"FED_SELF {self_name!r} does not appear in FED_PEERS "
+                f"({sorted(peers)})"
+            )
+        return (
+            True, self_name, peers,
+            share_min, share_max, interval, max_lag, ttl,
+        )
 
     def cluster_config(self) -> tuple[int, list[list[str]], int, float]:
         """Validated (partitions, addr_groups, route_sets,
@@ -902,6 +1028,14 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("hotkeys_enabled", "HOTKEYS_ENABLED", _parse_bool),
     ("hotkey_k", "HOTKEY_K", int),
     ("hotkey_lanes", "HOTKEY_LANES", int),
+    ("fed_enabled", "FED_ENABLED", _parse_bool),
+    ("fed_self", "FED_SELF", str),
+    ("fed_peers", "FED_PEERS", str),
+    ("fed_share_min", "FED_SHARE_MIN", int),
+    ("fed_share_max", "FED_SHARE_MAX", int),
+    ("fed_settle_interval_ms", "FED_SETTLE_INTERVAL_MS", float),
+    ("fed_max_lag_ms", "FED_MAX_LAG_MS", float),
+    ("fed_share_ttl_ms", "FED_SHARE_TTL_MS", float),
 ]
 
 

@@ -80,6 +80,24 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
                             resync), error/torn_write/corrupt poison the
                             frame so the standby must resync off a fresh
                             snapshot, never apply suspect bytes
+    fed.exchange            global quota federation, BORROWER side
+                            (cluster/federation.py): before each exchange
+                            frame send — delay_ms models WAN settlement
+                            lag (-> the sticky fed.degraded probe), drop
+                            consumes the sequence number without sending
+                            (the home sees the gap and drops the
+                            connection), corrupt flips a frame byte (the
+                            home's CRC check drops the connection),
+                            torn_write sends half a frame, error fails
+                            the pump; every arm resyncs from the home's
+                            full ledger snapshot on reconnect
+    fed.apply               global quota federation, HOME side: before
+                            each received exchange frame applies —
+                            delay_ms stalls the grantor, drop loses the
+                            frame pre-apply (the borrower times out and
+                            resyncs), error/torn_write/corrupt poison
+                            the frame so the connection drops, never a
+                            suspect grant or settle
 
 The injector is mutable at runtime (configure()/clear()) so chaos tests can
 clear faults mid-scenario — e.g. to watch a circuit breaker's half-open

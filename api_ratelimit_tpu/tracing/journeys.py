@@ -79,6 +79,11 @@ FLAG_FAILOVER = "failover"
 # last drain (backends/tpu.py drain_hotkeys): "slow AND hot" is the gold
 # tail-sample — contention on the hot head, not a cold-path stall
 FLAG_HOTKEY = "hotkey"
+# a descriptor in this request was served from a federation quota share
+# (cluster/federation.py consume_for_fallback): the cluster answered from
+# budget another cluster's home pre-committed — relaxed-consistency
+# traffic worth spotting in the tail
+FLAG_FED = "fed"
 
 
 class Journey:

@@ -30,6 +30,7 @@ class TestArmTiers:
             "cluster_scale",
             "failover_blip",
             "fleet_saturation",
+            "fed_divergence",
             "sharded",
         ):
             assert not arming[tier]["armed"], tier
@@ -48,7 +49,12 @@ class TestArmTiers:
                 arming[tier]["reason"]
             )
         # ...while the multi-process tiers arm with the observed facts
-        for tier in ("service_mp", "cluster_scale", "fleet_saturation"):
+        for tier in (
+            "service_mp",
+            "cluster_scale",
+            "fleet_saturation",
+            "fed_divergence",
+        ):
             assert arming[tier]["armed"], tier
             assert "host_cpus=8" in arming[tier]["reason"]
 

@@ -1,0 +1,922 @@
+"""Global quota federation acceptance suite (cluster/federation.py).
+
+Pins the PR's robustness contract end to end over real loopback
+sockets: the INCRBY-rider grant discipline (a healthy federation never
+overshoots — budget is committed at grant time), the replication frame
+discipline on the exchange wire (gap/CRC/injected faults -> drop the
+connection and resync from a full grantor snapshot), partition
+tolerance (zero failed requests on both sides of a WAN cut; measured
+global overshoot bounded by the unsettled shares the home reclaimed —
+differential against testing/oracle.py), peer-death reclamation (TTL
+and SIGKILL'd borrower subprocess -> the home re-tightens the global
+limit and fences the resurrected peer's late settlements), the fed.snap
+restart story, the FallbackLimiter share-ledger rung, and the
+FED_ENABLED=false byte-identical rollback arm (the TestRollbackArm
+discipline from tests/test_replication.py).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from api_ratelimit_tpu.backends import sidecar as sc
+from api_ratelimit_tpu.backends.fallback import (
+    FAILURE_MODE_DENY,
+    FallbackLimiter,
+)
+from api_ratelimit_tpu.cluster import federation as fed_mod
+from api_ratelimit_tpu.cluster.federation import (
+    KIND_FED_FENCE,
+    KIND_FED_SETTLE,
+    FederationCoordinator,
+    _Share,
+)
+from api_ratelimit_tpu.limiter.base_limiter import BaseRateLimiter
+from api_ratelimit_tpu.limiter.cache import CacheError
+from api_ratelimit_tpu.models import (
+    Code,
+    Descriptor,
+    RateLimitRequest,
+    Unit,
+)
+from api_ratelimit_tpu.ops.hashing import fingerprint64
+from api_ratelimit_tpu.persist.snapshot import (
+    FED_COL_EXPIRE,
+    FED_COL_GRANTED,
+    FED_COL_OUT,
+    FED_COL_SETTLED,
+    FED_COL_SPENT,
+    FED_COL_WINDOW,
+    FED_ROW_WIDTH,
+    FLAG_FED,
+    load_snapshot,
+    reconcile_fed_shares,
+    write_snapshot,
+)
+from api_ratelimit_tpu.testing.faults import FaultInjector
+from api_ratelimit_tpu.testing.oracle import occurrence_rank
+from api_ratelimit_tpu.tracing import journeys
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NOW = 1_000_000
+W = NOW  # the single window label every scenario spends against
+D = W + 10_000  # far-future deadline: tests control GC via the clock
+
+
+class _FedNet:
+    """N in-process federation clusters wired over real loopback TCP,
+    with a cuttable WAN between them. Listener sockets are bound FIRST
+    (their ports seed the peers dict), then coordinators, then accept
+    loops that hand OP_FED_EXCHANGE connections to serve_exchange —
+    the same shape as the production sidecar dispatch."""
+
+    def __init__(self, ts, names=("east", "west"), faults=None, **kw):
+        self.ts = ts
+        self._closing = threading.Event()
+        self._partitioned = threading.Event()
+        self._conn_lock = threading.Lock()
+        self._conns: list = []
+        self.listeners: dict = {}
+        peers = {}
+        for name in names:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(16)
+            self.listeners[name] = srv
+            peers[name] = f"tcp://127.0.0.1:{srv.getsockname()[1]}"
+        self.peers = peers
+        kw.setdefault("share_min", 8)
+        kw.setdefault("share_max", 64)
+        kw.setdefault("settle_interval_ms", 50.0)
+        kw.setdefault("share_ttl_ms", 5_000.0)
+        kw.setdefault("breaker_reset_s", 0.05)
+        self.coords = {
+            name: FederationCoordinator(
+                name,
+                peers,
+                ts,
+                fault_injector=(faults or {}).get(name),
+                **kw,
+            )
+            for name in names
+        }
+        for name in names:
+            threading.Thread(
+                target=self._accept_loop, args=(name,), daemon=True
+            ).start()
+
+    def _accept_loop(self, name):
+        srv = self.listeners[name]
+        while not self._closing.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            if self._partitioned.is_set():
+                conn.close()  # the WAN cut: dials are reset
+                continue
+            with self._conn_lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(name, conn), daemon=True
+            ).start()
+
+    def _serve(self, name, conn):
+        try:
+            hdr = fed_mod._recv_exact(conn, sc._HDR.size)
+            _magic, _version, op, _flags = sc._HDR.unpack(hdr)
+            if op == sc.OP_FED_EXCHANGE:
+                self.coords[name].serve_exchange(conn)
+        except (OSError, ConnectionError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def partition(self):
+        """Cut the WAN: live exchanges severed, new dials reset."""
+        self._partitioned.set()
+        with self._conn_lock:
+            for conn in self._conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+            self._conns.clear()
+
+    def heal(self):
+        self._partitioned.clear()
+
+    def close(self):
+        self._closing.set()
+        for coord in self.coords.values():
+            coord.close()
+        for srv in self.listeners.values():
+            try:
+                srv.close()
+            except OSError:
+                pass
+
+
+@pytest.fixture
+def make_net():
+    nets = []
+
+    def _make(ts=None, **kw):
+        net = _FedNet(ts or FakeTimeSource(NOW), **kw)
+        nets.append(net)
+        return net
+
+    yield _make
+    for net in nets:
+        net.close()
+
+
+def _dummy_peers():
+    # parse-only addresses: never dialed in membership-level tests
+    return {"east": "tcp://127.0.0.1:1", "west": "tcp://127.0.0.1:2"}
+
+
+def _borrowed_unsettled(coord, home_name):
+    with coord._lock:
+        return sum(
+            max(0, s.spent - s.settled)
+            for (fp, _w), s in coord._shares.items()
+            if coord.home_of(fp) == home_name
+        )
+
+
+def _borrowed_watermark(coord, home_name):
+    with coord._lock:
+        return sum(
+            max(0, s.granted - s.settled)
+            for (fp, _w), s in coord._shares.items()
+            if coord.home_of(fp) == home_name
+        )
+
+
+class TestMembership:
+    def test_home_assignment_is_deterministic_over_sorted_members(self):
+        ts = FakeTimeSource(NOW)
+        east = FederationCoordinator("east", _dummy_peers(), ts)
+        west = FederationCoordinator("west", _dummy_peers(), ts)
+        # sorted(("east", "west")) -> even fps home east, odd home west
+        for fp in range(16):
+            want = ("east", "west")[fp % 2]
+            assert east.home_of(fp) == want
+            assert west.home_of(fp) == want
+        assert east.is_home(2) and not east.is_home(3)
+
+    def test_home_consume_spends_to_the_limit_then_denies(self):
+        east = FederationCoordinator(
+            "east", _dummy_peers(), FakeTimeSource(NOW)
+        )
+        for _ in range(5):
+            assert east.consume(2, W, 5, deadline=D)
+        assert not east.consume(2, W, 5, deadline=D)
+        assert east._used[(2, W)] == 5
+
+    def test_borrower_without_share_denies_and_queues_a_want(self):
+        west = FederationCoordinator(
+            "west", _dummy_peers(), FakeTimeSource(NOW)
+        )
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west._wants[(2, W)] == (100, D)
+
+    def test_membership_junk_is_rejected(self):
+        ts = FakeTimeSource(NOW)
+        with pytest.raises(ValueError, match="missing from peers"):
+            FederationCoordinator("north", _dummy_peers(), ts)
+        with pytest.raises(ValueError, match="at least two"):
+            FederationCoordinator(
+                "east", {"east": "tcp://127.0.0.1:1"}, ts
+            )
+
+
+class TestExchange:
+    def test_grant_settle_happy_path(self, make_net):
+        net = make_net()
+        east, west = net.coords["east"], net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west.pump()["east"] == "ok"
+        # the INCRBY rider: the share entered east's committed count at
+        # grant time, before west served a single request from it
+        assert east._used[(2, W)] == 8
+        assert west.share_balance() == 8
+        for _ in range(8):
+            assert west.consume(2, W, 100, deadline=D)
+        assert not west.consume(2, W, 100, deadline=D)  # dry -> want
+        assert west.pump()["east"] == "ok"  # settle 8 + renewed grant
+        share = west._shares[(2, W)]
+        assert share.settled == 8
+        # renew-after-exhaustion doubled the share (8 -> 16)
+        assert share.granted == 24
+        assert east._used[(2, W)] == 24
+        assert east.outstanding_tokens() == 16
+        assert east.grants_total == 2 and east.settles_total == 1
+        assert west.resyncs_total == 1  # the connect handshake snapshot
+
+    def test_healthy_federation_never_overshoots(self, make_net):
+        """Admits across both clusters stay inside the global limit with
+        zero settlement help — grants are pre-counted."""
+        net = make_net()
+        east, west = net.coords["east"], net.coords["west"]
+        admitted = 0
+        for _ in range(12):
+            for _ in range(4):
+                admitted += bool(east.consume(2, W, 10, deadline=D))
+                admitted += bool(west.consume(2, W, 10, deadline=D))
+            west.pump()
+            east.pump()
+        assert admitted <= 10
+        assert east._used[(2, W)] <= 10
+        # and the limit is fully reachable once grants land
+        assert admitted == 10
+
+    def test_grants_shrink_toward_one_near_the_limit(self, make_net):
+        net = make_net()
+        east, west = net.coords["east"], net.coords["west"]
+        assert east.consume(2, W, 40, n=36, deadline=D)  # home at 90%
+        assert not west.consume(2, W, 40, deadline=D)
+        west.pump()
+        # want was share_min=8, headroom 4, near-limit clamp -> 2
+        assert west.share_balance() == 2
+        assert east._used[(2, W)] == 38
+
+
+class TestFrameDiscipline:
+    """Injected fed.exchange / fed.apply faults all land in the same
+    drop-the-connection-and-resync discipline as replication."""
+
+    def _borrow_ok(self, west):
+        if not west.consume(2, W, 100, deadline=D):
+            west.pump()
+        return west.consume(2, W, 100, deadline=D)
+
+    def test_exchange_corrupt_drops_connection_then_resyncs(self, make_net):
+        faults = FaultInjector.from_spec("fed.exchange:corrupt:1")
+        net = make_net(faults={"west": faults})
+        west = net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west.pump()["east"].startswith("error:")
+        assert west.exchange_errors_total == 1
+        assert faults.fired()["fed.exchange:corrupt"] == 1
+        assert west._links["east"].sock is None  # dropped, not limping
+        faults.clear()
+        assert west.pump()["east"] == "ok"
+        assert west.resyncs_total == 2  # fresh handshake snapshot
+        assert west.consume(2, W, 100, deadline=D)
+
+    def test_exchange_torn_write_drops_then_resyncs(self, make_net):
+        faults = FaultInjector.from_spec("fed.exchange:torn_write:1")
+        net = make_net(faults={"west": faults})
+        west = net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west.pump()["east"].startswith("error:")
+        faults.clear()
+        assert west.pump()["east"] == "ok"
+        assert west.consume(2, W, 100, deadline=D)
+
+    def test_apply_error_is_a_protocol_disconnect(self, make_net):
+        faults = FaultInjector.from_spec("fed.apply:error:1")
+        net = make_net(faults={"east": faults})
+        west = net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west.pump()["east"].startswith("error:")
+        faults.clear()
+        assert west.pump()["east"] == "ok"
+        assert west.consume(2, W, 100, deadline=D)
+
+    def test_apply_drop_times_out_and_resyncs(self, make_net):
+        """A frame lost home-side pre-apply never gets a reply: the
+        borrower times out (~1s read deadline), drops, and resyncs."""
+        faults = FaultInjector.from_spec("fed.apply:drop:1")
+        net = make_net(faults={"east": faults})
+        west = net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        assert west.pump()["east"].startswith("error:")
+        faults.clear()
+        assert west.pump()["east"] == "ok"
+        assert west.consume(2, W, 100, deadline=D)
+
+    def test_stale_frame_kind_is_rejected(self, make_net):
+        """The exchange whitelist: a replication KIND_SNAPSHOT=1 frame
+        on the fed wire is a protocol error, not a silent misread."""
+        net = make_net()
+        east = net.coords["east"]
+        with pytest.raises(fed_mod.ReplProtocolError):
+            east._apply_exchange_frame("west", 1, 0, b"")
+
+
+class TestReclamationAndFencing:
+    def _grant_and_settle(self, net, spent=3, settled=3):
+        """west borrows 8 for key 2, spends `spent`, settles `settled`
+        of it (settled <= spent)."""
+        east, west = net.coords["east"], net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        west.pump()  # grant 8
+        for _ in range(settled):
+            assert west.consume(2, W, 100, deadline=D)
+        west.pump()  # settle watermark
+        for _ in range(spent - settled):
+            assert west.consume(2, W, 100, deadline=D)
+        return east, west
+
+    def test_ttl_reclaim_re_tightens_and_fences_the_borrower(self, make_net):
+        net = make_net()
+        east, west = self._grant_and_settle(net, spent=5, settled=3)
+        assert east.outstanding_tokens() == 5  # granted 8 - settled 3
+        net.ts.advance(6)  # past the 5s share TTL, no renewal
+        reclaimed = east.reclaim_sweep()
+        assert reclaimed == 5
+        assert east.reclaimed_tokens_total == 5
+        assert east._used[(2, W)] == 3  # the global limit re-tightened
+        assert east._fence["west"] == 1
+        # the partitioned borrower keeps serving its residual balance —
+        # exactly the overshoot the bound permits
+        for _ in range(3):
+            assert west.consume(2, W, 100, deadline=D)
+        # global double-count is bounded by what was reclaimed
+        spent_total = west._shares[(2, W)].spent
+        assert spent_total <= 3 + reclaimed
+        # the late settlement rides the LIVE connection with the old
+        # epoch: rejected with a pinned count, then the borrower adopts
+        # the new fence and re-requests
+        assert west.pump()["east"] == "ok"
+        assert east.stale_epoch_rejected_total == 1
+        assert west.resyncs_total == 2  # handshake + fence adoption
+        assert west._links["east"].epoch == 1
+        # serving resumes under the new epoch
+        assert not west.consume(2, W, 100, deadline=D)
+        west.pump()
+        assert west.consume(2, W, 100, deadline=D)
+        assert east.stale_epoch_rejected_total == 1  # no further rejects
+
+    def test_breaker_open_borrower_is_reclaimed_before_ttl(self, make_net):
+        net = make_net()
+        east, _west = self._grant_and_settle(net, spent=3, settled=3)
+        link = east._links["west"]
+        for _ in range(3):  # trip the dial breaker (threshold 3)
+            link.breaker.record_failure()
+        reclaimed = east.reclaim_sweep()  # TTL still live
+        assert reclaimed == 5  # granted 8 - settled 3
+        assert east._fence["west"] == 1
+
+    def test_restart_fence_floor_rejects_pre_crash_settlements(
+        self, make_net
+    ):
+        net = make_net()
+        east, _west = self._grant_and_settle(net, spent=5, settled=3)
+        rows = east.export_rows()
+        # "east" restarts: fresh coordinator, ledger from the snapshot
+        east2 = FederationCoordinator(
+            "east", net.peers, net.ts, share_ttl_ms=5_000.0
+        )
+        kept, _stats = reconcile_fed_shares(rows, net.ts.now)
+        assert east2.import_rows(kept, now=net.ts.now) == 1
+        assert east2._fence_floor == net.ts.now
+        assert east2._used[(2, W)] == 8  # committed count survives
+        # the resurrected borrower's pre-crash watermark is stale
+        kind, fence, _payload = east2._apply_exchange_frame(
+            "west", KIND_FED_SETTLE, 0, fed_mod._pack_rows([(2, W, 5, 0)])
+        )
+        assert kind == KIND_FED_FENCE
+        assert fence >= net.ts.now
+        assert east2.stale_epoch_rejected_total == 1
+        # ...but the parked liability can still be reclaimed
+        net.ts.advance(6)
+        assert east2.reclaim_sweep() == 5
+        assert east2._used[(2, W)] == 3
+
+
+# phase-A round shapes: each side home-spends its own keys and borrows
+# the peer's — evens home east, odds home west
+EAST_ROUND = (3, 3, 5, 5, 7, 2, 4, 6)
+WEST_ROUND = (2, 2, 4, 4, 6, 3, 5, 7)
+KEYS = (2, 3, 4, 5, 6, 7)
+LIMIT = 24
+
+
+class TestPartitionChaos:
+    """The acceptance scenario: two live cluster pairs under closed-loop
+    load, WAN cut mid-stream, heal, reconverge — zero failed requests,
+    overshoot bounded by the reclaimed unsettled shares, differential
+    against the exact oracle."""
+
+    def test_partition_heal_bounded_divergence(self, make_net):
+        net = make_net()
+        ts = net.ts
+        east, west = net.coords["east"], net.coords["west"]
+        ids: list = []
+        codes: list = []
+        admits = {k: 0 for k in KEYS}
+        failures = 0
+
+        def drive(coord, fps):
+            nonlocal failures
+            for fp in fps:
+                try:
+                    ok = coord.consume(fp, W, LIMIT, deadline=D)
+                except Exception:  # noqa: BLE001 - the zero-failed contract
+                    failures += 1
+                    continue
+                ids.append(fp)
+                codes.append(0 if ok else 2)
+                if ok:
+                    admits[fp] += 1
+
+        # phase A: healthy closed-loop load, settle cadence every round
+        for _ in range(10):
+            drive(east, EAST_ROUND)
+            drive(west, WEST_ROUND)
+            east.pump()
+            west.pump()
+        for fp in KEYS:  # the healthy invariant: no overshoot at all
+            assert admits[fp] <= LIMIT, (fp, admits[fp])
+        # one unsettled burst so the cut catches in-flight liability
+        drive(east, EAST_ROUND)
+        drive(west, WEST_ROUND)
+        outstanding_at_cut = (
+            east.outstanding_tokens() + west.outstanding_tokens()
+        )
+        assert outstanding_at_cut > 0
+
+        # phase B: WAN cut; both sides keep answering; TTLs expire and
+        # the homes reclaim the unsettled shares
+        net.partition()
+        ts.advance(6)
+        admitted_before_cut = sum(admits.values())
+        for _ in range(3):
+            drive(east, EAST_ROUND)
+            drive(west, WEST_ROUND)
+            east.pump()  # fails over the cut; runs the reclaim sweep
+            west.pump()
+        assert failures == 0
+        assert east.degraded and west.degraded  # WAN-lag ladder engaged
+        reclaimed_total = (
+            east.reclaimed_tokens_total + west.reclaimed_tokens_total
+        )
+        # nothing settled across the cut: every grant outstanding at the
+        # cut is exactly what the homes took back
+        assert reclaimed_total == outstanding_at_cut
+        # borrowers really served from residual shares during the cut
+        assert sum(admits.values()) > admitted_before_cut
+        # THE BOUND: global admits <= limit + reclaimed unsettled shares
+        overshoot = sum(max(0, admits[fp] - LIMIT) for fp in KEYS)
+        assert overshoot <= reclaimed_total
+        # differential vs the exact oracle over the global stream
+        ids_arr = np.asarray(ids, dtype=np.int64)
+        oracle_admits = int(np.sum(occurrence_rank(ids_arr) + 1 <= LIMIT))
+        assert sum(admits.values()) <= oracle_admits + reclaimed_total
+
+        # phase C: heal -> ledgers reconverge, degradation clears
+        net.heal()
+        for _ in range(4):
+            time.sleep(0.06)  # let the dial breaker half-open
+            east.pump()
+            west.pump()
+        assert not east.degraded and not west.degraded
+        assert _borrowed_unsettled(west, "east") == 0
+        assert _borrowed_unsettled(east, "west") == 0
+        assert east.outstanding_tokens() == _borrowed_watermark(
+            west, "east"
+        )
+        assert west.outstanding_tokens() == _borrowed_watermark(
+            east, "west"
+        )
+
+        # phase D: a late stale-epoch settlement after a post-heal
+        # reclaim is rejected with a pinned count (fresh key, live conn)
+        assert not west.consume(8, W, LIMIT, deadline=D)
+        west.pump()
+        assert west.consume(8, W, LIMIT, deadline=D)
+        assert west.consume(8, W, LIMIT, deadline=D)
+        ts.advance(6)
+        assert east.reclaim_sweep() >= 8
+        stale_before = east.stale_epoch_rejected_total
+        west.pump()
+        assert east.stale_epoch_rejected_total == stale_before + 1
+
+
+class TestOwnerDeath:
+    """SIGKILL one cluster's owner process mid-borrow: the surviving
+    home reclaims its shares after the TTL and the global limit
+    re-tightens by exactly the unsettled remainder."""
+
+    _BORROWER = """\
+import sys
+from api_ratelimit_tpu.cluster.federation import FederationCoordinator
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+peers = {{"east": sys.argv[1], "west": "tcp://127.0.0.1:9"}}
+coord = FederationCoordinator(
+    "west", peers, RealTimeSource(),
+    share_min=8, settle_interval_ms=20.0, share_ttl_ms=10_000.0,
+)
+assert not coord.consume(2, {W}, 50, deadline=4_000_000_000)
+coord.pump()   # grant 8
+for _ in range(3):
+    assert coord.consume(2, {W}, 50, deadline=4_000_000_000)
+coord.pump()   # settle 3
+print("READY", flush=True)
+import time
+time.sleep(120)
+"""
+
+    def test_sigkilled_borrower_is_reclaimed_after_ttl(self, tmp_path):
+        ts = FakeTimeSource(NOW)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        addr = f"tcp://127.0.0.1:{srv.getsockname()[1]}"
+        east = FederationCoordinator(
+            "east",
+            {"east": addr, "west": "tcp://127.0.0.1:9"},
+            ts,
+            share_ttl_ms=5_000.0,
+        )
+        closing = threading.Event()
+
+        def accept_loop():
+            while not closing.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    fed_mod._recv_exact(conn, sc._HDR.size)
+                    east.serve_exchange(conn)
+                finally:
+                    conn.close()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+        err_path = tmp_path / "borrower.err"
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        with open(err_path, "w") as err:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", self._BORROWER.format(W=W), addr],
+                stdout=subprocess.PIPE,
+                stderr=err,
+                env=env,
+                text=True,
+            )
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with east._lock:
+                    go = east._out.get((2, W), {}).get("west")
+                if go is not None and go.settled == 3:
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(
+                    f"borrower never settled: {err_path.read_text()}"
+                )
+            assert proc.poll() is None, err_path.read_text()
+            assert east._used[(2, W)] == 8  # grant pre-committed
+            proc.kill()  # SIGKILL: no goodbye, no final settle
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            closing.set()
+            srv.close()
+        ts.advance(6)  # the TTL runs out with the borrower dead
+        assert east.reclaim_sweep() == 5  # granted 8 - settled 3
+        assert east._used[(2, W)] == 3
+        assert east._fence["west"] == 1
+        # the global limit re-tightened: the reclaimed budget is
+        # admittable again at the home, and not one token more
+        assert east.consume(2, W, 50, n=47, deadline=D)
+        assert not east.consume(2, W, 50, deadline=D)
+        east.close()
+
+
+class TestSnapshotRoundtrip:
+    """The fed.snap section: export -> FLAG_FED file -> reconcile ->
+    import re-seeds the ledger with the fence floor raised."""
+
+    def test_export_write_load_reconcile_import(self, make_net, tmp_path):
+        net = make_net()
+        east, west = net.coords["east"], net.coords["west"]
+        assert not west.consume(2, W, 100, deadline=D)
+        west.pump()
+        for _ in range(5):
+            assert west.consume(2, W, 100, deadline=D)
+        west.pump()  # settle 5 of the 8 granted
+        path = str(tmp_path / "fed.snap")
+        rows = east.export_rows()
+        assert rows.shape == (1, FED_ROW_WIDTH)
+        write_snapshot(path, rows, created_at=net.ts.now, flags=FLAG_FED)
+        header, table = load_snapshot(path)
+        assert header.flags & FLAG_FED
+        kept, stats = reconcile_fed_shares(table, net.ts.now)
+        assert stats == {"restored": 1, "dropped": 0}
+        east2 = FederationCoordinator(
+            "east", net.peers, net.ts, share_ttl_ms=5_000.0
+        )
+        assert east2.import_rows(kept, now=net.ts.now) == 1
+        assert east2._used[(2, W)] == east._used[(2, W)] == 8
+        assert east2.outstanding_tokens() == 3  # granted 8 - settled 5
+        assert east2._fence_floor == net.ts.now
+        net.ts.advance(6)
+        assert east2.reclaim_sweep() == 3  # parked liability returns
+
+    def test_reconcile_drops_settled_and_ttl_dead_rows(self):
+        rows = np.zeros((3, FED_ROW_WIDTH), dtype=np.uint32)
+        # row 0: live borrower balance (granted > spent, future expiry)
+        rows[0, FED_COL_WINDOW] = W
+        rows[0, FED_COL_GRANTED] = 8
+        rows[0, FED_COL_SPENT] = 2
+        rows[0, FED_COL_EXPIRE] = NOW + 100
+        # row 1: fully settled, no liability -> dropped
+        rows[1, FED_COL_GRANTED] = 4
+        rows[1, FED_COL_SPENT] = 4
+        rows[1, FED_COL_SETTLED] = 4
+        rows[1, FED_COL_EXPIRE] = NOW + 100
+        # row 2: TTL-dead -> dropped
+        rows[2, FED_COL_GRANTED] = 8
+        rows[2, FED_COL_OUT] = 8
+        rows[2, FED_COL_EXPIRE] = NOW - 1
+        kept, stats = reconcile_fed_shares(rows, NOW)
+        assert stats == {"restored": 1, "dropped": 2}
+        assert kept[0, FED_COL_WINDOW] == W
+
+
+def _fp_and_window(domain="chaos", pair=("k", "v")):
+    desc = Descriptor.of(pair)
+    divider = 60  # Unit.MINUTE
+    fp = fingerprint64(domain, desc.entries, divider)
+    return desc, int(fp), (NOW // divider) * divider
+
+
+def _make_limit(store, rpu):
+    from api_ratelimit_tpu.models.config import (
+        RateLimit,
+        new_rate_limit_stats,
+    )
+    from api_ratelimit_tpu.models.response import RateLimitValue
+
+    return RateLimit(
+        full_key="key_value",
+        stats=new_rate_limit_stats(store, "key_value"),
+        limit=RateLimitValue(requests_per_unit=rpu, unit=Unit.MINUTE),
+    )
+
+
+class TestFallbackShareRung:
+    """FallbackLimiter consults the share ledger like the lease table:
+    budget the federation actually owns answers before the rung."""
+
+    def _fallback(self, store, coord, rpu=3):
+        base = BaseRateLimiter(FakeTimeSource(NOW))
+        coord.bind_base(base)
+        fb = FallbackLimiter(
+            FAILURE_MODE_DENY,
+            base_limiter=base,
+            scope=store.scope("ratelimit"),
+            fed_shares=coord,
+        )
+        limit = _make_limit(store, rpu)
+        request = RateLimitRequest(
+            domain="chaos",
+            descriptors=(Descriptor.of(("k", "v")),),
+            hits_addend=1,
+        )
+        return fb, request, limit
+
+    def test_home_budget_serves_the_outage(self, test_store):
+        store, _sink = test_store
+        _desc, fp, _window = _fp_and_window()
+        self_name = sorted(("east", "west"))[fp % 2]  # make us the home
+        coord = FederationCoordinator(
+            self_name, _dummy_peers(), FakeTimeSource(NOW)
+        )
+        fb, request, limit = self._fallback(store, coord, rpu=3)
+        for _ in range(3):
+            resp = fb.do_limit(request, [limit], CacheError("dark"))
+            assert resp.descriptor_statuses[0].code == Code.OK
+        # budget exhausted: the DENY rung answers
+        resp = fb.do_limit(request, [limit], CacheError("dark"))
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        assert coord.fallback_hits_total == 3
+
+    def test_borrowed_share_serves_then_falls_to_rung(self, test_store):
+        store, _sink = test_store
+        _desc, fp, window = _fp_and_window()
+        borrower = sorted(("east", "west"))[1 - fp % 2]
+        coord = FederationCoordinator(
+            borrower, _dummy_peers(), FakeTimeSource(NOW)
+        )
+        coord._shares[(fp, window)] = _Share(
+            granted=2, expire_at=NOW + 999, limit=3
+        )
+        fb, request, limit = self._fallback(store, coord, rpu=3)
+        for _ in range(2):
+            resp = fb.do_limit(request, [limit], CacheError("dark"))
+            assert resp.descriptor_statuses[0].code == Code.OK
+        resp = fb.do_limit(request, [limit], CacheError("dark"))
+        assert resp.descriptor_statuses[0].code == Code.OVER_LIMIT
+        # the dry share queued a renewal for the next pump
+        assert (fp, window) in coord._wants
+
+    def test_share_served_request_carries_the_journey_flag(
+        self, test_store
+    ):
+        store, _sink = test_store
+        _desc, fp, _window = _fp_and_window()
+        self_name = sorted(("east", "west"))[fp % 2]
+        coord = FederationCoordinator(
+            self_name, _dummy_peers(), FakeTimeSource(NOW)
+        )
+        fb, request, limit = self._fallback(store, coord, rpu=3)
+        recorder = journeys.JourneyRecorder(slow_ms=1e9, retain=8, ring=8)
+        journeys.set_global_recorder(recorder)
+        try:
+            journey = recorder.begin("request")
+            fb.do_limit(request, [limit], CacheError("dark"))
+            recorder.finish(journey, 1.0)
+            retained = recorder.retained()
+            assert retained, "fed-served journey was not tail-sampled"
+            assert journeys.FLAG_FED in retained[-1].flags
+        finally:
+            journeys.set_global_recorder(None)
+
+
+def _make_engine(ts):
+    from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+
+    return SlabDeviceEngine(
+        time_source=ts,
+        n_slots=1 << 10,
+        buckets=(128,),
+        use_pallas=False,
+        block_mode=True,
+    )
+
+
+def _submit_frame():
+    from api_ratelimit_tpu.backends.tpu import _Item
+
+    items = [_Item(fp=7, hits=1, limit=1000, divider=60, jitter=0)]
+    return sc._HDR.pack(
+        sc.MAGIC, sc.VERSION, sc.OP_SUBMIT, 0
+    ) + sc.encode_items(items)
+
+
+def _submit_roundtrip(port, frame, times=3):
+    conn = socket.create_connection(("127.0.0.1", port), timeout=10)
+    out = b""
+    try:
+        for _ in range(times):
+            conn.sendall(frame)
+            status = fed_mod._recv_exact(conn, 1)
+            n_raw = fed_mod._recv_exact(conn, 4)
+            (n,) = struct.unpack("<I", n_raw)
+            out += status + n_raw + fed_mod._recv_exact(conn, 4 * n)
+    finally:
+        conn.close()
+    return out
+
+
+class TestRollbackArm:
+    """FED_ENABLED=false is the pre-federation server, byte for byte on
+    the wire — the TestRollbackArm discipline from test_replication."""
+
+    def test_default_settings_build_no_federation(self):
+        from api_ratelimit_tpu.settings import Settings
+
+        assert Settings().fed_config()[0] is False
+
+    def test_submit_wire_is_byte_identical_across_arms(self):
+        """The same SUBMIT stream against a server with no federation
+        (the FED_ENABLED=false arm) and one carrying a live coordinator
+        produces byte-identical responses: the fed rides its own wire
+        op and the submit path is untouched."""
+        plain = sc.SlabSidecarServer(
+            "tcp://127.0.0.1:0", _make_engine(FakeTimeSource(NOW))
+        )
+        coord = FederationCoordinator(
+            "east", _dummy_peers(), FakeTimeSource(NOW)
+        )
+        fedded = sc.SlabSidecarServer(
+            "tcp://127.0.0.1:0", _make_engine(FakeTimeSource(NOW)),
+            fed=coord,
+        )
+        try:
+            frame = _submit_frame()
+            assert _submit_roundtrip(plain.port, frame) == (
+                _submit_roundtrip(fedded.port, frame)
+            )
+        finally:
+            plain.close()
+            fedded.close()
+
+    def test_fed_op_without_federation_is_an_error_frame(self):
+        server = sc.SlabSidecarServer(
+            "tcp://127.0.0.1:0", _make_engine(FakeTimeSource(NOW))
+        )
+        try:
+            conn = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            try:
+                conn.sendall(
+                    sc._HDR.pack(sc.MAGIC, sc.VERSION, sc.OP_FED_EXCHANGE, 0)
+                )
+                status = fed_mod._recv_exact(conn, 1)
+                (msg_len,) = struct.unpack(
+                    "<I", fed_mod._recv_exact(conn, 4)
+                )
+                msg = fed_mod._recv_exact(conn, msg_len)
+            finally:
+                conn.close()
+            assert status == b"\x01"
+            assert b"federation not configured" in msg
+        finally:
+            server.close()
+
+    def test_exchange_flows_through_the_sidecar_server(self):
+        """The production dispatch: a borrower dials the home's sidecar
+        address and OP_FED_EXCHANGE becomes its exchange loop."""
+        ts = FakeTimeSource(NOW)
+        east = FederationCoordinator("east", _dummy_peers(), ts)
+        server = sc.SlabSidecarServer(
+            "tcp://127.0.0.1:0", _make_engine(ts), fed=east
+        )
+        west = FederationCoordinator(
+            "west",
+            {
+                "east": f"tcp://127.0.0.1:{server.port}",
+                "west": "tcp://127.0.0.1:9",
+            },
+            ts,
+        )
+        try:
+            assert not west.consume(2, W, 100, deadline=D)
+            assert west.pump()["east"] == "ok"
+            assert west.share_balance() == 8
+            assert east._used[(2, W)] == 8
+            assert west.consume(2, W, 100, deadline=D)
+        finally:
+            west.close()
+            server.close()

@@ -619,3 +619,123 @@ class TestClusterSettings:
                     "PARTITION_ADDRS": "a;b;c;d",
                 }
             ).cluster_config()
+
+
+class TestFederationSettings:
+    """FED_* knobs (cluster/federation.py global quota federation),
+    following the lease_config() junk-rejection pattern: a typo'd
+    membership must fail the boot, never silently become a different
+    home assignment."""
+
+    def test_defaults_are_the_rollback_arm(self):
+        s = Settings()
+        assert s.fed_enabled is False  # byte-identical pre-federation wire
+        enabled, self_name, peers, mn, mx, interval, lag, ttl = (
+            s.fed_config()
+        )
+        assert enabled is False
+        assert self_name == "" and peers == {}
+        assert (mn, mx) == (8, 1024)
+        assert interval == pytest.approx(50.0)
+        # 0 defaults resolve to multiples of the settle interval
+        assert lag == pytest.approx(250.0)
+        assert ttl == pytest.approx(500.0)
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "FED_ENABLED": "true",
+                "FED_SELF": "east",
+                "FED_PEERS": " east=/run/e.sock , west=tcp://w:9000 ",
+                "FED_SHARE_MIN": "2",
+                "FED_SHARE_MAX": "64",
+                "FED_SETTLE_INTERVAL_MS": "100",
+                "FED_MAX_LAG_MS": "400",
+                "FED_SHARE_TTL_MS": "1000",
+            }
+        )
+        enabled, self_name, peers, mn, mx, interval, lag, ttl = (
+            s.fed_config()
+        )
+        assert enabled is True
+        assert self_name == "east"
+        assert peers == {"east": "/run/e.sock", "west": "tcp://w:9000"}
+        assert (mn, mx) == (2, 64)
+        assert (interval, lag, ttl) == (100.0, 400.0, 1000.0)
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="FED_ENABLED"):
+            new_settings({"FED_ENABLED": "sideways"})
+        with pytest.raises(ValueError, match="FED_SHARE_MIN"):
+            new_settings({"FED_SHARE_MIN": "four"})
+        with pytest.raises(ValueError, match="FED_SHARE_MIN"):
+            new_settings({"FED_SHARE_MIN": "0"}).fed_config()
+        with pytest.raises(ValueError, match="FED_SHARE_MAX"):
+            new_settings(
+                {"FED_SHARE_MIN": "64", "FED_SHARE_MAX": "8"}
+            ).fed_config()
+        with pytest.raises(ValueError, match="FED_SETTLE_INTERVAL_MS"):
+            new_settings({"FED_SETTLE_INTERVAL_MS": "0"}).fed_config()
+        # a lag/ttl bound below the settle cadence would flap on every
+        # pump — rejected, like REPL_MAX_LAG_MS below its interval
+        with pytest.raises(ValueError, match="FED_MAX_LAG_MS"):
+            new_settings(
+                {"FED_SETTLE_INTERVAL_MS": "100", "FED_MAX_LAG_MS": "50"}
+            ).fed_config()
+        with pytest.raises(ValueError, match="FED_MAX_LAG_MS"):
+            new_settings({"FED_MAX_LAG_MS": "-1"}).fed_config()
+        with pytest.raises(ValueError, match="FED_SHARE_TTL_MS"):
+            new_settings(
+                {"FED_SETTLE_INTERVAL_MS": "100", "FED_SHARE_TTL_MS": "50"}
+            ).fed_config()
+
+    def test_enabled_membership_junk_rejected(self):
+        with pytest.raises(ValueError, match="FED_SELF"):
+            new_settings(
+                {"FED_ENABLED": "true", "FED_PEERS": "a=/a,b=/b"}
+            ).fed_config()
+        with pytest.raises(ValueError, match="FED_PEERS"):
+            new_settings(
+                {"FED_ENABLED": "true", "FED_SELF": "a"}
+            ).fed_config()
+        with pytest.raises(ValueError, match="name=address"):
+            new_settings(
+                {
+                    "FED_ENABLED": "true",
+                    "FED_SELF": "a",
+                    "FED_PEERS": "a=/a,b",
+                }
+            ).fed_config()
+        with pytest.raises(ValueError, match="duplicate"):
+            new_settings(
+                {
+                    "FED_ENABLED": "true",
+                    "FED_SELF": "a",
+                    "FED_PEERS": "a=/a,a=/b",
+                }
+            ).fed_config()
+        with pytest.raises(ValueError, match="address"):
+            new_settings(
+                {
+                    "FED_ENABLED": "true",
+                    "FED_SELF": "a",
+                    "FED_PEERS": "a=/a,b=tcp://nope",
+                }
+            ).fed_config()
+        with pytest.raises(ValueError, match="at least two"):
+            new_settings(
+                {
+                    "FED_ENABLED": "true",
+                    "FED_SELF": "a",
+                    "FED_PEERS": "a=/a",
+                }
+            ).fed_config()
+        # self must be part of the membership it hashes over
+        with pytest.raises(ValueError, match="FED_SELF"):
+            new_settings(
+                {
+                    "FED_ENABLED": "true",
+                    "FED_SELF": "c",
+                    "FED_PEERS": "a=/a,b=/b",
+                }
+            ).fed_config()

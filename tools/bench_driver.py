@@ -13,7 +13,8 @@ core (BENCH_r11/r13 carry that caveat as prose). The fix is structural:
   * ``probe_hardware()`` detects host_cpus, JAX platform, and device
     count in a subprocess (a wedged device stack can't hang the driver);
   * ``arm_tiers()`` maps that onto the tier matrix — multi-process tiers
-    (service_mp / cluster_scale / failover_blip / fleet_saturation) arm
+    (service_mp / cluster_scale / failover_blip / fleet_saturation /
+    fed_divergence) arm
     only when ``host_cpus > 1``, device tiers (pallas slab, device
     sketch, multichip mesh) only when a chip window is open — and every
     un-armed tier is recorded **skipped-with-reason**, never as a
@@ -34,9 +35,18 @@ and pairs the client view with the server-side fleet scrape
 (``GET /metrics?fleet=1`` via stats/fleet.py). On a 1-core box it emits
 the skipped-with-reason artifact instead — the acceptance shape.
 
+The ``--fed-divergence`` mode is the global-quota-federation tier
+(cluster/federation.py): two in-process cluster coordinators exchange
+quota shares over real sockets under skewed closed-loop load, a mid-run
+partition cuts the link both ways, and the artifact reports the measured
+global overshoot against the share-ledger bound (overshoot ≤ reclaimed
+unsettled tokens ≤ shares outstanding at the cut). On a 1-core box it
+emits the skipped-with-reason artifact instead.
+
 Usage:
     python -m tools.bench_driver [--out BENCH_rNN.json] [--budget S]
     python -m tools.bench_driver --fleet [--out FLEET_rNN.json]
+    python -m tools.bench_driver --fed-divergence [--out FED_rNN.json]
     python -m tools.bench_driver --probe-only   # print hw + arming matrix
 """
 
@@ -126,6 +136,7 @@ TIER_REQUIREMENTS: dict = {
     "cluster_scale": {"min_host_cpus": 2},
     "failover_blip": {"min_host_cpus": 2},
     "fleet_saturation": {"min_host_cpus": 2},
+    "fed_divergence": {"min_host_cpus": 2},
     "sharded": {"min_host_cpus": 2, "or_min_devices": 2},
     "pallas_slab": {"platform": "tpu"},
     "device_sketch": {"platform": "tpu"},
@@ -475,6 +486,216 @@ def run_fleet_saturation(hw: dict, arming: dict, budget_s: float) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# federation divergence tier (--fed-divergence)
+
+
+def run_fed_divergence(hw: dict, arming: dict, budget_s: float) -> dict:
+    """The bounded-divergence tier (cluster/federation.py): two in-process
+    cluster coordinators exchange shares over real TCP sockets under
+    closed-loop Zipf-skewed load, a mid-run partition cuts the WAN both
+    ways, and the measured global overshoot is checked against the
+    share-ledger bound — overshoot ≤ reclaimed unsettled tokens ≤ the
+    shares outstanding at the partition instant. Armed only when
+    host_cpus > 1 (two live closed loops plus two settle pumps on one
+    core measure the scheduler, not the algebra)."""
+    import random
+    import socket
+    import threading
+
+    from api_ratelimit_tpu.backends import sidecar as sc
+    from api_ratelimit_tpu.cluster.federation import FederationCoordinator
+    from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+    duration = min(
+        float(os.environ.get("BENCH_FED_SECONDS", "6")), budget_s * 0.8
+    )
+    n_keys = int(os.environ.get("BENCH_FED_KEYS", "48"))
+    limit = int(os.environ.get("BENCH_FED_LIMIT", "400"))
+
+    # two listeners bound first (the membership map needs the ports),
+    # coordinators second, accept loops last
+    socks = {}
+    for name in ("east", "west"):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(32)
+        srv.settimeout(0.2)
+        socks[name] = srv
+    peers = {
+        name: f"tcp://127.0.0.1:{srv.getsockname()[1]}"
+        for name, srv in socks.items()
+    }
+    coords = {
+        name: FederationCoordinator(
+            name,
+            peers,
+            time_source=RealTimeSource(),
+            share_min=8,
+            share_max=256,
+            settle_interval_ms=50.0,
+            max_lag_ms=250.0,
+            share_ttl_ms=600.0,
+        )
+        for name in socks
+    }
+    partitioned = threading.Event()
+    closing = threading.Event()
+
+    def accept_loop(name: str) -> None:
+        srv = socks[name]
+        while not closing.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if partitioned.is_set():
+                conn.close()  # the WAN cut: peers get connection reset
+                continue
+
+            def serve(c=conn, coord=coords[name]) -> None:
+                try:
+                    need = sc._HDR.size
+                    buf = b""
+                    while len(buf) < need:
+                        chunk = c.recv(need - len(buf))
+                        if not chunk:
+                            return
+                        buf += chunk
+                    coord.serve_exchange(c)
+                except Exception:  # noqa: BLE001 - chaos by design
+                    pass
+                finally:
+                    c.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+
+    threads = [
+        threading.Thread(target=accept_loop, args=(n,), daemon=True)
+        for n in socks
+    ]
+    for t in threads:
+        t.start()
+
+    # Zipf-ish key popularity, skewed differently per region: east's hot
+    # head is west's tail — the cross-borrow traffic that makes shares
+    # flow both directions
+    rng = random.Random(1234)
+    now = int(time.time())
+    window = (now // 3600) * 3600
+    deadline = window + 3600
+    keys = [((rng.getrandbits(63) << 1) | (i & 1), window) for i in range(n_keys)]
+    weights = [1.0 / (i + 1) for i in range(n_keys)]
+    east_keys = random.Random(7).choices(keys, weights=weights, k=4096)
+    west_keys = random.Random(11).choices(
+        keys, weights=list(reversed(weights)), k=4096
+    )
+
+    admitted: dict = {k: 0 for k in keys}
+    denied = {"east": 0, "west": 0}
+    lock = threading.Lock()
+    t_end = time.monotonic() + duration
+    t_cut = time.monotonic() + duration * 0.35
+    t_heal = time.monotonic() + duration * 0.75
+    bound_at_cut = {"tokens": -1}
+
+    def drive(name: str, plan: list) -> None:
+        coord = coords[name]
+        i = 0
+        next_pump = 0.0
+        while time.monotonic() < t_end:
+            fp, win = plan[i % len(plan)]
+            i += 1
+            ok = coord.consume(fp, win, limit, 1, deadline=deadline)
+            with lock:
+                if ok:
+                    admitted[(fp, win)] += 1
+                else:
+                    denied[name] += 1
+            t = time.monotonic()
+            if t >= next_pump:
+                next_pump = t + 0.05
+                try:
+                    coord.pump()
+                except Exception:  # noqa: BLE001 - partition chaos
+                    pass
+            if i % 64 == 0:
+                time.sleep(0.001)
+
+    drivers = [
+        threading.Thread(target=drive, args=("east", east_keys), daemon=True),
+        threading.Thread(target=drive, args=("west", west_keys), daemon=True),
+    ]
+    for d in drivers:
+        d.start()
+    healed_at = None
+    while time.monotonic() < t_end:
+        t = time.monotonic()
+        if not partitioned.is_set() and t >= t_cut and t < t_heal:
+            bound_at_cut["tokens"] = sum(
+                c.outstanding_tokens() for c in coords.values()
+            )
+            partitioned.set()
+            log(
+                f"fed tier: partition cut — outstanding "
+                f"{bound_at_cut['tokens']} tokens"
+            )
+        if partitioned.is_set() and t >= t_heal:
+            partitioned.clear()
+            healed_at = t
+            log("fed tier: partition healed")
+        time.sleep(0.02)
+    for d in drivers:
+        d.join(timeout=10.0)
+    # post-run settle passes so the healed ledgers reconverge
+    for _ in range(6):
+        for c in coords.values():
+            try:
+                c.pump()
+            except Exception:  # noqa: BLE001
+                pass
+        time.sleep(0.06)
+    closing.set()
+    for srv in socks.values():
+        srv.close()
+    for c in coords.values():
+        c.close()
+
+    overshoot = sum(max(0, n - limit) for n in admitted.values())
+    reclaimed = sum(c.reclaimed_tokens_total for c in coords.values())
+    stale = sum(c.stale_epoch_rejected_total for c in coords.values())
+    result = {
+        "clusters": sorted(coords),
+        "keys": n_keys,
+        "per_key_limit": limit,
+        "duration_s": duration,
+        "admitted_total": sum(admitted.values()),
+        "denied_total": dict(denied),
+        "overshoot_tokens": overshoot,
+        "reclaimed_tokens": reclaimed,
+        "outstanding_at_partition": bound_at_cut["tokens"],
+        # the ledger invariant (cluster/federation.py): every admitted
+        # token beyond the limit traces to a reclaimed-but-still-spendable
+        # share — idle TTL reclaims count too, so the bound is reclaimed
+        # tokens, with the partition-instant outstanding as context
+        "within_bound": overshoot <= reclaimed,
+        "stale_epoch_rejected": stale,
+        "healed": healed_at is not None,
+        "settles": {
+            n: c.settles_total for n, c in coords.items()
+        },
+        "grants": {n: c.grants_total for n, c in coords.items()},
+        "degraded_during_run": {
+            n: bool(c.degraded or c.exchange_errors_total)
+            for n, c in coords.items()
+        },
+    }
+    return result
+
+
+# ---------------------------------------------------------------------------
 # driver CLI
 
 
@@ -501,6 +722,11 @@ def main(argv=None) -> int:
         "--fleet", action="store_true",
         help="run the fleet-saturation tier instead of bench.py",
     )
+    ap.add_argument(
+        "--fed-divergence", action="store_true",
+        help="run the federation bounded-divergence tier instead of "
+        "bench.py",
+    )
     args = ap.parse_args(argv)
 
     hw = probe_hardware()
@@ -511,6 +737,26 @@ def main(argv=None) -> int:
 
     if args.probe_only:
         print(json.dumps({"hardware": hw, "tiers": arming}, indent=2))
+        return 0
+
+    if args.fed_divergence:
+        doc: dict = {"metric": "fed_divergence", "hardware": hw}
+        st = arming["fed_divergence"]
+        if not st["armed"]:
+            doc["fed_divergence"] = {"skipped": st["reason"]}
+        else:
+            try:
+                doc["fed_divergence"] = run_fed_divergence(
+                    hw, arming, args.budget
+                )
+            except Exception as e:  # noqa: BLE001 - artifact must land
+                doc["fed_divergence"] = {"error": str(e)[-300:]}
+        _stamp(doc, hw, arming)
+        line = json.dumps(doc)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
         return 0
 
     if args.fleet:

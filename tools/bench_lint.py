@@ -62,7 +62,9 @@ def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
     if not isinstance(doc, dict):
         return ["artifact is not a JSON object"]
 
-    is_fleet = doc.get("metric") == "fleet_saturation"
+    # single-tier artifacts (--fleet / --fed-divergence) carry their own
+    # metric name and body block instead of the bench.py configs shape
+    is_fleet = doc.get("metric") in ("fleet_saturation", "fed_divergence")
     if not is_fleet:
         for field in REQUIRED_TOP:
             if field not in doc:
@@ -123,6 +125,33 @@ def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
                     f"configs.{tier}: rate claimed but no positive request "
                     f"count in stages"
                 )
+
+    # claim honesty for the federation tier: a row that actually ran must
+    # carry the numeric divergence evidence (the overshoot and its bound),
+    # not just a verdict — "within_bound": true with no numbers reads as
+    # a measurement that never happened
+    if doc.get("metric") == "fed_divergence":
+        body = doc.get("fed_divergence")
+        if not isinstance(body, dict):
+            findings.append("fed_divergence: missing tier body block")
+        elif "skipped" not in body and "error" not in body:
+            for field in (
+                "overshoot_tokens",
+                "reclaimed_tokens",
+                "admitted_total",
+                "within_bound",
+            ):
+                if field == "within_bound":
+                    if not isinstance(body.get(field), bool):
+                        findings.append(
+                            f"fed_divergence.{field}: missing or non-bool "
+                            f"bound verdict"
+                        )
+                elif not isinstance(body.get(field), (int, float)):
+                    findings.append(
+                        f"fed_divergence.{field}: ran but carries no "
+                        f"numeric value"
+                    )
 
     # arming drift: a disarmed tier must not carry numbers
     tiers = doc.get("tiers")

@@ -36,6 +36,12 @@ from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
     COL_EXPIRE,
     COL_WINDOW,
     row_algorithms,
+    FED_COL_EXPIRE,
+    FED_COL_GRANTED,
+    FED_COL_OUT,
+    FED_COL_SETTLED,
+    FED_COL_SPENT,
+    FLAG_FED,
     FLAG_LEASE_TABLE,
     LEASE_COL_EXPIRE,
     LEASE_COL_GRANTED,
@@ -43,6 +49,7 @@ from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
     SNAPSHOT_VERSION,
     SnapshotError,
     load_snapshot,
+    reconcile_fed_shares,
     reconcile_leases,
     reconcile_rows,
     set_occupancy_histogram,
@@ -54,7 +61,10 @@ def inspect_file(path: str, now: int | None) -> dict:
     raises SnapshotError on any validation failure. Lease-liability
     tables (FLAG_LEASE_TABLE — the leases.snap section) get their own
     report shape: outstanding grants, unsettled tokens, and how the
-    boot-time reconcile at `now` would treat them."""
+    boot-time reconcile at `now` would treat them. Federation share
+    ledgers (FLAG_FED — the fed.snap section, cluster/federation.py)
+    likewise: outstanding inter-cluster shares, unsettled spend, and the
+    reconcile-at-`now` preview."""
     header, table = load_snapshot(path)
     at = int(now) if now is not None else int(header.created_at)
     if header.flags & FLAG_LEASE_TABLE:
@@ -76,6 +86,37 @@ def inspect_file(path: str, now: int | None) -> dict:
                 "settled_tokens": int(settled.sum()),
                 # the Σ budgets term of the crash-overshoot bound
                 "unsettled_tokens": int((granted - settled).sum()),
+                "ttl_dead_at_now": int(np.sum(expire_at <= at)),
+                "restorable": rec["restored"],
+                "dropped_on_restore": rec["dropped"],
+            },
+        }
+    if header.flags & FLAG_FED:
+        granted = table[:, FED_COL_GRANTED].astype(np.int64)
+        spent = table[:, FED_COL_SPENT].astype(np.int64)
+        settled = table[:, FED_COL_SETTLED].astype(np.int64)
+        out = table[:, FED_COL_OUT].astype(np.int64)
+        expire_at = table[:, FED_COL_EXPIRE].astype(np.int64)
+        _kept, rec = reconcile_fed_shares(table, at)
+        return {
+            "path": path,
+            "valid": True,
+            "kind": "federation",
+            "version": header.version,
+            "created_at": header.created_at,
+            "age_seconds": max(0, at - header.created_at),
+            "bytes": os.path.getsize(path),
+            "shares": {
+                "rows": int(table.shape[0]),
+                "granted_tokens": int(granted.sum()),
+                "spent_tokens": int(spent.sum()),
+                "settled_tokens": int(settled.sum()),
+                # the Σ outstanding-shares term of the partition
+                # overshoot bound (cluster/federation.py)
+                "outstanding_tokens": int(out.sum()),
+                "unsettled_tokens": int(
+                    np.maximum(spent - settled, 0).sum()
+                ),
                 "ttl_dead_at_now": int(np.sum(expire_at <= at)),
                 "restorable": rec["restored"],
                 "dropped_on_restore": rec["dropped"],
@@ -185,6 +226,29 @@ def _print_text(report: dict) -> None:
             f"  restore restorable={leases['restorable']} "
             f"dropped={leases['dropped_on_restore']} "
             f"ttl_dead={leases['ttl_dead_at_now']}"
+        )
+        return
+    if report.get("kind") == "federation":
+        shares = report["shares"]
+        print(f"{report['path']}:")
+        print(
+            f"  header  v{report['version']} federation share ledger "
+            f"created_at={report['created_at']} "
+            f"(age {report['age_seconds']}s) "
+            f"({report['bytes']} bytes)  CRC OK"
+        )
+        print(
+            f"  shares  rows={shares['rows']} "
+            f"outstanding_tokens={shares['outstanding_tokens']} "
+            f"unsettled_tokens={shares['unsettled_tokens']} "
+            f"(granted={shares['granted_tokens']}, "
+            f"spent={shares['spent_tokens']}, "
+            f"settled={shares['settled_tokens']})"
+        )
+        print(
+            f"  restore restorable={shares['restorable']} "
+            f"dropped={shares['dropped_on_restore']} "
+            f"ttl_dead={shares['ttl_dead_at_now']}"
         )
         return
     rows = report["rows"]
