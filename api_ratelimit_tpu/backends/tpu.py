@@ -56,6 +56,9 @@ from ..ops.hashing import fingerprint_many, split_fingerprints
 from ..ops.slab import (
     ALGO_CONC_RELEASE,
     ALGO_SHIFT,
+    COL_EXPIRE,
+    COL_FP_HI,
+    COL_FP_LO,
     HEALTH_ALGO_RESETS,
     HEALTH_DROPS,
     HEALTH_EVICT_EXPIRED,
@@ -67,6 +70,7 @@ from ..ops.slab import (
     slab_export_copy,
     slab_import_rows,
     slab_live_slots,
+    slab_promote_rows,
     slab_step_after,
     default_ways,
     validate_ways,
@@ -147,12 +151,27 @@ class SlabDeviceEngine:
         partition: int = -1,
         hotkey_lanes: int = 0,
         hotkey_k: int = 16,
+        victim_max_rows: int = 0,
+        victim_watermark: float = 0.85,
     ):
         """hotkey_lanes: lanes of the in-kernel heavy-hitter sketch
         (ops/sketch.py; HOTKEY_LANES). 0 disables — the HOTKEYS_ENABLED=
         false arm: no sketch array enters the launch pytree, so the traced
         program is byte-identical to the pre-hotkeys engine. hotkey_k is
         the top-K size each drain reports (HOTKEY_K).
+
+        victim_max_rows: row bound of the host-RAM victim tier
+        (backends/victim.py; VICTIM_MAX_ROWS). 0 disables — the
+        VICTIM_TIER_ENABLED=false arm: the launch compiles with
+        victim=False (ops/slab.py static gate), so the traced program and
+        the slab bytes are byte-identical to the pre-tier engine. When
+        enabled, every launch's demoted live rows (the in-kernel
+        eviction readback) drain into the tier and a key's reappearance
+        re-promotes its row onto the slab mid-window via
+        slab_promote_rows — live eviction stops being lossy.
+        victim_watermark (VICTIM_WATERMARK) is the tier-occupancy
+        fraction past which the sticky degraded probe raises
+        (victim_watermark_reason).
 
         partition: which cluster partition this owner serves
         (cluster/; -1 = unpartitioned). Labeling only: the dispatch
@@ -276,6 +295,37 @@ class SlabDeviceEngine:
                 self._sketch_ways = sketch_ways(self._ways, hotkey_lanes)
                 self._sketch = jax.device_put(
                     make_sketch(hotkey_lanes), device
+                )
+        # host-RAM victim tier (backends/victim.py): where in-kernel live
+        # evictions drain instead of vanishing, and where the promote
+        # injection re-reads them from. Single-device only for the same
+        # reason as the sketch: the mesh engine's compacted per-shard
+        # launches would need per-shard victim readbacks nothing demands
+        # yet. The fault injector is kept for the victim.demote /
+        # victim.promote chaos sites (testing/faults.py).
+        self._victim = None
+        self._victim_lock = threading.Lock()
+        # sketch-hot rows never demote: a hot row swept up in a live
+        # eviction parks here and re-injects unconditionally on the very
+        # next launch, immune to the tier's overflow valuation
+        self._promote_pending: dict = {}
+        self._victim_hot_refusals = 0
+        self._victim_demote_errors = 0
+        self._victim_promote_skips = 0
+        self._fault = fault_injector
+        if int(victim_max_rows) > 0:
+            if mesh is not None:
+                _log.warning(
+                    "victim tier is single-device only; disabled on the "
+                    "mesh-sharded engine"
+                )
+            else:
+                from .victim import VictimTier
+
+                self._victim = VictimTier(
+                    int(victim_max_rows),
+                    float(victim_watermark),
+                    time_source,
                 )
         # lossy-event counters (the eviction mix / in-batch contention
         # drops — ops/slab.py HEALTH_* layout): per-launch device health
@@ -882,12 +932,16 @@ class SlabDeviceEngine:
         )
         use_pallas = self._use_pallas and not self._algos_seen
         with self._state_lock:
+            # promote injection rides BEFORE the step so a demoted key's
+            # reappearing batch sees its restored counter in this very
+            # launch (the tier's rows resume mid-window, not next-launch)
+            self._inject_promotes_locked(packed, n)
             # the numpy block rides the jit call directly — the committed
             # state array pins placement, and skipping the separate
             # device_put dispatch saves ~0.1ms of per-launch host overhead
             # (a third of the launch cost at small batches)
             try:
-                after_dev, health = self._step_after_locked(
+                after_dev, health, victim_rows = self._step_after_locked(
                     packed, dtype, use_pallas
                 )
                 if use_pallas:
@@ -907,13 +961,18 @@ class SlabDeviceEngine:
                 # the donated state is still intact for the retry.
                 _log.warning("pallas slab kernel failed; using XLA path: %s", e)
                 self._use_pallas = False
-                after_dev, health = self._step_after_locked(
+                after_dev, health, victim_rows = self._step_after_locked(
                     packed, dtype, False
                 )
             self._pending_health.append(health)
             self._decisions_total += n
             if len(self._pending_health) > 4096:
                 self._drain_health_locked()
+        if victim_rows is not None:
+            # demote drain OUTSIDE the state lock: the D2H wait on the
+            # readback and the host-table inserts must not serialize the
+            # next launch's dispatch
+            self._drain_victim(victim_rows)
         if self._h_launch is not None:
             self._h_launch.record((time.perf_counter() - t_launch) * 1e3)
         return after_dev, n
@@ -936,12 +995,18 @@ class SlabDeviceEngine:
             multi_algo=self._algos_seen,
             sketch=self._sketch,
             sketch_ways=self._sketch_ways,
+            victim=self._victim is not None,
         )
+        victim_rows = None
+        if self._victim is not None:
+            # the demoted-row readback rides LAST in the output tuple
+            # (after the optional sketch element — ops/slab.py)
+            *outs, victim_rows = outs
         if self._sketch is not None:
             self._state, after_dev, health, self._sketch = outs
         else:
             self._state, after_dev, health = outs
-        return after_dev, health
+        return after_dev, health, victim_rows
 
     # -- heavy-hitter sketch drain (stats cadence; ops/sketch.py) --
 
@@ -1004,6 +1069,159 @@ class SlabDeviceEngine:
                 for lo, hi, cnt in self._last_topk
             ],
         }
+
+    # -- victim tier: demote drain + promote injection (backends/victim.py) --
+
+    @property
+    def victim_enabled(self) -> bool:
+        return self._victim is not None
+
+    @property
+    def victim_tier(self):
+        """The VictimTier (or None) — the snapshotter's victim.snap hook
+        (persist/snapshotter.py) and the debug/inspect surface."""
+        return self._victim
+
+    def _drain_victim(self, victim_rows) -> None:
+        """Absorb one launch's demoted-live-row readback into the tier.
+        Runs outside the state lock (the tier has its own). The readback
+        is sorted order with non-demoted lanes zeroed, so the filter is
+        just COL_EXPIRE != 0 — a live row always carries a TTL."""
+        rows = np.asarray(victim_rows)
+        rows = rows[rows[:, COL_EXPIRE] != 0]
+        if not rows.shape[0]:
+            return
+        if self._fault is not None:
+            action = self._fault.fire("victim.demote")
+            if action == "drop":
+                return  # rows silently vanish — the chaos arm's loss
+            if action == "error":
+                # fail open exactly like a live eviction without the tier:
+                # the counters are lost, but counted — never block serving
+                self._victim_demote_errors += 1
+                return
+        self._absorb_demoted(rows)
+
+    def _absorb_demoted(self, rows: np.ndarray) -> None:
+        """Route demoted rows: sketch-hot keys to the unconditional
+        re-inject queue (hot keys never demote — their next launch is
+        now), everything else into the bounded tier."""
+        hot = self._hot_fps
+        if hot:
+            combined = (
+                rows[:, COL_FP_HI].astype(np.uint64) << np.uint64(32)
+            ) | rows[:, COL_FP_LO].astype(np.uint64)
+            mask = np.fromiter(
+                (int(fp) in hot for fp in combined), bool, rows.shape[0]
+            )
+            hot_rows = rows[mask]
+            if hot_rows.shape[0]:
+                self._victim_hot_refusals += int(hot_rows.shape[0])
+                with self._victim_lock:
+                    for r in hot_rows:
+                        self._promote_pending[
+                            (int(r[COL_FP_LO]), int(r[COL_FP_HI]))
+                        ] = r.copy()
+            rows = rows[~mask]
+        if rows.shape[0]:
+            self._victim.insert(rows, int(self._time_source.unix_now()))
+
+    def _inject_promotes_locked(self, packed: np.ndarray, n: int) -> None:
+        """Pre-step promote pass: any of this batch's fingerprints found
+        in the victim tier (plus every parked hot row) re-enters the slab
+        via slab_promote_rows, counter/divider/algorithm bits intact, so
+        the step that follows sees the resumed row. Swap semantics: a row
+        the promote displaces comes back in the `displaced` readback and
+        re-demotes into the tier — the hierarchy loses nothing either
+        direction. Holds the state lock (caller); the promote launch is
+        a few-row program, cheap next to the step it precedes."""
+        tier = self._victim
+        if tier is None or n == 0:
+            return
+        with self._victim_lock:
+            pending = list(self._promote_pending.values())
+        if not tier.rows and not pending:
+            return
+        if self._fault is not None:
+            action = self._fault.fire("victim.promote")
+            if action in ("drop", "error"):
+                # skip the injection: rows STAY in the tier (promotion is
+                # retry-forever by construction — nothing is lost, the
+                # key just keeps missing until the site heals)
+                self._victim_promote_skips += 1
+                return
+        hits = tier.lookup_batch(packed[0, :n], packed[1, :n])
+        n_hits = 0 if hits is None else hits.shape[0]
+        if not n_hits and not pending:
+            return
+        parts = ([hits] if n_hits else []) + (
+            [np.stack(pending)] if pending else []
+        )
+        rows = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        k = rows.shape[0]
+        # pad to the bucket ladder so the promote program compiles a
+        # handful of shapes, not one per row count
+        size = max(self._bucket_for(k), k)
+        padded = np.zeros((size, ROW_WIDTH), dtype=np.uint32)
+        padded[:k] = rows
+        now = int(packed[6, 0])
+        self._state, landed_dev, displaced_dev = slab_promote_rows(
+            self._state, padded, now, ways=self._ways
+        )
+        landed = np.asarray(landed_dev)[:k]
+        if n_hits:
+            tier.retire(rows[:n_hits], landed[:n_hits])
+        if pending:
+            with self._victim_lock:
+                for row, ok in zip(pending, landed[n_hits:].tolist()):
+                    if ok:
+                        self._promote_pending.pop(
+                            (int(row[COL_FP_LO]), int(row[COL_FP_HI])), None
+                        )
+        displaced = np.asarray(displaced_dev)
+        displaced = displaced[displaced[:, COL_EXPIRE] != 0]
+        if displaced.shape[0]:
+            self._absorb_demoted(displaced)
+
+    def victim_snapshot(self) -> dict:
+        """Tier health for the stats tree (VictimStats — that generator IS
+        the reclamation cadence, like HotkeyStats is the sketch drain):
+        runs the TTL/window reclaim, then reports occupancy + counters."""
+        tier = self._victim
+        if tier is None:
+            return {"enabled": False}
+        now = int(self._time_source.unix_now())
+        tier.reclaim(now)
+        snap = tier.describe(now)
+        snap["enabled"] = True
+        snap["hot_refusals"] = self._victim_hot_refusals
+        snap["demote_errors"] = self._victim_demote_errors
+        snap["promote_skips"] = self._victim_promote_skips
+        with self._victim_lock:
+            snap["pending_hot"] = len(self._promote_pending)
+        return snap
+
+    def victim_debug(self) -> dict:
+        """The GET /debug/victim document — victim_snapshot without the
+        reclaim side effect (a debug poll must not advance tier state)."""
+        tier = self._victim
+        if tier is None:
+            return {"enabled": False}
+        snap = tier.describe(int(self._time_source.unix_now()))
+        snap["enabled"] = True
+        snap["hot_refusals"] = self._victim_hot_refusals
+        snap["demote_errors"] = self._victim_demote_errors
+        snap["promote_skips"] = self._victim_promote_skips
+        with self._victim_lock:
+            snap["pending_hot"] = len(self._promote_pending)
+        return snap
+
+    def victim_watermark_reason(self) -> str | None:
+        """HealthChecker degraded-probe contract for the tier watermark —
+        registered beside the slab's own watermark_reason (runner.py)."""
+        if self._victim is None:
+            return None
+        return self._victim.watermark_reason()
 
     def _launch_ready(self, tokens) -> bool:
         """Non-blocking readiness probe for a launch token (the dispatch
@@ -1299,6 +1517,61 @@ class HotkeyStats:
         self._drains_seen = drains
 
 
+class VictimStats:
+    """StatGenerator exporting the victim tier on every stats flush
+    (SlabDeviceEngine.victim_snapshot — this generator IS the tier's
+    TTL/window reclamation cadence, like HotkeyStats is the sketch
+    drain):
+
+        ratelimit.victim.rows            rows currently parked in the tier
+        ratelimit.victim.demotes         cumulative demoted live rows
+                                         absorbed from eviction readbacks
+        ratelimit.victim.promotes        cumulative rows promoted back
+                                         onto the slab (retired landed)
+        ratelimit.victim.hot_refusals    sketch-hot rows that refused
+                                         demotion (parked for next-launch
+                                         re-inject instead)
+        ratelimit.victim.reclaimed       rows dropped by TTL/window-aware
+                                         reclamation (dead state, not loss)
+        ratelimit.victim.overflow_drops  value-ranked losses past
+                                         VICTIM_MAX_ROWS — the tier's ONLY
+                                         lossy behavior
+        ratelimit.victim.overflow_lost_count_sum
+                                         sum of the counter values those
+                                         drops forgot — the ledger the
+                                         differential false-admit bound
+                                         is stated against
+                                         (tests/test_victim.py)
+        ratelimit.victim.watermark       0 normal / 1 past VICTIM_WATERMARK
+                                         (sticky degraded probe mirror)
+
+    The full document (age histogram, capacity, fault-site counters)
+    ships via GET /debug/victim; this exports the alarmable envelope."""
+
+    def __init__(self, engine, scope):
+        self._engine = engine
+        self._gauges = {
+            "rows": scope.gauge("rows"),
+            "demotes": scope.gauge("demotes"),
+            "promotes": scope.gauge("promotes"),
+            "hot_refusals": scope.gauge("hot_refusals"),
+            "reclaimed": scope.gauge("reclaimed"),
+            "overflow_drops": scope.gauge("overflow_drops"),
+            "overflow_lost_count_sum": scope.gauge("overflow_lost_count_sum"),
+            "watermark": scope.gauge("watermark"),
+        }
+
+    def generate_stats(self) -> None:
+        snap = self._engine.victim_snapshot()
+        if not snap.get("enabled"):
+            return
+        for k, g in self._gauges.items():
+            if k == "watermark":
+                g.set(snap.get("watermark_state", 0))
+            else:
+                g.set(snap.get(k, 0))
+
+
 class TpuRateLimitCache:
     """limiter.RateLimitCache implementation backed by the TPU slab."""
 
@@ -1325,6 +1598,8 @@ class TpuRateLimitCache:
         gcra_burst_ratio: float = 1.0,
         hotkey_lanes: int = 0,
         hotkey_k: int = 16,
+        victim_max_rows: int = 0,
+        victim_watermark: float = 0.85,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -1381,6 +1656,8 @@ class TpuRateLimitCache:
                 gcra_burst_ratio=gcra_burst_ratio,
                 hotkey_lanes=hotkey_lanes,
                 hotkey_k=hotkey_k,
+                victim_max_rows=victim_max_rows,
+                victim_watermark=victim_watermark,
             )
         self._engine_core = engine
         # per-algorithm decision stats (ratelimit.algo.<name>.{decisions,
@@ -1458,6 +1735,15 @@ class TpuRateLimitCache:
             engine.add_hotkey_listener(
                 lambda _top, fps: self._lease.note_hot_fps(fps)
             )
+
+    def victim_debug(self) -> dict:
+        """The /debug/victim document: the engine's tier health snapshot
+        (occupancy, counters, age histogram) — {"enabled": False} when
+        the engine runs without a tier (sidecar clients, test engines)."""
+        fn = getattr(self._engine_core, "victim_debug", None)
+        if fn is None:
+            return {"enabled": False}
+        return fn()
 
     def hotkeys_debug(self) -> dict:
         """The /debug/hotkeys document: the engine's last drained top-K

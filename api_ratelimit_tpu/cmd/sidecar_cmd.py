@@ -222,6 +222,7 @@ def main(argv=None) -> None:
     settings.warn_deprecated_knobs(logger)
 
     hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
+    v_enabled, v_max_rows, v_watermark = settings.victim_config()
     engine = SlabDeviceEngine(
         time_source=RealTimeSource(),
         near_limit_ratio=settings.near_limit_ratio,
@@ -259,6 +260,10 @@ def main(argv=None) -> None:
         # head measured here is the authoritative one
         hotkey_lanes=hk_lanes if hk_enabled else 0,
         hotkey_k=hk_k,
+        # host-RAM victim tier (backends/victim.py): demoted live rows
+        # park beside the device owner and resume mid-window on promote
+        victim_max_rows=v_max_rows if v_enabled else 0,
+        victim_watermark=v_watermark,
         **({"buckets": settings.buckets()} if settings.buckets() else {}),
     )
     cluster_node = None
@@ -287,6 +292,14 @@ def main(argv=None) -> None:
         # HotkeyStats): gauges + the ranked head for /debug/hotkeys
         store.add_stat_generator(
             HotkeyStats(engine, scope.scope("hotkeys"))
+        )
+    if engine.victim_enabled:
+        from ..backends.tpu import VictimStats
+
+        # the stats flush cadence IS the tier's reclamation cadence (see
+        # VictimStats): gauges + the occupancy document for /debug/victim
+        store.add_stat_generator(
+            VictimStats(engine, scope.scope("victim"))
         )
     # Lease liability gauges (backends/lease.py): frontends with
     # LEASE_ENABLED ship grant/settle trailers on their SUBMIT frames; the
@@ -419,6 +432,10 @@ def main(argv=None) -> None:
         # WAN settlement lag past FED_MAX_LAG_MS: degraded-only — the
         # cluster keeps serving its granted slice while divergence grows
         health.add_degraded_probe(fed.degraded_reason)
+    if engine.victim_enabled:
+        # victim-tier occupancy past VICTIM_WATERMARK: degraded-only —
+        # the tier overflows by value-ranked drop, never OOM or shed
+        health.add_degraded_probe(engine.victim_watermark_reason)
 
     debug = new_debug_server(
         "",
@@ -453,6 +470,20 @@ def main(argv=None) -> None:
             )
 
         debug.add_get("/debug/hotkeys", handle_hotkeys)
+    if engine.victim_enabled:
+        import json as _v_json
+
+        def handle_victim(h) -> None:
+            # tier occupancy, counters, and the row-age histogram — the
+            # operator's view of how much demoted state is parked and
+            # how long it waits before promotion or reclamation
+            h._write(
+                200,
+                _v_json.dumps(engine.victim_debug(), indent=2).encode(),
+                content_type="application/json",
+            )
+
+        debug.add_get("/debug/victim", handle_victim)
     if fed is not None:
         import json as _fed_json
 

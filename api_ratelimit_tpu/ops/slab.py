@@ -474,6 +474,7 @@ def _slab_update_sorted(
     multi_algo: bool = True,  # static: compile the sibling-algorithm arms
     sketch: jnp.ndarray | None = None,  # hotkeys planes (None = gate off)
     sketch_ways: int = 0,  # static: sketch set associativity
+    victim: bool = False,  # static: readback of evicted live rows
 ):
     """The stateful core: set scan, serialize duplicates, window-reset,
     increment, one row-scatter. Returns sorted before/after counters, the
@@ -657,6 +658,7 @@ def _slab_update_sorted(
                 algo_reset, count_health, decision,
                 sketch=sketch, sketch_ways=sketch_ways,
                 sketch_pallas=use_pallas, sketch_interpret=interpret,
+                victim=victim, st_rows=st_rows,
             )
 
         st_algo = (st_rows[:, COL_DIVIDER].astype(jnp.int32) >> ALGO_SHIFT) & 7
@@ -886,6 +888,7 @@ def _slab_update_sorted(
         count_health, decision,
         sketch=sketch, sketch_ways=sketch_ways,
         sketch_pallas=use_pallas, sketch_interpret=interpret,
+        victim=victim, st_rows=st_rows,
     )
 
 
@@ -896,6 +899,7 @@ def _finish_update(
     div_store, prev_store, aux_store, algo_reset,
     count_health, decision,
     sketch=None, sketch_ways=0, sketch_pallas=False, sketch_interpret=False,
+    victim=False, st_rows=None,
 ):
     """The shared tail of _slab_update_sorted — one row write per slot,
     the health reductions, and the return tuple — factored out so the
@@ -909,7 +913,18 @@ def _finish_update(
     segment, weighted by the segment's total hits, updates the sketch in
     the same program. When on, the return tuple grows ONE trailing
     element (the new sketch) — conditional arity keeps every existing
-    destructuring call site untouched."""
+    destructuring call site untouched.
+
+    victim (static gate, same discipline): True appends the EVICTED LIVE
+    ROWS as one more trailing element — uint32[b, ROW_WIDTH] in sorted
+    order, each lane either the full stored row a winning insert
+    displaced from a live in-window way (the ONLY lossy eviction class)
+    or all-zero. st_rows must be the sorted picked rows when on. This is
+    the demote readback of the host-RAM victim tier
+    (backends/victim.py): the engine drains the nonzero lanes into the
+    host table instead of letting the counters vanish. False compiles
+    the byte-identical no-readback program — the VICTIM_TIER_ENABLED
+    rollback arm."""
     # --- one row write per SLOT: the final item in the slot's run ---
     is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.array([True])])
     s_valid = s_hits > 0
@@ -971,8 +986,17 @@ def _finish_update(
         health,
         decision,
     )
+    if victim:
+        # demote readback: the stored row each WINNING insert displaced
+        # from a live in-window way, zero everywhere else. Sorted order —
+        # the host only filters nonzero lanes, so no unsort is needed.
+        # Recomputed from evict_class (not the count_health block, which
+        # may be compiled out) so the readback never depends on the
+        # health flag.
+        demote = s_valid & is_last & (evict_class[order] == EVICT_LIVE)
+        victim_rows = jnp.where(demote[:, None], st_rows, jnp.uint32(0))
     if sketch is None:
-        return base
+        return base if not victim else (*base, victim_rows)
 
     from .sketch import sketch_update
 
@@ -992,7 +1016,8 @@ def _finish_update(
         sketch, s_fp_lo, s_fp_hi, weight, cand, sketch_ways,
         use_pallas=sketch_pallas, interpret=sketch_interpret,
     )
-    return (*base, new_sketch)
+    out = (*base, new_sketch)
+    return out if not victim else (*out, victim_rows)
 
 
 def _slab_step_sorted(
@@ -1199,7 +1224,10 @@ def _unpack(packed: jnp.ndarray) -> tuple[SlabBatch, jnp.ndarray, jnp.ndarray, j
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ways", "out_dtype", "use_pallas", "multi_algo", "sketch_ways"),
+    static_argnames=(
+        "ways", "out_dtype", "use_pallas", "multi_algo", "sketch_ways",
+        "victim",
+    ),
     donate_argnames=("state", "sketch"),
 )
 def slab_step_after(
@@ -1211,20 +1239,29 @@ def slab_step_after(
     multi_algo: bool = True,
     sketch: jnp.ndarray | None = None,
     sketch_ways: int = 0,
+    victim: bool = False,
 ) -> tuple[SlabState, jnp.ndarray, jnp.ndarray]:
     """Stateful update only; returns (post-increment counters in arrival
     order, saturating-cast to out_dtype, uint32[HEALTH_WIDTH] health). The
     caller guarantees max(limit) + max(hits) < dtype max. use_pallas runs
     the Mosaic way-scan + fused INCRBY kernel (no decide outputs). A
     non-None sketch (the HOTKEYS_ENABLED arm) appends the updated hotkey
-    planes as a 4th return element; None compiles the byte-identical
-    pre-hotkeys program (slab_step_packed's gate commentary)."""
+    planes as an extra return element; None compiles the byte-identical
+    pre-hotkeys program (slab_step_packed's gate commentary). victim=True
+    (the VICTIM_TIER_ENABLED arm) appends the evicted-live-rows readback
+    — uint32[b, ROW_WIDTH], nonzero lanes are the full stored rows this
+    launch displaced from live in-window ways (_finish_update) — as the
+    LAST element; False compiles the byte-identical no-readback
+    program."""
     batch, now, _, burst_ratio = _unpack(packed)
     outs = _slab_update_sorted(
         state, batch, now, ways, use_pallas=use_pallas,
         burst_ratio=burst_ratio, multi_algo=multi_algo,
-        sketch=sketch, sketch_ways=sketch_ways,
+        sketch=sketch, sketch_ways=sketch_ways, victim=victim,
     )
+    victim_rows = None
+    if victim:
+        *outs, victim_rows = outs
     new_sketch = None
     if sketch is not None:
         *outs, new_sketch = outs
@@ -1232,7 +1269,9 @@ def slab_step_after(
     after = _unsort(s_after, order)
     cap = jnp.uint32(jnp.iinfo(out_dtype).max)
     base = (state, jnp.minimum(after, cap).astype(out_dtype), health)
-    return base if sketch is None else (*base, new_sketch)
+    if sketch is not None:
+        base = (*base, new_sketch)
+    return base if not victim else (*base, victim_rows)
 
 
 @functools.partial(
@@ -1307,6 +1346,104 @@ def slab_import_rows(rows, device=None) -> SlabState:
     if device is not None:
         table = jax.device_put(table, device)
     return SlabState(table=table)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ways",), donate_argnames=("state",)
+)
+def slab_promote_rows(
+    state: SlabState,
+    rows: jnp.ndarray,  # uint32[k, ROW_WIDTH] victim-tier rows (0 = padding)
+    now: jnp.ndarray,  # int32 scalar
+    ways: int = DEFAULT_WAYS,
+) -> tuple[SlabState, jnp.ndarray]:
+    """Re-insert demoted rows from the host-RAM victim tier
+    (backends/victim.py) into the slab ahead of a launch that is about to
+    touch their keys — the promote half of the HBM<->host hierarchy. The
+    row lands with its counter, window, divider, algorithm bits, and
+    sliding/GCRA auxiliary words INTACT, so a demoted key resumes
+    mid-window instead of resetting.
+
+    Placement rides the SAME set scan as the hot path (_choose_ways), so
+    a promoted row lands exactly where a request for its key will look.
+    Promotion is a SWAP, not a polite insert: the engine only promotes
+    keys present in the imminent batch, whose miss would evict the set's
+    least-valuable way anyway — so the promote takes that same way
+    up-front, and when the way held a LIVE in-window row the displaced
+    row comes back in the `displaced` readback for the host to drain
+    into the victim tier. Nothing is lost in either direction; the cost
+    of a hot set is swap traffic, which the keyspace_overload bench
+    prices. Per-lane outcomes:
+
+      * fp match: the slab re-created the row while it sat demoted —
+        keep-the-newest (persist/snapshot.py merge_rows_into_table rule:
+        greater window wins, equal windows keep the greater count);
+        either way the lane reports landed (the victim copy is consumed
+        or provably stale);
+      * no match: the row overwrites the scan's victim way; a displaced
+        live in-window row is reported for re-demotion.
+
+    Two lanes picking one slot serialize like the hot path: sort by
+    (slot, matched), the run's last write wins; losers report landed
+    False, stay in the tier, and retry on a later launch. Padding lanes
+    (all-zero rows, or rows whose own expire_at already passed) drop
+    with landed False — the tier's reclamation, not this kernel,
+    retires them.
+
+    Returns (state, bool[k] landed in arrival order, uint32[k,
+    ROW_WIDTH] displaced rows — sorted order, nonzero lanes only, the
+    same filter-don't-unsort contract as the demote readback)."""
+    n = state.n_slots
+    now = jnp.asarray(now).astype(jnp.int32)
+    k = rows.shape[0]
+    valid = rows[:, COL_EXPIRE].astype(jnp.int32) > now
+    batch = SlabBatch(
+        fp_lo=rows[:, COL_FP_LO],
+        fp_hi=rows[:, COL_FP_HI],
+        hits=valid.astype(jnp.uint32),
+        limit=rows[:, COL_COUNT],
+        divider=(rows[:, COL_DIVIDER] & jnp.uint32(ALGO_DIV_MASK)).astype(
+            jnp.int32
+        ),
+        jitter=jnp.zeros((k,), dtype=jnp.int32),
+    )
+    chosen, evict_class, matched, picked_rows = _choose_ways(
+        state, batch, now, ways
+    )
+    # keep-the-newest vs a matched live row (windows are unix-seconds
+    # magnitudes, so the uint32 compare is exact)
+    newer = (rows[:, COL_WINDOW] > picked_rows[:, COL_WINDOW]) | (
+        (rows[:, COL_WINDOW] == picked_rows[:, COL_WINDOW])
+        & (rows[:, COL_COUNT] > picked_rows[:, COL_COUNT])
+    )
+    stale = matched & valid & ~newer
+    want_write = valid & ~stale
+    # serialize same-slot collisions exactly like the hot path's sort
+    # key: matched lanes order after evictor lanes, so the winning write
+    # of a contended way is always the fp match
+    key = (chosen.astype(jnp.uint32) << 1) | matched.astype(jnp.uint32)
+    (_, order) = jax.lax.sort(
+        (key, jnp.arange(k, dtype=jnp.int32)), num_keys=1, is_stable=True
+    )
+    s_chosen = chosen[order]
+    is_last = jnp.concatenate(
+        [s_chosen[1:] != s_chosen[:-1], jnp.array([True])]
+    )
+    s_wrote = want_write[order] & is_last
+    write_idx = jnp.where(s_wrote, s_chosen, jnp.int32(n))
+    table = _scatter_rows(state.table, write_idx, rows[order])
+    # the swap's far side: a winning write over a live in-window way
+    # (EVICT_LIVE implies no fp match) hands that row back for
+    # re-demotion — the promote path's own never-lose-a-counter rule
+    s_displaced = s_wrote & (evict_class[order] == EVICT_LIVE)
+    displaced = jnp.where(
+        s_displaced[:, None], picked_rows[order], jnp.uint32(0)
+    )
+    # landed = the tier may retire the row: written, or matched a row
+    # that is already fresher than the victim copy
+    s_landed = s_wrote | stale[order]
+    landed = _unsort(s_landed, order)
+    return SlabState(table=table), landed, displaced
 
 
 def make_split_programs(ways: int):

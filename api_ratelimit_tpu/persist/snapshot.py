@@ -101,6 +101,14 @@ FLAG_PARTITION = 2
 # borrowers). Same (n, 8) uint32 shape as the other table kinds; the
 # flag keeps it from masquerading as a slab shard or lease table.
 FLAG_FED = 4
+# FLAG_VICTIM (backends/victim.py): the host-RAM victim tier — demoted
+# live slab rows awaiting promotion, stored in the SAME slab row wire
+# (fp_lo, fp_hi, count, window, expire, divider, ...), so restore runs
+# the ordinary reconcile_rows clock discipline before re-seeding the
+# tier. The flag keeps it from masquerading as a slab shard: a victim
+# table must never be imported onto the device directly (its rows were
+# evicted precisely because the slab had no room for them).
+FLAG_VICTIM = 8
 FLAG_WAYS_SHIFT = 16
 
 _PARTITION_EXT = struct.Struct("<IIII")

@@ -39,6 +39,7 @@ from .snapshot import (
     FED_ROW_WIDTH,
     FLAG_FED,
     FLAG_LEASE_TABLE,
+    FLAG_VICTIM,
     LEASE_ROW_WIDTH,
     ROW_WIDTH,
     SNAPSHOT_VERSION,
@@ -81,6 +82,15 @@ def fed_snapshot_path(directory: str) -> str:
     the ledger is global, not per-shard), written with FLAG_FED so it can
     never masquerade as a slab shard or a lease table."""
     return os.path.join(directory, "fed.snap")
+
+
+def victim_snapshot_path(directory: str) -> str:
+    """The victim-tier section of the snapshot set (one file — the tier
+    is host-global, not per-shard), written with FLAG_VICTIM so it can
+    never masquerade as a slab shard: its rows are DEMOTED state, and a
+    restart must re-seed them into the tier for promotion, not upload
+    them onto a device that had no room for them."""
+    return os.path.join(directory, "victim.snap")
 
 
 class SlabSnapshotter:
@@ -157,6 +167,7 @@ class SlabSnapshotter:
         self._g_rows = self._g_dropped_expired = self._g_dropped_window = None
         self._g_leases = self._g_dropped_leases = None
         self._g_fed = self._g_dropped_fed = None
+        self._g_victim = self._g_dropped_victim = None
         self._h_write = None
         if scope is not None:
             snap = scope.scope("snapshot")
@@ -172,6 +183,8 @@ class SlabSnapshotter:
             self._g_dropped_leases = snap.gauge("restore_dropped_leases")
             self._g_fed = snap.gauge("restore_fed_shares")
             self._g_dropped_fed = snap.gauge("restore_dropped_fed_shares")
+            self._g_victim = snap.gauge("restore_victim_rows")
+            self._g_dropped_victim = snap.gauge("restore_dropped_victim_rows")
             self._h_write = snap.histogram("write_ms")
             scope.add_stat_generator(self)
         os.makedirs(directory, exist_ok=True)
@@ -271,6 +284,25 @@ class SlabSnapshotter:
                             fault_injector=self._faults,
                             flags=FLAG_FED,
                         )
+                # victim-tier section: demoted live rows ride the same
+                # snapshot set so a restart resumes them mid-window
+                # instead of re-serving a fresh window to every demoted
+                # key (backends/victim.py). Tier-less deployments keep
+                # the exact pre-tier snapshot set; once the file exists
+                # it is maintained even when the tier drains empty — a
+                # stale victim file must never re-seed dead counters.
+                victim = getattr(self._engine, "victim_tier", None)
+                if victim is not None:
+                    victim_rows = victim.export_rows()
+                    victim_path = victim_snapshot_path(self._dir)
+                    if victim_rows.shape[0] or os.path.exists(victim_path):
+                        total += write_snapshot(
+                            victim_path,
+                            victim_rows,
+                            created_at=now,
+                            fault_injector=self._faults,
+                            flags=FLAG_VICTIM,
+                        )
             except Exception as e:
                 self.write_errors_total += 1
                 if self._c_errors is not None:
@@ -347,6 +379,7 @@ class SlabSnapshotter:
                 tables.append(table)
             lease_stats = self._restore_leases(tables, now)
             fed_stats = self._restore_fed(tables, now)
+            victim_stats = self._restore_victim(now)
             self._engine.import_tables(tables)
         except (SnapshotError, OSError, ValueError) as e:
             self.load_rejected_total += 1
@@ -382,6 +415,7 @@ class SlabSnapshotter:
             **totals,
             **lease_stats,
             **fed_stats,
+            **victim_stats,
         }
         return self.restore_stats
 
@@ -495,6 +529,63 @@ class SlabSnapshotter:
                 rec["dropped"],
                 floored,
                 unmatched,
+            )
+        return stats
+
+    def _restore_victim(self, now: int) -> dict:
+        """The victim-tier half of restore: reconcile victim.snap against
+        the clock (the SAME reconcile_rows rules the slab shards get —
+        dead and window-ended demoted rows carry no decision state and
+        drop; snapshot.restore_dropped_victim_rows), then re-seed the
+        engine's tier so every surviving demoted key still resumes
+        mid-window across the restart. import_rows re-applies the running
+        config's bounds, so a snapshot written under a larger
+        VICTIM_MAX_ROWS can never overflow a smaller tier. A bad victim
+        file degrades to a tier-less restore (counted in load_rejected),
+        never a cold boot."""
+        victim = getattr(self._engine, "victim_tier", None)
+        path = victim_snapshot_path(self._dir)
+        stats = {"restored_victim_rows": 0, "dropped_victim_rows": 0}
+        if victim is None or not os.path.exists(path):
+            return stats
+        try:
+            header, rows = load_snapshot(path, fault_injector=self._faults)
+            if header.flags != FLAG_VICTIM:
+                raise SnapshotError(
+                    f"{path}: flags {header.flags} is not a victim tier"
+                )
+            if header.row_width != ROW_WIDTH:
+                raise SnapshotError(
+                    f"{path}: victim row width {header.row_width} != "
+                    f"{ROW_WIDTH}"
+                )
+            kept, rec = reconcile_rows(rows, now)
+        except (SnapshotError, OSError, ValueError) as e:
+            self.load_rejected_total += 1
+            if self._c_rejected is not None:
+                self._c_rejected.inc()
+            _log.warning(
+                "victim tier snapshot rejected (slab restores without the "
+                "tier's demoted rows): %s",
+                e,
+            )
+            return stats
+        kept = kept[kept.any(axis=1)]  # compact: the tier stores occupied
+        absorbed = victim.import_rows(kept, now)
+        dropped = rec["dropped_expired"] + rec["dropped_window"]
+        stats = {
+            "restored_victim_rows": absorbed,
+            "dropped_victim_rows": dropped,
+        }
+        if self._g_victim is not None:
+            self._g_victim.set(absorbed)
+            self._g_dropped_victim.set(dropped)
+        if absorbed or dropped:
+            _log.info(
+                "victim tier restored: %d demoted rows re-seeded (%d "
+                "dead/window-ended dropped)",
+                absorbed,
+                dropped,
             )
         return stats
 

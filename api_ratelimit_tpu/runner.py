@@ -119,6 +119,7 @@ def create_limiter(
         if ladder is not None:
             kwargs["buckets"] = ladder
         hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
+        v_enabled, v_max_rows, v_watermark = settings.victim_config()
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
@@ -140,6 +141,8 @@ def create_limiter(
             gcra_burst_ratio=settings.gcra_burst(),
             hotkey_lanes=hk_lanes if hk_enabled else 0,
             hotkey_k=hk_k,
+            victim_max_rows=v_max_rows if v_enabled else 0,
+            victim_watermark=v_watermark,
             **kwargs,
         )
     if backend == "tpu-sidecar":
@@ -483,10 +486,33 @@ class Runner:
                 "/debug/hotkeys",
                 lambda: json.dumps(cache.hotkeys_debug(), indent=2),
             )
+        # Victim-tier telemetry (VICTIM_TIER_ENABLED; backends/victim.py):
+        # the VictimStats generator IS the tier's TTL/window reclamation
+        # cadence — each stats flush reclaims dead rows, publishes
+        # ratelimit.victim.* and the full occupancy/age document behind
+        # GET /debug/victim.
+        if engine is not None and getattr(engine, "victim_enabled", False):
+            from .backends.tpu import VictimStats
+
+            self.stats_store.add_stat_generator(
+                VictimStats(engine, self.scope.scope("victim"))
+            )
+        if hasattr(cache, "victim_debug"):
+            self.server.add_debug_endpoint(
+                "/debug/victim",
+                lambda: json.dumps(cache.victim_debug(), indent=2),
+            )
         # Watermark degraded probe: slab pressure/saturation shows up in
         # the /healthcheck body next to the fallback/overload reasons.
         if engine is not None and hasattr(engine, "watermark_reason"):
             self.server.health.add_degraded_probe(engine.watermark_reason)
+        # ... and the victim tier's own occupancy watermark beside it: a
+        # tier filling toward value-ranked overflow is pressure building
+        # one level down the hierarchy.
+        if engine is not None and hasattr(engine, "victim_watermark_reason"):
+            self.server.health.add_degraded_probe(
+                engine.victim_watermark_reason
+            )
         # Device-owner failover probe (SIDECAR_ADDRS; backends/sidecar.py):
         # while this frontend serves from a standby address the cluster is
         # one failure from the degradation ladder — /healthcheck carries

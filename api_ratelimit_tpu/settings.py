@@ -358,6 +358,26 @@ class Settings:
     # min(SLAB_WAYS, lanes). 128 = one TPU lane register of head keys —
     # top-16 reporting with 8x slack for churn.
     hotkey_lanes: int = 128
+    # --- tiered slab: host-RAM victim tier (backends/victim.py) ---
+    # VICTIM_TIER_ENABLED: drain in-kernel live evictions into a bounded
+    # host-RAM victim table and re-promote a demoted key's row onto the
+    # slab (counter/divider/algorithm bits intact) the next time its
+    # fingerprint appears — live eviction stops losing counters under
+    # keyspace overload. false (the default) is the byte-identical
+    # rollback arm: the launch compiles with victim=False, so the traced
+    # program and the slab bytes are exactly the pre-tier engine's
+    # (pinned by test, same discipline as HOTKEYS_ENABLED /
+    # LEASE_ENABLED).
+    victim_tier_enabled: bool = False
+    # VICTIM_MAX_ROWS: the tier's occupancy bound; past it the tier
+    # reclaims dead/window-ended rows first, then drops the lowest-count
+    # row (value-ranked overflow, counted in
+    # ratelimit.victim.overflow_drops) — bounded memory, never OOM.
+    victim_max_rows: int = 1 << 20
+    # VICTIM_WATERMARK: tier-occupancy fraction past which the sticky
+    # degraded health probe raises (observability only; serving is never
+    # touched).
+    victim_watermark: float = 0.85
     # --- global quota federation (cluster/federation.py) ---
     # FED_ENABLED turns on multi-cluster quota federation: each key's
     # home cluster (deterministic over the sorted FED_PEERS membership)
@@ -596,6 +616,23 @@ class Settings:
                 f"HOTKEY_K ({k}) must not exceed HOTKEY_LANES ({lanes})"
             )
         return bool(self.hotkeys_enabled), k, lanes
+
+    def victim_config(self) -> tuple[bool, int, float]:
+        """Validated (enabled, max_rows, watermark) for the host-RAM
+        victim tier. Junk fails the boot like every other knob — a typo'd
+        row bound must not silently become 'no tier' (counters would go
+        back to vanishing on live eviction)."""
+        max_rows = int(self.victim_max_rows)
+        watermark = float(self.victim_watermark)
+        if max_rows < 1:
+            raise ValueError(
+                f"VICTIM_MAX_ROWS must be >= 1, got {max_rows}"
+            )
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(
+                f"VICTIM_WATERMARK must be in (0, 1], got {watermark}"
+            )
+        return bool(self.victim_tier_enabled), max_rows, watermark
 
     def sidecar_addresses(self) -> list[str]:
         """The frontend's device-owner failover list: parsed SIDECAR_ADDRS
@@ -1028,6 +1065,9 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("hotkeys_enabled", "HOTKEYS_ENABLED", _parse_bool),
     ("hotkey_k", "HOTKEY_K", int),
     ("hotkey_lanes", "HOTKEY_LANES", int),
+    ("victim_tier_enabled", "VICTIM_TIER_ENABLED", _parse_bool),
+    ("victim_max_rows", "VICTIM_MAX_ROWS", int),
+    ("victim_watermark", "VICTIM_WATERMARK", float),
     ("fed_enabled", "FED_ENABLED", _parse_bool),
     ("fed_self", "FED_SELF", str),
     ("fed_peers", "FED_PEERS", str),

@@ -98,6 +98,23 @@ Sites wired in this codebase (backends/sidecar.py, backends/batcher.py):
                             resyncs), error/torn_write/corrupt poison
                             the frame so the connection drops, never a
                             suspect grant or settle
+    victim.demote           tiered slab, DEMOTE side (backends/tpu.py
+                            _drain_victim): fires between a launch's
+                            demoted-live-row readback and the host
+                            victim-table insert — drop silently loses
+                            the rows (the pre-tier behavior, so a chaos
+                            arm can measure exactly what the tier buys),
+                            error counts victim.demote_errors and fails
+                            open (rows lost, serving untouched),
+                            delay_ms models a slow host table
+    victim.promote          tiered slab, PROMOTE side (backends/tpu.py
+                            _inject_promotes_locked): fires before the
+                            pre-step promote injection — drop/error skip
+                            the injection entirely (rows STAY in the
+                            tier: promotion is retry-forever, the key
+                            just keeps missing until the site heals),
+                            delay_ms stalls the dispatch path the way a
+                            slow promote launch would
 
 The injector is mutable at runtime (configure()/clear()) so chaos tests can
 clear faults mid-scenario — e.g. to watch a circuit breaker's half-open

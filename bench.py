@@ -1254,6 +1254,203 @@ def bench_hotkeys(device, on_tpu: bool, left=lambda: 1e9) -> dict:
     return result
 
 
+def bench_keyspace_overload(device, on_tpu: bool, left=lambda: 1e9) -> dict:
+    """Tiered-slab victim tier (round 18, backends/victim.py): loss under
+    keyspace overload, measured as a differential against the exact
+    unbounded per-key oracle (testing/oracle.py VictimOracle), tier-on vs
+    tier-off arms interleaved launch-by-launch over the IDENTICAL stream.
+
+    The sweep offers a live keyspace of {1,2,5,10,50}x the slab's row
+    capacity. The stream is structured, not statistical — one key per set
+    per launch, each set round-robining its own key pool on a fixed clock
+    — so slab contention drops and window churn are exactly zero and the
+    only loss mechanism in play is the one this tier exists to end:
+    in-kernel live eviction resetting a counter. limit=1 gives the
+    differential maximal teeth (every revisit of a surviving counter is
+    an oracle OVER; every reset re-admits). Per multiplier the row
+    reports:
+
+      * off arm: false-admit count/ppm vs the oracle, the engine's own
+        loss_ppm and evictions_live — the silent-loss baseline;
+      * on arm: the same, plus the stated bound's loss terms (slab
+        HEALTH drops + the tier's value-ranked overflow ledger
+        overflow_lost_count_sum) and bound_ok = false_admits <= their
+        sum. VICTIM_MAX_ROWS is sized to 8x slab capacity, so 1x-5x hold
+        the whole overflow (false admits exactly 0) while 10x-50x
+        overflow the TIER too — the bound stays honest where the memory
+        cap bites, which is the graceful-degradation claim;
+      * victim_overhead_pct: tier-on vs tier-off launch wall-time, the
+        demote-drain + promote-injection cost on the dispatch path.
+
+    Host-side tier on the XLA twin by design (same discipline as
+    boundary_burst): the demote/promote work this tier prices is host
+    RAM + numpy either way, and the victim=True launch program itself is
+    the spy-pinned static gate tests/test_victim.py owns."""
+    from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+    from api_ratelimit_tpu.testing.oracle import VictimOracle
+    from api_ratelimit_tpu.utils import FakeTimeSource
+
+    t0 = time.perf_counter()
+    now = 1_000_000
+    n_slots, ways = 256, 4
+    n_sets = n_slots // ways
+    victim_max_rows = 8 * n_slots
+    limit, div = 1, 3600
+    rounds = 500  # 50x: 200-key pools, ~2.5 visits/key — overs everywhere
+    warm_rounds = 2  # first launches pay the jit compile; keep them out
+    # of the A/B clocks (false-admit accounting still covers every round)
+    multipliers = (1, 2, 5, 10, 50)
+
+    def fp_of(set_idx: int, uid: int) -> int:
+        # set = fp_lo & (n_sets-1); distinct colliding keys need distinct
+        # top-16 fp_hi bits (the kernel's winner-per-way rank — the
+        # SetSlabOracle construction tests/test_victim.py uses)
+        fp_lo = (set_idx & (n_sets - 1)) | (uid << 6)
+        fp_hi = (uid + 1) << 16
+        return (fp_hi << 32) | fp_lo
+
+    def make_engine(max_rows: int) -> SlabDeviceEngine:
+        return SlabDeviceEngine(
+            FakeTimeSource(now),
+            n_slots=n_slots,
+            ways=ways,
+            buckets=(n_sets,),
+            max_batch=n_sets,
+            use_pallas=False,
+            victim_max_rows=max_rows,
+        )
+
+    result: dict = {
+        "n_slots": n_slots,
+        "ways": ways,
+        "sets": n_sets,
+        "victim_max_rows": victim_max_rows,
+        "limit": limit,
+        "rounds": rounds,
+        "batch_per_round": n_sets,
+        "sweep": [],
+    }
+
+    for mult in multipliers:
+        if left() < 25:
+            result["sweep"].append({"multiplier": mult, "skipped": "budget"})
+            continue
+        pool = mult * ways  # keys per set
+        arms = {"off": make_engine(0), "on": make_engine(victim_max_rows)}
+        oracle = VictimOracle()
+        counts = {
+            name: {"false_admits": 0, "false_overs": 0, "launch_s": 0.0}
+            for name in arms
+        }
+        oracle_overs = decisions = 0
+        for r in range(rounds):
+            batch = [fp_of(s, 1 + (r % pool)) for s in range(n_sets)]
+            items = [
+                _Item(fp=fp, hits=1, limit=limit, divider=div, jitter=0)
+                for fp in batch
+            ]
+            codes = oracle.step_batch(
+                [
+                    (fp & 0xFFFFFFFF, fp >> 32, 1, limit, div, 0)
+                    for fp in batch
+                ],
+                now,
+            )
+            decisions += len(batch)
+            oracle_overs += sum(1 for c in codes if c == 2)
+            for name, eng in arms.items():  # interleaved: drift hits both
+                t_l = time.perf_counter()
+                afters = eng._launch(items)
+                if r >= warm_rounds:
+                    counts[name]["launch_s"] += time.perf_counter() - t_l
+                for after, code in zip(afters, codes):
+                    if code == 2 and after <= limit:
+                        counts[name]["false_admits"] += 1
+                    if code == 1 and after > limit:
+                        counts[name]["false_overs"] += 1
+        timed = (rounds - warm_rounds) * n_sets
+        row: dict = {
+            "multiplier": mult,
+            "keyspace": pool * n_sets,
+            "decisions": decisions,
+            "oracle_overs": oracle_overs,
+        }
+        for name, eng in arms.items():
+            health = eng.health_snapshot()
+            c = counts[name]
+            arm: dict = {
+                "false_admits": c["false_admits"],
+                "false_admit_ppm": round(
+                    c["false_admits"] / decisions * 1e6, 1
+                ),
+                "false_overs": c["false_overs"],
+                "loss_ppm": health["loss_ppm"],
+                "evictions_live": health["evictions_live"],
+                "launch_s": round(c["launch_s"], 4),
+                "rate": round(timed / c["launch_s"]),
+            }
+            if name == "on":
+                tier = eng.victim_tier
+                events = (
+                    tier.demotes_total
+                    + tier.promotes_total
+                    + tier.overflow_drops_total
+                )
+                arm.update(
+                    drops=health["drops"],
+                    overflow_lost_count_sum=tier.overflow_lost_count_sum,
+                    bound_ok=(
+                        c["false_admits"]
+                        <= health["drops"] + tier.overflow_lost_count_sum
+                    ),
+                    demotes=tier.demotes_total,
+                    promotes=tier.promotes_total,
+                    tier_rows=tier.rows,
+                    overflow_drops=tier.overflow_drops_total,
+                    watermark_reason=tier.watermark_reason(),
+                    # the cost the A/B prices, per tier event: the extra
+                    # launch wall-time divided over every demote insert,
+                    # landed promote, and overflow scan the arm performed
+                    # (None below capacity — no events to divide over;
+                    # victim_overhead_pct alone is the idle-arm cost)
+                    tier_event_us=(
+                        round(
+                            (c["launch_s"] - counts["off"]["launch_s"])
+                            / events
+                            * 1e6,
+                            2,
+                        )
+                        if events
+                        else None
+                    ),
+                )
+            row[name] = arm
+            eng.close()
+        row["victim_overhead_pct"] = round(
+            (counts["on"]["launch_s"] / counts["off"]["launch_s"] - 1.0)
+            * 100.0,
+            2,
+        )
+        result["sweep"].append(row)
+        print(f"[keyspace_overload] {mult}x: {row}", file=sys.stderr)
+
+    ran = [
+        r for r in result["sweep"]
+        if "skipped" not in r and r["multiplier"] == 5
+    ]
+    if ran:
+        r5 = ran[0]
+        result["headline"] = {
+            "multiplier": 5,
+            "off_false_admit_ppm": r5["off"]["false_admit_ppm"],
+            "on_false_admits": r5["on"]["false_admits"],
+            "on_bound_ok": r5["on"]["bound_ok"],
+            "victim_overhead_pct": r5["victim_overhead_pct"],
+        }
+    result["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return result
+
+
 # ---------------- service-level benches (configs[0..3]) ----------------
 
 _FLAT = """\
@@ -3478,6 +3675,25 @@ def main() -> None:
             configs["hotkeys"] = bench_hotkeys(device, on_tpu, left)
         except Exception as e:
             configs["hotkeys"] = {"error": str(e)[-300:]}
+    emit()
+
+    # tiered-slab victim tier (round 18): false-admit rate vs the exact
+    # unbounded oracle at 1x-50x slab capacity, tier-on/tier-off arms
+    # interleaved, the stated loss bound asserted per row, and the
+    # demote/promote launch-overhead A/B (backends/victim.py)
+    if not tier_selected("keyspace_overload"):
+        configs["keyspace_overload"] = skip_not_selected()
+    elif not arming["keyspace_overload"]["armed"]:
+        configs["keyspace_overload"] = skip_disarmed("keyspace_overload")
+    elif left() < 45:
+        configs["keyspace_overload"] = {"skipped": "budget"}
+    else:
+        try:
+            configs["keyspace_overload"] = bench_keyspace_overload(
+                device, on_tpu, left
+            )
+        except Exception as e:
+            configs["keyspace_overload"] = {"error": str(e)[-300:]}
     emit()
 
     for key, yaml_text in (

@@ -80,6 +80,18 @@ class TestArmTiers:
         )
         assert not arming["sharded"]["armed"]
 
+    def test_keyspace_overload_arms_everywhere(self):
+        """The victim-tier overload differential is host RAM + numpy on
+        the dispatch path — meaningful on any box, so it always arms
+        (and the artifact's tier matrix records that it RAN)."""
+        for hw in (
+            {"host_cpus": 1, "platform": "cpu", "device_count": 1},
+            {"host_cpus": 16, "platform": "tpu", "device_count": 4},
+        ):
+            arming = bench_driver.arm_tiers(hw)
+            assert arming["keyspace_overload"]["armed"], hw
+            assert arming["keyspace_overload"]["reason"]
+
     def test_bench_arm_forces_with_visible_reason(self):
         """A forced run must be visibly a forced run in the artifact."""
         arming = bench_driver.arm_tiers(

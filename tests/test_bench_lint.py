@@ -113,8 +113,66 @@ class TestBenchLint:
         findings = bench_lint.lint_artifact(doc)
         assert any("disarmed" in f and "measurements" in f for f in findings)
 
+    def _ks_row(self):
+        return {
+            "multiplier": 5,
+            "keyspace": 1280,
+            "decisions": 32000,
+            "oracle_overs": 30720,
+            "off": {"false_admits": 26112, "false_admit_ppm": 816000.0},
+            "on": {
+                "false_admits": 0,
+                "false_admit_ppm": 0.0,
+                "drops": 0,
+                "overflow_lost_count_sum": 0,
+                "bound_ok": True,
+            },
+            "victim_overhead_pct": 343.0,
+        }
+
+    def test_keyspace_overload_good_sweep_is_clean(self):
+        doc = _good_doc()
+        doc["configs"]["keyspace_overload"] = {"sweep": [self._ks_row()]}
+        assert bench_lint.lint_artifact(doc) == []
+        # skipped rows inside the sweep are fine as long as they carry
+        # a reason (the generic bare-skip rule covers the empty case)
+        doc["configs"]["keyspace_overload"]["sweep"].append(
+            {"multiplier": 50, "skipped": "budget"}
+        )
+        assert bench_lint.lint_artifact(doc) == []
+
+    def test_keyspace_overload_claim_without_ledger_is_a_finding(self):
+        """A tier-on false-admit count must ride with the bound's loss
+        terms and verdict — a bare zero reads as a claim, not a bound."""
+        doc = _good_doc()
+        row = self._ks_row()
+        del row["on"]["overflow_lost_count_sum"]
+        del row["on"]["bound_ok"]
+        doc["configs"]["keyspace_overload"] = {"sweep": [row]}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("overflow_lost_count_sum" in f for f in findings)
+        assert any("bound_ok" in f for f in findings)
+
+    def test_keyspace_overload_ran_empty_or_armless_is_a_finding(self):
+        doc = _good_doc()
+        doc["configs"]["keyspace_overload"] = {"sweep": []}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("no sweep rows" in f for f in findings)
+        doc["configs"]["keyspace_overload"] = {
+            "sweep": [{"multiplier": 5, "off": {"false_admits": 3}}]
+        }
+        findings = bench_lint.lint_artifact(doc)
+        assert any("without a tier-on arm" in f for f in findings)
+        # skipped/errored tiers are exempt — they didn't claim anything
+        doc["configs"]["keyspace_overload"] = {"skipped": "budget"}
+        assert bench_lint.lint_artifact(doc) == []
+
     def test_checked_in_r16_lints_clean(self):
         path = os.path.join(REPO, "BENCH_r16.json")
+        assert bench_lint.lint_file(path) == []
+
+    def test_checked_in_r18_lints_clean(self):
+        path = os.path.join(REPO, "BENCH_r18.json")
         assert bench_lint.lint_file(path) == []
 
     def test_legacy_rounds_lint_under_legacy_flag(self):

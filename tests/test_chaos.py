@@ -1549,3 +1549,264 @@ class TestSigkillDeviceOwnerWithLeases:
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# Tiered-slab chaos (backends/victim.py): the victim.demote fault site as
+# the "what the tier buys" measurement arm, then the SIGKILL acceptance —
+# an owner killed under eviction pressure restores the victim tier from
+# victim.snap and overshoots the exact oracle by at most one snapshot
+# interval of admitted traffic.
+# ---------------------------------------------------------------------------
+
+
+def _vfp(set_idx, uid):
+    """Colliding fingerprints for a tiny n_slots=8 / ways=2 slab: set =
+    fp_lo & 3, distinct top-16 fp_hi bits per uid (the tests/test_victim.py
+    construction)."""
+    return (((uid + 1) << 16) << 32) | ((set_idx & 3) | (uid << 2))
+
+
+class TestVictimTierChaos:
+    def _pressure(self, eng):
+        """One demotion's worth of set pressure on set 0."""
+        for uid in (1, 2):
+            for _ in range(3):
+                eng._launch(
+                    [_Item(fp=_vfp(0, uid), hits=1, limit=100,
+                           divider=3600, jitter=0)]
+                )
+        eng._launch(
+            [_Item(fp=_vfp(0, 3), hits=1, limit=100, divider=3600, jitter=0)]
+        )
+
+    def test_demote_drop_arm_measures_what_the_tier_buys(self):
+        """victim.demote:drop:1.0 IS the pre-tier behavior (rows silently
+        vanish); clearing the fault mid-scenario — the outage "ends" —
+        restores the hierarchy, so one run measures the tier's value."""
+        inj = FaultInjector.from_spec("victim.demote:drop:1.0")
+        eng = SlabDeviceEngine(
+            FakeTimeSource(1_000_000),
+            n_slots=8,
+            ways=2,
+            buckets=(16,),
+            use_pallas=False,
+            victim_max_rows=64,
+            fault_injector=inj,
+        )
+        self._pressure(eng)
+        assert eng.victim_tier.rows == 0  # the loss arm: nothing absorbed
+        assert inj.fired().get("victim.demote:drop", 0) >= 1
+        inj.clear()  # the outage ends
+        eng._launch(
+            [_Item(fp=_vfp(0, 4), hits=1, limit=100, divider=3600, jitter=0)]
+        )
+        assert eng.victim_tier.rows == 1  # the tier is back in the loop
+
+
+_VICTIM_OWNER_CHILD = """\
+import json, os, sys, time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+
+from api_ratelimit_tpu.backends.sidecar import SlabSidecarServer
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+from api_ratelimit_tpu.utils.timeutil import RealTimeSource
+
+snap_dir, sock, ctl = sys.argv[1], sys.argv[2], sys.argv[3]
+# a deliberately TINY slab (8 rows, 2 ways) so a handful of keys is
+# already keyspace overload -> live evictions -> victim-tier traffic
+engine = SlabDeviceEngine(
+    RealTimeSource(),
+    n_slots=8,
+    ways=2,
+    buckets=(16,),
+    use_pallas=False,
+    block_mode=True,
+    victim_max_rows=256,
+)
+snap = SlabSnapshotter(engine, snap_dir, interval_ms=3_600_000.0)
+snap.restore()  # warm boot: slab shards + victim.snap (FLAG_VICTIM)
+server = SlabSidecarServer(sock, engine)
+with open(ctl + ".ready", "w") as f:
+    f.write("ok")
+while True:  # runs until SIGKILLed / SIGTERMed by the parent
+    if os.path.exists(ctl + ".snap_req"):
+        os.unlink(ctl + ".snap_req")
+        snap.snapshot_once()
+        with open(ctl + ".snap_done", "w") as f:
+            f.write("ok")
+    with open(ctl + ".stats.tmp", "w") as f:
+        json.dump(
+            dict(
+                restore=snap.restore_stats,
+                victim_rows=engine.victim_debug().get("rows", -1),
+            ),
+            f,
+        )
+    os.replace(ctl + ".stats.tmp", ctl + ".stats")
+    time.sleep(0.02)
+"""
+
+
+class TestSigkillVictimTier:
+    """The tiered-slab chaos acceptance: SIGKILL the device-owner process
+    UNDER EVICTION PRESSURE — the hot key's live counter is sitting in
+    the host victim tier, not on the slab, when the process dies. The
+    restarted owner restores the tier from victim.snap and the key
+    RESUMES mid-window: total admitted overshoots the exact per-key
+    oracle by at most the admits of one snapshot interval (everything
+    after the last snapshot_once), never by a whole reset window."""
+
+    LIMIT = 50
+
+    def test_kill9_under_eviction_pressure_restores_victim_snap(
+        self, tmp_path
+    ):
+        import json as json_mod
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        from api_ratelimit_tpu.backends.sidecar import SidecarEngineClient
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        snap_dir = str(tmp_path / "snaps")
+        os.makedirs(snap_dir)
+        sock = str(tmp_path / "owner.sock")
+        ctl = str(tmp_path / "ctl")
+
+        def spawn():
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _VICTIM_OWNER_CHILD.format(repo=repo),
+                    snap_dir,
+                    sock,
+                    ctl,
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+        def wait_ready(timeout=60.0):
+            deadline = time.time() + timeout
+            while not os.path.exists(ctl + ".ready"):
+                assert time.time() < deadline, "device owner never came up"
+                time.sleep(0.05)
+            os.unlink(ctl + ".ready")
+
+        def child_stats(want=None, timeout=30.0):
+            """Latest child stats; with `want` set, polls until the
+            predicate holds (the stats file trails the engine by one
+            publish tick) or returns the last snapshot at timeout."""
+            deadline = time.time() + timeout
+            last = None
+            while time.time() < deadline:
+                try:
+                    with open(ctl + ".stats") as f:
+                        last = json_mod.load(f)
+                except (OSError, ValueError):
+                    last = None
+                if last is not None and (want is None or want(last)):
+                    return last
+                time.sleep(0.05)
+            if last is not None:
+                return last
+            raise AssertionError("child never published stats")
+
+        HOT, FILL, EVICTOR = _vfp(0, 2), _vfp(0, 1), _vfp(0, 3)
+        proc = spawn()
+        try:
+            wait_ready()
+            client = SidecarEngineClient(
+                sock,
+                retries=4,
+                retry_backoff=0.02,
+                retry_backoff_max=0.2,
+                breaker_threshold=0,
+            )
+
+            admitted = [0]
+
+            def sub(fp, n=1):
+                last = 0
+                for _ in range(n):
+                    last = client.submit(
+                        [_Item(fp=fp, hits=1, limit=self.LIMIT,
+                               divider=3600, jitter=0)]
+                    )[0]
+                    if last <= self.LIMIT:
+                        admitted[0] += 1
+                return last
+
+            # the hot key lives on the slab at count 30...
+            assert sub(HOT, 30) == 30
+            # ...until keyspace overload: a heavier neighbor fills its
+            # set and a new key's insert demotes the LIGHTER live row —
+            # the hot counter now exists ONLY in the host victim tier
+            for _ in range(40):
+                client.submit(
+                    [_Item(fp=FILL, hits=1, limit=1_000_000,
+                           divider=3600, jitter=0)]
+                )
+            client.submit(
+                [_Item(fp=EVICTOR, hits=1, limit=1_000_000,
+                       divider=3600, jitter=0)]
+            )
+            got = child_stats(want=lambda s: s["victim_rows"] == 1)
+            assert got["victim_rows"] == 1
+
+            # one deterministic snapshot: slab shards + victim.snap
+            with open(ctl + ".snap_req", "w") as f:
+                f.write("go")
+            deadline = time.time() + 30
+            while not os.path.exists(ctl + ".snap_done"):
+                assert time.time() < deadline, "owner never snapshotted"
+                time.sleep(0.05)
+
+            # one snapshot interval of post-snapshot traffic: the hot
+            # key promotes back out of the tier and RESUMES (31..35) —
+            # these 5 admits are exactly what the kill may lose
+            before_lost = admitted[0]
+            assert sub(HOT, 5) == 35
+            lost_window = admitted[0] - before_lost
+            assert lost_window == 5
+
+            # kill -9 mid-pressure, restart from the snapshot set
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            proc = spawn()
+            wait_ready()
+
+            # the victim tier came back from victim.snap, not cold
+            stats = child_stats(want=lambda s: s["victim_rows"] == 1)
+            assert stats["restore"]["restored"]
+            assert stats["restore"]["restored_victim_rows"] == 1
+            assert stats["victim_rows"] == 1
+
+            # the hot key's FIRST post-restart decision resumes from the
+            # tier-restored counter (30 + 1), not from a silent reset
+            assert sub(HOT, 1) == 31
+            sub(HOT, 59)  # run well past the limit
+
+            # exact single-key oracle: first LIMIT occurrences admitted
+            overshoot = admitted[0] - self.LIMIT
+            assert overshoot <= lost_window, (
+                f"overshoot {overshoot} exceeds one snapshot interval "
+                f"of admitted traffic ({lost_window}) — victim.snap "
+                f"restore must bound the loss"
+            )
+            client.close()
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

@@ -409,6 +409,44 @@ class TestHotkeySettings:
             ).hotkey_config()
 
 
+class TestVictimSettings:
+    """VICTIM_* knobs (backends/victim.py host-RAM victim tier),
+    following the lease_config() junk-rejection pattern: a typo'd bound
+    must fail the boot, never silently become 'no tier' (live-eviction
+    counter loss would come back without a trace)."""
+
+    def test_defaults(self):
+        s = Settings()
+        assert s.victim_tier_enabled is False
+        assert s.victim_max_rows == 1 << 20
+        assert s.victim_watermark == 0.85
+        assert s.victim_config() == (False, 1 << 20, 0.85)
+
+    def test_env_parsing(self):
+        s = new_settings(
+            {
+                "VICTIM_TIER_ENABLED": "true",
+                "VICTIM_MAX_ROWS": "4096",
+                "VICTIM_WATERMARK": "0.5",
+            }
+        )
+        assert s.victim_config() == (True, 4096, 0.5)
+
+    def test_junk_rejected(self):
+        with pytest.raises(ValueError, match="VICTIM_TIER_ENABLED"):
+            new_settings({"VICTIM_TIER_ENABLED": "sideways"})
+        with pytest.raises(ValueError, match="VICTIM_MAX_ROWS"):
+            new_settings({"VICTIM_MAX_ROWS": "many"})
+        with pytest.raises(ValueError, match="VICTIM_MAX_ROWS"):
+            new_settings({"VICTIM_MAX_ROWS": "0"}).victim_config()
+        with pytest.raises(ValueError, match="VICTIM_MAX_ROWS"):
+            new_settings({"VICTIM_MAX_ROWS": "-1"}).victim_config()
+        with pytest.raises(ValueError, match="VICTIM_WATERMARK"):
+            new_settings({"VICTIM_WATERMARK": "1.5"}).victim_config()
+        with pytest.raises(ValueError, match="VICTIM_WATERMARK"):
+            new_settings({"VICTIM_WATERMARK": "0"}).victim_config()
+
+
 class TestReplicationSettings:
     """SIDECAR_ADDRS / REPL_* knobs (persist/replication.py), following
     the lease_config() junk-rejection pattern: a typo'd knob fails the

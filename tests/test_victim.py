@@ -1,0 +1,794 @@
+"""Tiered slab tests: the host-RAM victim tier under keyspace overload.
+
+The acceptance ladder (ISSUE r18):
+
+  * VictimTier unit semantics — keep-the-newest merge, value-ranked
+    overflow with the lost-count ledger, TTL/window reclamation, the
+    sticky watermark, export/import, and the probe-chain invariants
+    under overflow churn;
+  * the slab_promote_rows kernel — swap semantics, stale no-op, the
+    displaced readback, same-slot serialization, inert padding;
+  * the engine hierarchy end-to-end — demote readback drains to the
+    tier, a reappearing key promotes and RESUMES mid-window;
+  * the differential oracle bound — at 5x slab capacity the tier-on
+    engine's false admits against the exact unbounded VictimOracle are
+    <= slab contention drops + tier overflow_lost_count_sum, and a
+    structured stream drives both terms (and so the false admits) to
+    exactly ZERO, while the tier-off control pins a non-zero count;
+  * the VICTIM_TIER_ENABLED=false rollback arm — byte-identical wire
+    rows, verdicts, and slab bytes (spy-pinned, the test_hotkeys.py
+    discipline), plus the victim=False kernel arity gate;
+  * sketch-hot keys never demote — set pressure parks them in the
+    unconditional re-inject queue instead of the tier;
+  * the victim.demote / victim.promote chaos sites;
+  * victim.snap riding the snapshot set (FLAG_VICTIM, boot reconcile).
+
+The SIGKILL-under-eviction-pressure chaos acceptance lives in
+tests/test_chaos.py (TestSigkillVictimTier).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine, _Item
+from api_ratelimit_tpu.backends.victim import VictimTier, _OCCUPIED
+from api_ratelimit_tpu.ops.slab import (
+    ROW_WIDTH,
+    make_slab,
+    slab_promote_rows,
+    slab_step_after,
+)
+from api_ratelimit_tpu.persist.snapshot import (
+    COL_COUNT,
+    COL_DIVIDER,
+    COL_EXPIRE,
+    COL_FP_HI,
+    COL_FP_LO,
+    COL_WINDOW,
+)
+from api_ratelimit_tpu.testing.faults import FaultInjector
+from api_ratelimit_tpu.testing.oracle import VictimOracle
+from api_ratelimit_tpu.utils import FakeTimeSource
+
+NOW = 1_000_000
+
+
+def row(fp_lo, fp_hi, count, window=NOW, expire=NOW + 3600, divider=3600,
+        prev=0, aux=0):
+    return np.array(
+        [fp_lo, fp_hi, count, window, expire, divider, prev, aux],
+        dtype=np.uint32,
+    )
+
+
+def rows(*rs):
+    return np.stack(rs)
+
+
+# -- fingerprint construction -------------------------------------------
+#
+# Engines below run n_slots=8 / ways=2 -> 4 sets; set = fp_lo & 3. uid
+# rides fp_lo bits 2+ (distinct keys, same set) and fp_hi's TOP-16 bits
+# (the kernel's winner-per-way rank needs distinct top bits among
+# colliding distinct keys — testing/oracle.py SetSlabOracle commentary).
+
+
+def fp_of(set_idx: int, uid: int) -> int:
+    fp_lo = (set_idx & 3) | (uid << 2)
+    fp_hi = (uid + 1) << 16
+    return (fp_hi << 32) | fp_lo
+
+
+def split(fp: int) -> tuple[int, int]:
+    return fp & 0xFFFFFFFF, fp >> 32
+
+
+def make_engine(victim_max_rows=64, ts=None, **kw):
+    kw.setdefault("n_slots", 8)
+    kw.setdefault("ways", 2)
+    kw.setdefault("buckets", (16,))
+    kw.setdefault("use_pallas", False)
+    return SlabDeviceEngine(
+        ts or FakeTimeSource(NOW),
+        victim_max_rows=victim_max_rows,
+        **kw,
+    )
+
+
+def item(fp, hits=1, limit=100, divider=3600):
+    return _Item(fp=fp, hits=hits, limit=limit, divider=divider, jitter=0)
+
+
+class TestVictimTierUnit:
+    def test_insert_and_lookup_roundtrip(self):
+        t = VictimTier(max_rows=8)
+        assert t.insert(rows(row(5, 9, 7)), NOW) == 1
+        assert t.rows == 1 and t.demotes_total == 1
+        hit = t.lookup_batch(np.array([5]), np.array([9]))
+        assert hit.shape == (1, ROW_WIDTH)
+        assert int(hit[0, COL_COUNT]) == 7
+        # lookups return copies; the row stays until retire confirms
+        assert t.rows == 1
+        assert t.lookup_batch(np.array([6]), np.array([9])) is None
+
+    def test_zero_lanes_skipped(self):
+        t = VictimTier(max_rows=8)
+        blk = np.zeros((4, ROW_WIDTH), dtype=np.uint32)
+        blk[2] = row(1, 2, 3)
+        assert t.insert(blk, NOW) == 1
+        assert t.rows == 1
+
+    def test_merge_keeps_the_newest(self):
+        t = VictimTier(max_rows=8)
+        t.insert(rows(row(1, 2, count=5, window=NOW)), NOW)
+        # older window loses; same window, lower count loses
+        t.insert(rows(row(1, 2, count=50, window=NOW - 3600)), NOW)
+        t.insert(rows(row(1, 2, count=3, window=NOW)), NOW)
+        got = t.lookup_batch(np.array([1]), np.array([2]))
+        assert int(got[0, COL_COUNT]) == 5
+        # newer window wins even with a lower count
+        t.insert(rows(row(1, 2, count=1, window=NOW + 3600)), NOW)
+        got = t.lookup_batch(np.array([1]), np.array([2]))
+        assert int(got[0, COL_COUNT]) == 1
+        assert t.rows == 1 and t.merges_total == 3
+
+    def test_retire_only_landed(self):
+        t = VictimTier(max_rows=8)
+        r1, r2 = row(1, 2, 3), row(5, 6, 7)
+        t.insert(rows(r1, r2), NOW)
+        assert t.retire(rows(r1, r2), np.array([True, False])) == 1
+        assert t.rows == 1 and t.promotes_total == 1
+        assert t.lookup_batch(np.array([1]), np.array([2])) is None
+        assert t.lookup_batch(np.array([5]), np.array([6])) is not None
+
+    def test_reclaim_drops_dead_and_window_ended(self):
+        t = VictimTier(max_rows=8)
+        t.insert(
+            rows(
+                row(1, 2, 3, expire=NOW + 10),  # live, window current
+                row(5, 6, 7, expire=NOW - 1),  # TTL-dead
+                # fixed window ended (window + div <= now) but TTL alive
+                row(9, 10, 11, window=NOW - 7200, expire=NOW + 10),
+            ),
+            NOW,
+        )
+        assert t.rows == 3
+        dropped = t.reclaim(NOW)
+        assert dropped == 2 and t.rows == 1 and t.reclaimed_total == 2
+        assert t.lookup_batch(np.array([1]), np.array([2])) is not None
+
+    def test_overflow_is_value_ranked_and_ledgered(self):
+        t = VictimTier(max_rows=2)
+        t.insert(rows(row(1, 2, count=10), row(5, 6, count=20)), NOW)
+        # lower than the table minimum: the INCOMING row drops
+        assert t.insert(rows(row(9, 10, count=4)), NOW) == 0
+        assert t.rows == 2
+        assert t.overflow_drops_total == 1
+        assert t.overflow_lost_count_sum == 4
+        # higher than the minimum: the table's argmin-count row drops
+        assert t.insert(rows(row(13, 14, count=30)), NOW) == 1
+        assert t.rows == 2
+        assert t.overflow_drops_total == 2
+        assert t.overflow_lost_count_sum == 4 + 10
+        assert t.lookup_batch(np.array([1]), np.array([2])) is None
+        assert t.lookup_batch(np.array([13]), np.array([14])) is not None
+
+    def test_overflow_reclaims_first(self):
+        t = VictimTier(max_rows=2)
+        t.insert(rows(row(1, 2, 3, expire=NOW - 1), row(5, 6, 7)), NOW)
+        # the dead row reclaims, so this insert costs no overflow drop
+        assert t.insert(rows(row(9, 10, count=1)), NOW) == 1
+        assert t.overflow_drops_total == 0 and t.reclaimed_total == 1
+        assert t.rows == 2
+
+    def test_watermark_sticky_until_occupancy_falls(self):
+        t = VictimTier(max_rows=4, watermark=0.5)
+        assert t.watermark_reason() is None
+        t.insert(rows(row(1, 2, 3), row(5, 6, 7)), NOW)
+        assert t.watermark_reason() is not None
+        # stays raised while occupancy holds
+        assert "victim tier pressure" in t.watermark_reason()
+        t.retire(rows(row(1, 2, 3)), np.array([True]))
+        assert t.watermark_reason() is None
+
+    def test_export_import_roundtrip(self):
+        t = VictimTier(max_rows=8)
+        t.insert(rows(row(1, 2, 3), row(5, 6, 7)), NOW)
+        exported = t.export_rows()
+        assert exported.shape == (2, ROW_WIDTH)
+        t2 = VictimTier(max_rows=8)
+        assert t2.import_rows(exported, NOW) == 2
+        got = t2.lookup_batch(np.array([1, 5]), np.array([2, 6]))
+        assert got.shape == (2, ROW_WIDTH)
+
+    def test_import_reapplies_bounds(self):
+        big = VictimTier(max_rows=16)
+        blk = np.stack([row(i * 4 + 1, i + 1, count=i + 1) for i in range(8)])
+        big.insert(blk, NOW)
+        small = VictimTier(max_rows=2)
+        small.import_rows(big.export_rows(), NOW)
+        assert small.rows <= 2  # never overflows the running config
+
+    def test_describe_document(self):
+        t = VictimTier(max_rows=8)
+        t.insert(rows(row(1, 2, 3, window=NOW - 30)), NOW)
+        doc = t.describe(NOW)
+        assert doc["rows"] == 1 and doc["max_rows"] == 8
+        assert doc["age_histogram"]["<60s"] == 1
+        assert sum(doc["age_histogram"].values()) == 1
+        assert doc["overflow_lost_count_sum"] == 0
+
+    def test_overflow_churn_keeps_invariants(self):
+        # the regression stress: overflow/rehash must never leave a
+        # stale free-slot — every surviving row stays findable and the
+        # bound holds through heavy churn
+        t = VictimTier(max_rows=32)
+        rng = np.random.default_rng(11)
+        for step in range(400):
+            uid = int(rng.integers(1, 200))
+            t.insert(
+                rows(row(uid * 4 + 1, uid, count=int(rng.integers(1, 50)))),
+                NOW,
+            )
+            assert t.rows <= 32
+        occ = t._slot_state == _OCCUPIED
+        assert int(occ.sum()) == t.rows
+        for r in t._table[occ]:
+            got = t.lookup_batch(
+                np.array([int(r[COL_FP_LO])]), np.array([int(r[COL_FP_HI])])
+            )
+            assert got is not None and int(got[0, COL_COUNT]) == int(
+                r[COL_COUNT]
+            )
+
+
+def _promote(state, blk, now=NOW, ways=2):
+    state, landed, displaced = slab_promote_rows(
+        state, jnp.asarray(blk, dtype=jnp.uint32), now, ways=ways
+    )
+    return state, np.asarray(landed), np.asarray(displaced)
+
+
+class TestPromoteKernel:
+    def _occupied_set(self, state, set_idx, uids, counts, ways=2):
+        """Fill a set's ways via real steps so the table rows carry the
+        kernel's own wire format."""
+        table = np.array(state.table)
+        for uid, count in zip(uids, counts):
+            lo, hi = split(fp_of(set_idx, uid))
+            free = None
+            base = set_idx * ways
+            for w in range(ways):
+                if table[base + w, COL_EXPIRE] == 0:
+                    free = base + w
+                    break
+            table[free] = row(lo, hi, count)
+        from api_ratelimit_tpu.ops.slab import SlabState
+
+        return SlabState(table=jnp.asarray(table))
+
+    def test_promote_lands_in_empty_way(self):
+        state = make_slab(8)
+        lo, hi = split(fp_of(1, 3))
+        state, landed, _ = _promote(state, rows(row(lo, hi, count=9)))
+        assert landed.tolist() == [True]
+        table = np.asarray(state.table)
+        hit = (table[:, COL_FP_LO] == lo) & (table[:, COL_FP_HI] == hi)
+        assert int(table[hit][0, COL_COUNT]) == 9
+
+    def test_promote_swaps_and_reports_displaced(self):
+        state = make_slab(8)
+        state = self._occupied_set(state, 2, uids=(1, 2), counts=(5, 3))
+        lo, hi = split(fp_of(2, 7))
+        state, landed, displaced = _promote(state, rows(row(lo, hi, 40)))
+        assert landed.tolist() == [True]
+        live = displaced[displaced[:, COL_EXPIRE] != 0]
+        # the scan's victim way (lowest count live: count 3) came back
+        assert live.shape[0] == 1
+        assert int(live[0, COL_COUNT]) == 3
+        table = np.asarray(state.table)
+        assert int(table[(table[:, COL_FP_LO] == lo)][0, COL_COUNT]) == 40
+
+    def test_stale_promote_is_noop_but_lands(self):
+        # the slab re-created the row with a NEWER window while the copy
+        # sat demoted: keep-the-newest — the tier copy is provably stale,
+        # reported landed so the tier retires it
+        state = make_slab(8)
+        lo, hi = split(fp_of(0, 4))
+        state = self._occupied_set(state, 0, uids=(4,), counts=(8,))
+        stale = row(lo, hi, count=99, window=NOW - 3600)
+        state, landed, displaced = _promote(state, rows(stale))
+        assert landed.tolist() == [True]
+        table = np.asarray(state.table)
+        assert int(table[(table[:, COL_FP_LO] == lo)][0, COL_COUNT]) == 8
+        assert displaced[displaced[:, COL_EXPIRE] != 0].shape[0] == 0
+
+    def test_newer_promote_overwrites_match(self):
+        state = make_slab(8)
+        lo, hi = split(fp_of(0, 4))
+        state = self._occupied_set(state, 0, uids=(4,), counts=(8,))
+        newer = row(lo, hi, count=12, window=NOW)  # same window, more count
+        state, landed, _ = _promote(state, rows(newer))
+        assert landed.tolist() == [True]
+        table = np.asarray(state.table)
+        assert int(table[(table[:, COL_FP_LO] == lo)][0, COL_COUNT]) == 12
+
+    def test_same_slot_collision_serializes(self):
+        # two promoted rows whose scan picks the same way: the last write
+        # wins, the loser stays un-landed (retries from the tier later)
+        state = make_slab(8)
+        state = self._occupied_set(state, 3, uids=(1, 2), counts=(50, 60))
+        lo_a, hi_a = split(fp_of(3, 7))
+        lo_b, hi_b = split(fp_of(3, 8))
+        blk = rows(row(lo_a, hi_a, 5), row(lo_b, hi_b, 6))
+        state, landed, _ = _promote(state, blk)
+        assert sorted(landed.tolist()) == [False, True]
+        table = np.asarray(state.table)
+        present = {
+            (int(r[COL_FP_LO]), int(r[COL_FP_HI]))
+            for r in table
+            if r[COL_EXPIRE]
+        }
+        winners = {(lo_a, hi_a), (lo_b, hi_b)} & present
+        assert len(winners) == 1
+
+    def test_padding_rows_inert(self):
+        state = make_slab(8)
+        blk = np.zeros((4, ROW_WIDTH), dtype=np.uint32)
+        lo, hi = split(fp_of(1, 2))
+        blk[1] = row(lo, hi, 3)
+        state, landed, displaced = _promote(state, blk)
+        assert landed.tolist() == [False, True, False, False]
+        table = np.asarray(state.table)
+        assert int((table[:, COL_EXPIRE] != 0).sum()) == 1
+        assert displaced[displaced[:, COL_EXPIRE] != 0].shape[0] == 0
+
+    def test_expired_tier_row_drops_unlanded(self):
+        state = make_slab(8)
+        lo, hi = split(fp_of(1, 2))
+        dead = row(lo, hi, 3, expire=NOW - 5)
+        state, landed, _ = _promote(state, rows(dead))
+        assert landed.tolist() == [False]
+        assert int((np.asarray(state.table)[:, COL_EXPIRE] != 0).sum()) == 0
+
+
+class TestEngineHierarchy:
+    def test_demote_then_promote_resumes_mid_window(self):
+        eng = make_engine()
+        fa, fb, fc = fp_of(0, 1), fp_of(0, 2), fp_of(0, 3)
+        for _ in range(5):
+            eng._launch([item(fa)])
+        for _ in range(3):
+            eng._launch([item(fb)])
+        # set 0 is full (A count 5, B count 3); C's insert demotes B
+        eng._launch([item(fc)])
+        tier = eng.victim_tier
+        assert tier.rows == 1 and tier.demotes_total == 1
+        lo_b, hi_b = split(fb)
+        got = tier.lookup_batch(np.array([lo_b]), np.array([hi_b]))
+        assert int(got[0, COL_COUNT]) == 3
+        # B reappears: the promote rides ahead of the step, so THIS
+        # launch already sees the restored counter -> 4, not 1
+        after = eng._launch([item(fb)])
+        assert after == [4]
+        assert tier.promotes_total == 1
+        # the promote displaced a live row, which re-demoted
+        assert tier.demotes_total == 2 and tier.rows == 1
+
+    def test_victim_debug_document(self):
+        eng = make_engine()
+        doc = eng.victim_debug()
+        assert doc["enabled"] is True
+        assert doc["rows"] == 0 and doc["pending_hot"] == 0
+        off = make_engine(victim_max_rows=0)
+        assert off.victim_debug() == {"enabled": False}
+        assert off.victim_tier is None and not off.victim_enabled
+
+    def test_watermark_probe_via_engine(self):
+        eng = make_engine(victim_max_rows=2, victim_watermark=0.5)
+        assert eng.victim_watermark_reason() is None
+        eng.victim_tier.insert(rows(row(1, 2, 3)), NOW)
+        assert "victim tier pressure" in eng.victim_watermark_reason()
+        off = make_engine(victim_max_rows=0)
+        assert off.victim_watermark_reason() is None
+
+
+class TestDifferentialOracle:
+    """The tentpole acceptance: at 5x slab capacity (40 keys over an
+    8-row slab) the tier-on engine admits EXACTLY what the unbounded
+    per-key oracle admits — the bound false_admits <= slab contention
+    drops + tier overflow_lost_count_sum, with a structured stream (one
+    key per set per batch, keyspace within VICTIM_MAX_ROWS, fixed
+    clock) driving both loss terms to zero. The tier-off control under
+    the identical stream pins a NON-zero false-admit count."""
+
+    LIMIT = 3
+    ROUNDS = 60
+    KEYS_PER_SET = 10  # 4 sets x 10 = 40 keys = 5x the 8-row slab
+
+    def _stream(self):
+        for r in range(self.ROUNDS):
+            yield [
+                fp_of(s, 1 + s * self.KEYS_PER_SET + (r % self.KEYS_PER_SET))
+                for s in range(4)
+            ]
+
+    def _drive(self, eng):
+        oracle = VictimOracle()
+        false_admits = false_overs = oracle_overs = 0
+        for batch in self._stream():
+            afters = eng._launch(
+                [item(fp, limit=self.LIMIT) for fp in batch]
+            )
+            codes = oracle.step_batch(
+                [(*split(fp), 1, self.LIMIT, 3600, 0) for fp in batch], NOW
+            )
+            for after, code in zip(afters, codes):
+                engine_over = after > self.LIMIT
+                oracle_overs += code == 2
+                if code == 2 and not engine_over:
+                    false_admits += 1
+                if code == 1 and engine_over:
+                    false_overs += 1
+        return false_admits, false_overs, oracle_overs
+
+    def test_tier_on_false_admits_zero_at_5x_capacity(self):
+        eng = make_engine(victim_max_rows=64)
+        false_admits, false_overs, oracle_overs = self._drive(eng)
+        # the stream crosses the limit hard: half of all decisions are
+        # OVER in the exact model — the comparison has teeth
+        assert oracle_overs == 4 * self.KEYS_PER_SET * (
+            self.ROUNDS // self.KEYS_PER_SET - self.LIMIT
+        )
+        # the stated bound's loss terms, each provably zero here:
+        drops = eng.health_snapshot()["drops"]
+        lost = eng.victim_tier.overflow_lost_count_sum
+        assert drops == 0, "one key per set per batch: no contention"
+        assert lost == 0, "40 keys vs max_rows=64: no tier overflow"
+        assert false_admits <= drops + lost  # the bound itself
+        assert false_admits == 0, (
+            f"victim tier must end silent live-counter loss "
+            f"(false admits: {false_admits})"
+        )
+        # and the hierarchy never overcounts either direction
+        assert false_overs == 0
+        # the tier actually worked for a living: every round past the
+        # first sweep promotes 4 rows and demotes their displacements
+        tier = eng.victim_tier
+        assert tier.promotes_total > 100
+        assert tier.demotes_total > 100
+        assert tier.rows == 40 - 8  # everything not on the slab is here
+
+    def test_tier_off_control_pins_nonzero_loss(self):
+        eng = make_engine(victim_max_rows=0)
+        false_admits, _false_overs, oracle_overs = self._drive(eng)
+        assert oracle_overs > 0
+        # without the tier every live eviction resets a counter: the
+        # engine re-admits keys the exact model already cut off
+        assert false_admits > 0, (
+            "the control arm must exhibit the loss the tier ends — if "
+            "this is 0 the differential test lost its teeth"
+        )
+        assert eng.health_snapshot()["evictions_live"] > 0
+
+
+class TestRollbackArm:
+    """VICTIM_TIER_ENABLED=false must be the pre-tier engine byte for
+    byte: identical wire rows, identical verdicts, identical slab bytes
+    (the spy pin, test_hotkeys.py discipline), and a launch tuple with
+    NO victim readback (the kernel arity gate)."""
+
+    def _make_service(self, victim_max_rows):
+        from test_algorithms import FakeRuntime
+
+        from api_ratelimit_tpu.limiter import BaseRateLimiter
+        from api_ratelimit_tpu.backends.tpu import TpuRateLimitCache
+        from api_ratelimit_tpu.service.ratelimit import RateLimitService
+        from api_ratelimit_tpu.stats import Store, TestSink
+        from api_ratelimit_tpu.models import Descriptor, RateLimitRequest
+
+        yaml_text = (
+            "domain: vic\n"
+            "descriptors:\n"
+            "  - key: k\n"
+            "    rate_limit: {unit: hour, requests_per_unit: 5}\n"
+        )
+        ts = FakeTimeSource(NOW)
+        base = BaseRateLimiter(ts, near_limit_ratio=0.8)
+        cache = TpuRateLimitCache(
+            base,
+            n_slots=1 << 12,
+            buckets=(128,),
+            max_batch=128,
+            use_pallas=False,
+            victim_max_rows=victim_max_rows,
+        )
+        svc = RateLimitService(
+            runtime=FakeRuntime({"config.vic": yaml_text}),
+            cache=cache,
+            stats_scope=Store(TestSink()).scope("ratelimit.service"),
+            time_source=ts,
+        )
+
+        def req(tenant):
+            return RateLimitRequest(
+                domain="vic",
+                descriptors=(Descriptor.of(("k", tenant)),),
+                hits_addend=1,
+            )
+
+        return svc, cache, req
+
+    def test_off_and_on_arms_agree_byte_for_byte(self):
+        svc_off, cache_off, req = self._make_service(0)
+        svc_on, cache_on, _ = self._make_service(1 << 10)
+        assert not cache_off.engine.victim_enabled
+        assert cache_on.engine.victim_enabled
+
+        captured: dict[str, list] = {"off": [], "on": []}
+        for label, cache in (("off", cache_off), ("on", cache_on)):
+            real = cache._batcher._execute
+            bucket = captured[label]
+
+            def spy(blocks, _real=real, _bucket=bucket):
+                _bucket.append([np.array(b) for b in blocks])
+                return _real(blocks)
+
+            cache._batcher._execute = spy
+
+        verdicts = {"off": [], "on": []}
+        for label, svc in (("off", svc_off), ("on", svc_on)):
+            for i in range(8):  # crosses limit 5: OK and OVER both pinned
+                code, _, _ = svc.should_rate_limit(req("t"))
+                verdicts[label].append(code)
+            for i in range(4):
+                code, _, _ = svc.should_rate_limit(req(f"cold{i}"))
+                verdicts[label].append(code)
+
+        # identical verdict stream
+        assert verdicts["off"] == verdicts["on"]
+        # identical wire rows: the tier must not perturb the submit path
+        rows_off = np.concatenate(
+            [b for bs in captured["off"] for b in bs], axis=1
+        )
+        rows_on = np.concatenate(
+            [b for bs in captured["on"] for b in bs], axis=1
+        )
+        np.testing.assert_array_equal(rows_off, rows_on)
+        # identical slab bytes: with no eviction pressure the tier is
+        # pure SIBLING state — the slab never hears about it
+        np.testing.assert_array_equal(
+            np.asarray(cache_off.engine._state.table),
+            np.asarray(cache_on.engine._state.table),
+        )
+        assert cache_on.engine.victim_tier.rows == 0
+        assert cache_off.victim_debug() == {"enabled": False}
+
+    def test_victim_false_compiles_pre_tier_arity(self):
+        # the wire/program half of the byte-identity gate: victim=False
+        # (and the DEFAULT — no caller opts in accidentally) returns the
+        # pre-tier 3-tuple; victim=True appends exactly one trailing
+        # uint32[b, ROW_WIDTH] readback
+        import inspect
+
+        sig = inspect.signature(slab_step_after)
+        assert sig.parameters["victim"].default is False
+
+        packed = np.zeros((7, 16), dtype=np.uint32)
+        lo, hi = split(fp_of(0, 1))
+        packed[0, 0], packed[1, 0] = lo, hi
+        packed[2, 0], packed[3, 0] = 1, 10
+        packed[4, 0] = 3600
+        packed[6, 0] = NOW
+        out_default = slab_step_after(
+            make_slab(8), jnp.asarray(packed), ways=2, use_pallas=False
+        )
+        assert len(out_default) == 3
+        out_on = slab_step_after(
+            make_slab(8),
+            jnp.asarray(packed),
+            ways=2,
+            use_pallas=False,
+            victim=True,
+        )
+        assert len(out_on) == 4
+        assert out_on[-1].shape == (16, ROW_WIDTH)
+        assert out_on[-1].dtype == jnp.uint32
+
+
+class TestHotKeysNeverDemote:
+    def test_sketch_hot_key_refuses_demotion_under_set_pressure(self):
+        eng = make_engine()
+        hot = fp_of(0, 1)
+        lo_h, hi_h = split(hot)
+        # drive the hot key to a LOW count so the eviction scan would
+        # pick it, then pin it hot (PR 15's top-K feeds hot_fps in
+        # production; the test pins the set directly)
+        eng._launch([item(hot)])
+        eng._hot_fps = frozenset({hot})
+        # sustained set pressure: higher-count keys pile into set 0
+        for uid in range(2, 8):
+            for _ in range(3):
+                eng._launch([item(fp_of(0, uid))])
+            # the hot fp must NEVER appear in the tier
+            exported = eng.victim_tier.export_rows()
+            present = {
+                (int(r[COL_FP_LO]), int(r[COL_FP_HI])) for r in exported
+            }
+            assert (lo_h, hi_h) not in present
+        assert eng._victim_hot_refusals > 0
+        # the parked row re-injects unconditionally: the next launch for
+        # ANY key finds the hot row back on the slab, counter intact
+        after = eng._launch([item(hot)])
+        assert after == [2]  # resumed at 1, not reset to 0
+        with eng._victim_lock:
+            assert (lo_h, hi_h) not in eng._promote_pending
+
+
+class TestFaultSites:
+    def _pressure(self, eng):
+        """One demotion's worth of set pressure (set 0 full, then one
+        more key)."""
+        for uid in (1, 2):
+            for _ in range(3):
+                eng._launch([item(fp_of(0, uid))])
+        eng._launch([item(fp_of(0, 3))])
+
+    def test_demote_drop_silently_loses_rows(self):
+        inj = FaultInjector.from_spec("victim.demote:drop:1.0")
+        eng = make_engine(fault_injector=inj)
+        self._pressure(eng)
+        assert eng.victim_tier.rows == 0
+        assert eng._victim_demote_errors == 0
+        assert inj.fired().get("victim.demote:drop", 0) >= 1
+
+    def test_demote_error_counts_and_fails_open(self):
+        inj = FaultInjector.from_spec("victim.demote:error:1.0")
+        eng = make_engine(fault_injector=inj)
+        self._pressure(eng)
+        assert eng.victim_tier.rows == 0
+        assert eng._victim_demote_errors >= 1
+        assert eng.victim_debug()["demote_errors"] >= 1
+        # serving untouched: the next launch still answers
+        assert eng._launch([item(fp_of(1, 9))]) == [1]
+
+    def test_promote_drop_leaves_rows_in_tier(self):
+        eng = make_engine()
+        self._pressure(eng)
+        assert eng.victim_tier.rows == 1
+        demoted_fp = None
+        r = eng.victim_tier.export_rows()[0]
+        demoted_fp = (int(r[COL_FP_HI]) << 32) | int(r[COL_FP_LO])
+        inj = FaultInjector.from_spec("victim.promote:drop:1.0")
+        eng._fault = inj
+        # the key reappears but the promote site is down: the counter
+        # does NOT resume (fresh row) — and the tier row SURVIVES
+        after = eng._launch([item(demoted_fp)])
+        assert after[0] == 1
+        assert eng.victim_tier.rows >= 1
+        assert eng._victim_promote_skips >= 1
+        # the site heals: promotion is retry-forever, the counter comes
+        # back keep-the-newest (the slab's fresh row is same-window with
+        # a LOWER count, so the tier's copy wins)
+        eng._fault = None
+        after = eng._launch([item(demoted_fp)])
+        assert after[0] == 4  # tier count 3 + this hit
+        # the promoted fp retired from the tier (the faulted launch's
+        # insert displaced ANOTHER row, which rightly stays demoted)
+        exported = eng.victim_tier.export_rows()
+        present = {
+            (int(r[COL_FP_LO]), int(r[COL_FP_HI])) for r in exported
+        }
+        assert split(demoted_fp) not in present
+
+
+class TestPersistRoundTrip:
+    def _snap(self, eng, tmp_path):
+        from api_ratelimit_tpu.persist.snapshotter import SlabSnapshotter
+
+        return SlabSnapshotter(
+            eng,
+            str(tmp_path),
+            interval_ms=3_600_000.0,
+            time_source=eng._time_source,
+        )
+
+    def _demote_one(self, eng):
+        for uid in (1, 2):
+            for _ in range(3):
+                eng._launch([item(fp_of(0, uid))])
+        eng._launch([item(fp_of(0, 3))])
+
+    def test_victim_snap_rides_the_snapshot_set(self, tmp_path):
+        import os
+
+        from api_ratelimit_tpu.persist.snapshotter import (
+            victim_snapshot_path,
+        )
+
+        eng = make_engine()
+        self._demote_one(eng)
+        snap = self._snap(eng, tmp_path)
+        assert snap.snapshot_once() > 0
+        path = victim_snapshot_path(str(tmp_path))
+        assert os.path.exists(path)
+
+        # a fresh tier-on engine restores the demoted row and RESUMES
+        eng2 = make_engine(ts=FakeTimeSource(NOW))
+        snap2 = self._snap(eng2, tmp_path)
+        stats = snap2.restore()
+        assert stats["restored"]
+        assert stats["restored_victim_rows"] == 1
+        assert stats["dropped_victim_rows"] == 0
+        assert eng2.victim_tier.rows == 1
+        after = eng2._launch([item(fp_of(0, 2))])
+        assert after == [4]  # demoted at 3, resumed mid-window
+
+    def test_tierless_engine_ignores_victim_section(self, tmp_path):
+        eng = make_engine()
+        self._demote_one(eng)
+        self._snap(eng, tmp_path).snapshot_once()
+        off = make_engine(victim_max_rows=0, ts=FakeTimeSource(NOW))
+        stats = self._snap(off, tmp_path).restore()
+        assert stats["restored"]
+        assert stats.get("restored_victim_rows", 0) == 0
+
+    def test_restore_reconciles_against_the_clock(self, tmp_path):
+        eng = make_engine()
+        self._demote_one(eng)
+        self._snap(eng, tmp_path).snapshot_once()
+        # boot far past every TTL: the row reconciles away, not resumes
+        late = make_engine(ts=FakeTimeSource(NOW + 86_400))
+        stats = self._snap(late, tmp_path).restore()
+        assert stats["restored_victim_rows"] == 0
+        assert stats["dropped_victim_rows"] == 1
+        assert late.victim_tier.rows == 0
+
+    def test_corrupt_victim_file_degrades_to_tierless_restore(
+        self, tmp_path
+    ):
+        from api_ratelimit_tpu.persist.snapshotter import (
+            victim_snapshot_path,
+        )
+
+        eng = make_engine()
+        self._demote_one(eng)
+        self._snap(eng, tmp_path).snapshot_once()
+        path = victim_snapshot_path(str(tmp_path))
+        with open(path, "r+b") as f:
+            f.seek(40)
+            f.write(b"\xff\xff\xff\xff")
+        eng2 = make_engine(ts=FakeTimeSource(NOW))
+        stats = self._snap(eng2, tmp_path).restore()
+        # the SLAB still restores; only the victim section is rejected
+        assert stats["restored"]
+        assert stats.get("restored_victim_rows", 0) == 0
+        assert eng2.victim_tier.rows == 0
+
+
+class TestVictimStats:
+    def test_stats_flush_exports_the_envelope_and_reclaims(self):
+        from api_ratelimit_tpu.backends.tpu import VictimStats
+        from api_ratelimit_tpu.stats import Store, TestSink
+
+        sink = TestSink()
+        store = Store(sink)
+        eng = make_engine()
+        eng.victim_tier.insert(
+            rows(row(1, 2, 3), row(5, 6, 7, expire=NOW - 1)), NOW
+        )
+        gen = VictimStats(eng, store.scope("ratelimit").scope("victim"))
+        gen.generate_stats()
+        store.flush()
+        got = {
+            name: v for name, v in sink.gauges.items() if ".victim." in name
+        }
+        assert got["ratelimit.victim.rows"] == 1  # the dead row reclaimed
+        assert got["ratelimit.victim.demotes"] == 2
+        assert got["ratelimit.victim.reclaimed"] == 1
+        assert got["ratelimit.victim.watermark"] == 0
+        assert got["ratelimit.victim.overflow_lost_count_sum"] == 0

@@ -153,6 +153,51 @@ def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
                         f"numeric value"
                     )
 
+    # claim honesty for the victim-tier overload sweep: a tier-on row
+    # that claims a false-admit count must carry the stated bound's loss
+    # terms (slab HEALTH drops + the tier's overflow ledger) and the
+    # bound verdict — "false_admits": 0 without the ledger it is bounded
+    # against reads as a claim, not a measurement
+    ks = configs.get("keyspace_overload") if isinstance(configs, dict) else None
+    if isinstance(ks, dict) and "skipped" not in ks and "error" not in ks:
+        sweep = ks.get("sweep")
+        if not isinstance(sweep, list) or not sweep:
+            findings.append(
+                "configs.keyspace_overload: ran but carries no sweep rows"
+            )
+        else:
+            for i, srow in enumerate(sweep):
+                if not isinstance(srow, dict) or "skipped" in srow or (
+                    "error" in srow
+                ):
+                    continue
+                on = srow.get("on")
+                if not isinstance(on, dict):
+                    findings.append(
+                        f"configs.keyspace_overload.sweep[{i}]: ran "
+                        f"without a tier-on arm"
+                    )
+                    continue
+                if not isinstance(on.get("false_admits"), int):
+                    findings.append(
+                        f"configs.keyspace_overload.sweep[{i}].on: ran "
+                        f"but carries no false-admit count"
+                    )
+                    continue
+                for field in ("drops", "overflow_lost_count_sum"):
+                    if not isinstance(on.get(field), (int, float)):
+                        findings.append(
+                            f"configs.keyspace_overload.sweep[{i}].on: "
+                            f"false_admits claimed without bound term "
+                            f"{field!r}"
+                        )
+                if not isinstance(on.get("bound_ok"), bool):
+                    findings.append(
+                        f"configs.keyspace_overload.sweep[{i}].on: "
+                        f"false_admits claimed without the bound_ok "
+                        f"verdict"
+                    )
+
     # arming drift: a disarmed tier must not carry numbers
     tiers = doc.get("tiers")
     if isinstance(tiers, dict):

@@ -43,6 +43,7 @@ from api_ratelimit_tpu.persist.snapshot import (  # noqa: E402
     FED_COL_SPENT,
     FLAG_FED,
     FLAG_LEASE_TABLE,
+    FLAG_VICTIM,
     LEASE_COL_EXPIRE,
     LEASE_COL_GRANTED,
     LEASE_COL_SETTLED,
@@ -120,6 +121,59 @@ def inspect_file(path: str, now: int | None) -> dict:
                 "ttl_dead_at_now": int(np.sum(expire_at <= at)),
                 "restorable": rec["restored"],
                 "dropped_on_restore": rec["dropped"],
+            },
+        }
+    if header.flags & FLAG_VICTIM:
+        # victim-tier section (backends/victim.py — the victim.snap file):
+        # demoted live slab rows in the ordinary slab row wire, so the
+        # slab reconcile rules preview the restore and the divider word
+        # classifies per-row algorithms. Age histogram over window
+        # position: how long rows had been parked when the file was cut.
+        occupied = table.any(axis=1)
+        expire_at = table[:, COL_EXPIRE].astype(np.int64)
+        live = occupied & (expire_at > at)
+        _kept, rec = reconcile_rows(table, at)
+        counts = table[:, COL_COUNT].astype(np.int64)
+        algos = row_algorithms(table)
+        algo_counts = {
+            name: int(np.sum(occupied & (algos == aid)))
+            for aid, name in ALGO_NAMES.items()
+        }
+        ages = np.maximum(
+            0, at - table[:, COL_WINDOW].astype(np.int64)
+        )[occupied]
+        age_hist = {}
+        prev = 0
+        for bound, label in (
+            (10, "<10s"),
+            (60, "<60s"),
+            (600, "<600s"),
+            (1 << 62, ">=600s"),
+        ):
+            n = int(np.sum(ages < bound))
+            age_hist[label] = n - prev
+            prev = n
+        return {
+            "path": path,
+            "valid": True,
+            "kind": "victim",
+            "version": header.version,
+            "created_at": header.created_at,
+            "age_seconds": max(0, at - header.created_at),
+            "bytes": os.path.getsize(path),
+            "algorithms": algo_counts,
+            "rows": {
+                "occupied": int(np.sum(occupied)),
+                "live_at_now": int(np.sum(live)),
+                "restorable": rec["restored"],
+                "dropped_expired": rec["dropped_expired"],
+                "dropped_window": rec["dropped_window"],
+                # Σ counts parked in the tier — the decision state the
+                # tier is holding against loss
+                "count_sum": (
+                    int(counts[occupied].sum()) if occupied.any() else 0
+                ),
+                "age_histogram": age_hist,
             },
         }
     occupied = table.any(axis=1)
@@ -250,6 +304,32 @@ def _print_text(report: dict) -> None:
             f"dropped={shares['dropped_on_restore']} "
             f"ttl_dead={shares['ttl_dead_at_now']}"
         )
+        return
+    if report.get("kind") == "victim":
+        rows = report["rows"]
+        print(f"{report['path']}:")
+        print(
+            f"  header  v{report['version']} victim tier "
+            f"created_at={report['created_at']} "
+            f"(age {report['age_seconds']}s) "
+            f"({report['bytes']} bytes)  CRC OK"
+        )
+        print(
+            f"  rows    occupied={rows['occupied']} "
+            f"live={rows['live_at_now']} "
+            f"restorable={rows['restorable']} "
+            f"dropped(expired={rows['dropped_expired']}, "
+            f"window_ended={rows['dropped_window']}) "
+            f"count_sum={rows['count_sum']}"
+        )
+        algos = report.get("algorithms")
+        if algos:
+            body = " ".join(f"{k}:{v}" for k, v in algos.items() if v)
+            print(f"  algos   {body or 'fixed_window:0 (empty)'}")
+        ages = " ".join(
+            f"{k}:{v}" for k, v in rows["age_histogram"].items()
+        )
+        print(f"  ages    {ages}")
         return
     rows = report["rows"]
     print(f"{report['path']}:")
