@@ -11,6 +11,7 @@ NATIVE_SO  := api_ratelimit_tpu/_native/libratelimit_host.so
         tests_chaos tests_cluster tests_hotkeys tests_integration \
         tests_mp tests_with_redis tests_tpu \
         bench bench_smoke bench_fleet bench_report bench_lint \
+        chaos_campaign chaos_smoke \
         profile serve check_config clean docker_image docker_tests
 
 all: compile
@@ -133,6 +134,25 @@ bench_report:
 # runs it over the checked-in rounds via tests/test_bench_lint.py.
 bench_lint:
 	$(PY) -m tools.bench_lint BENCH_r16.json
+
+# Seeded chaos campaign (chaos/, tools/chaos_campaign.py): 10 seeds of
+# the composed nemesis schedule (fault sites, role kills, clock skew,
+# network partition, snapshot corruption) over the closed-loop workload,
+# the admission-ledger bound checked per seed, the provenance-stamped
+# CHAOS_rNN.json artifact written and immediately bench_lint-validated.
+# Deterministic: same seed -> byte-identical timeline and verdict.
+chaos_campaign:
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_campaign.py \
+	  --seeds 10 --steps 120 --out CHAOS_r19.json
+	$(PY) -m tools.bench_lint CHAOS_r19.json
+
+# Two-seed chaos smoke (~2 s): a short composed sweep plus one replay
+# that proves byte-identical determinism — the fast pre-commit arm of
+# chaos_campaign. Exit 1 on any violation or replay mismatch.
+chaos_smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_campaign.py --seeds 2 --steps 30
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_campaign.py \
+	  --seed 1 --steps 30 --replay
 
 # Host-path profile: cProfile over the flat_per_second request loop
 # (tools/hotpath_profile.py; --legacy pins the pre-vectorization path).

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Sequence
 
 from ..limiter.base_limiter import BaseRateLimiter
@@ -69,11 +68,18 @@ class CircuitBreaker:
         self,
         threshold: int,
         reset_seconds: float,
-        clock=time.monotonic,
+        clock=None,
         on_transition=None,
     ):
         self._threshold = int(threshold)
         self._reset = float(reset_seconds)
+        if clock is None:
+            # breaker reset windows are time-semantic: default to the
+            # process clock authority so chaos campaigns can virtualize
+            # them (tools/clock_lint.py)
+            from ..utils.timeutil import process_time_source
+
+            clock = process_time_source().monotonic
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()

@@ -126,6 +126,7 @@ from ..limiter.cache import CacheError
 from ..tracing import activate, active_span, global_tracer
 from ..tracing import journeys
 from ..tracing.propagation import decode_textmap, encode_textmap
+from ..utils.timeutil import process_time_source
 from .fallback import CircuitBreaker
 
 logger = logging.getLogger("ratelimit.sidecar")
@@ -162,6 +163,17 @@ OP_HOTKEYS_GET = 8
 # standard error frame (FED_ENABLED=false serves the byte-identical
 # pre-federation protocol).
 OP_FED_EXCHANGE = 9
+# --- chaos-campaign admin ops (testing/faults.py, utils/timeutil.py) ---
+# runtime fault/clock reconfiguration on a LIVE owner: the wire twins of
+# the debug port's POST /debug/faults and POST /debug/clock, so chaos
+# campaigns can flip faults and skew clocks mid-run without a
+# FAULT_INJECT reboot. Both reply u8 status | u32 len | blob like the
+# cluster admin ops.
+OP_FAULTS_SET = 10  # u32 len | JSON {"spec": str, "seed": int?}
+#                     -> FaultInjector.describe() JSON; a junk spec
+#                     answers the error frame and changes nothing
+OP_CLOCK_SET = 11  # u32 len | JSON {"offset_s": float?, "drift_ppm":
+#                     float?} -> {"unix_now", "skew"} JSON; {} resets
 # header flags (the u16 after op): bit 0 = B3 trace trailer appended,
 # bit 1 = lease-ops trailer appended (before the trace trailer),
 # bit 2 = u32 epoch trailer appended (after the lease trailer, before the
@@ -313,6 +325,7 @@ class SlabSidecarServer:
         shm_control_path: str = "",
         cluster=None,
         fed=None,
+        time_source=None,
     ):
         """address: unix path, tcp://host:port, or tls://host:port.
 
@@ -354,6 +367,11 @@ class SlabSidecarServer:
         self._faults = fault_injector
         self._repl = repl
         self._cluster = cluster
+        # the OP_CLOCK_SET target: the process clock authority unless the
+        # boot (or a chaos harness) hands this owner a specific source
+        self._time_source = (
+            time_source if time_source is not None else process_time_source()
+        )
         # fed: optional cluster.federation.FederationCoordinator — when
         # set, OP_FED_EXCHANGE connections become its exchange loops
         # (borrower peers dialing this cluster's share ledger)
@@ -499,6 +517,8 @@ class SlabSidecarServer:
                         OP_RESHARD_PULL,
                         OP_RESHARD_PUSH,
                         OP_HOTKEYS_GET,
+                        OP_FAULTS_SET,
+                        OP_CLOCK_SET,
                     ):
                         if not self._serve_cluster_op(conn, op):
                             return
@@ -754,9 +774,9 @@ class SlabSidecarServer:
 
         if op == OP_RESHARD_PULL:
             lo, hi, route_sets = struct.unpack("<III", _recv_exact(conn, 12))
-        elif op in (OP_MAP_SET, OP_RESHARD_PUSH):
+        elif op in (OP_MAP_SET, OP_RESHARD_PUSH, OP_FAULTS_SET, OP_CLOCK_SET):
             (blob_len,) = _U32.unpack(_recv_exact(conn, _U32.size))
-            cap = MAX_MAP_BYTES if op == OP_MAP_SET else MAX_RESHARD_BYTES
+            cap = MAX_MAP_BYTES if op != OP_RESHARD_PUSH else MAX_RESHARD_BYTES
             if blob_len > cap:
                 conn.sendall(
                     self._error(f"cluster op body {blob_len} exceeds cap {cap}")
@@ -767,7 +787,11 @@ class SlabSidecarServer:
             conn.sendall(self._error("cluster not configured"))
             return True
         try:
-            if op == OP_HOTKEYS_GET:
+            if op == OP_FAULTS_SET:
+                out = self._serve_faults_set(body)
+            elif op == OP_CLOCK_SET:
+                out = self._serve_clock_set(body)
+            elif op == OP_HOTKEYS_GET:
                 snap_fn = getattr(self._engine, "hotkeys_snapshot", None)
                 snap = (
                     snap_fn()
@@ -787,8 +811,14 @@ class SlabSidecarServer:
                 from ..persist.snapshot import pack_table_bytes
 
                 rows = self._engine.export_route_range(lo, hi, route_sets)
+                engine_ts = getattr(self._engine, "_time_source", None)
+                snap_now = (
+                    engine_ts.unix_now()
+                    if engine_ts is not None
+                    else process_time_source().unix_now()
+                )
                 out = pack_table_bytes(
-                    rows, int(time.time()), ways=getattr(self._engine, "ways", 0)
+                    rows, snap_now, ways=getattr(self._engine, "ways", 0)
                 )
             else:  # OP_RESHARD_PUSH
                 from ..persist.snapshot import unpack_table_bytes
@@ -803,6 +833,46 @@ class SlabSidecarServer:
             return True
         conn.sendall(b"\x00" + _U32.pack(len(out)) + out)
         return True
+
+    def _serve_faults_set(self, body: bytes) -> bytes:
+        """OP_FAULTS_SET: replace the owner's live fault rule set. The
+        injector is the one the engine/snapshotter/repl/fed already hold
+        (cmd/sidecar_cmd.py builds it unconditionally); a junk spec
+        raises, which the cluster-op wrapper answers as the standard
+        error frame — fail-loud, nothing changed."""
+        import json as _json
+
+        from ..testing.faults import parse_fault_spec
+
+        if self._faults is None:
+            raise ValueError("fault injector not configured on this owner")
+        doc = _json.loads(body.decode("utf-8")) if body else {}
+        rules = parse_fault_spec(str(doc.get("spec", "")))
+        seed = doc.get("seed")
+        self._faults.configure(
+            rules, seed=None if seed is None else int(seed)
+        )
+        return _json.dumps(self._faults.describe()).encode()
+
+    def _serve_clock_set(self, body: bytes) -> bytes:
+        """OP_CLOCK_SET: step/drift this owner's clock authority — the
+        chaos clock-skew nemesis against a live process. Applies to the
+        server's time source (the process singleton in a real boot);
+        an un-skewable source answers the error frame."""
+        import json as _json
+
+        ts = self._time_source
+        set_skew = getattr(ts, "set_skew", None)
+        if set_skew is None:
+            raise ValueError("owner time source is not skewable")
+        doc = _json.loads(body.decode("utf-8")) if body else {}
+        set_skew(
+            offset_s=float(doc.get("offset_s", 0.0)),
+            drift_ppm=float(doc.get("drift_ppm", 0.0)),
+        )
+        return _json.dumps(
+            {"unix_now": ts.unix_now(), "skew": ts.skew()}
+        ).encode()
 
     @staticmethod
     def _error(message: str) -> bytes:
@@ -1554,6 +1624,53 @@ def cluster_rpc(
         raise CacheError(f"cluster op {op} transport failure on {address}: {e}") from e
     finally:
         conn.close()
+
+
+def admin_set_faults(
+    address: str,
+    spec: str,
+    seed: int | None = None,
+    timeout: float = 30.0,
+) -> dict:
+    """Replace a live owner's fault rule set (OP_FAULTS_SET); returns the
+    resulting FaultInjector.describe() document. A junk spec raises
+    CacheError with the parse error — nothing changed server-side."""
+    import json as _json
+
+    doc: dict = {"spec": spec}
+    if seed is not None:
+        doc["seed"] = int(seed)
+    payload = _json.dumps(doc).encode()
+    body = cluster_rpc(
+        address,
+        OP_FAULTS_SET,
+        _U32.pack(len(payload)) + payload,
+        timeout=timeout,
+    )
+    return _json.loads(body.decode())
+
+
+def admin_set_clock(
+    address: str,
+    offset_s: float = 0.0,
+    drift_ppm: float = 0.0,
+    timeout: float = 30.0,
+) -> dict:
+    """Step/drift a live owner's clock authority (OP_CLOCK_SET); defaults
+    reset the skew. Returns {"unix_now", "skew"} as the owner now sees
+    them — the chaos clock-skew nemesis over the wire."""
+    import json as _json
+
+    payload = _json.dumps(
+        {"offset_s": float(offset_s), "drift_ppm": float(drift_ppm)}
+    ).encode()
+    body = cluster_rpc(
+        address,
+        OP_CLOCK_SET,
+        _U32.pack(len(payload)) + payload,
+        timeout=timeout,
+    )
+    return _json.loads(body.decode())
 
 
 def new_sidecar_cache_from_settings(

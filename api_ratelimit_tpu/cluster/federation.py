@@ -265,7 +265,14 @@ class FederationCoordinator:
             name: _PeerLink(
                 name,
                 addr,
-                CircuitBreaker(breaker_threshold, breaker_reset_s),
+                CircuitBreaker(
+                    breaker_threshold,
+                    breaker_reset_s,
+                    # the coordinator's clock authority drives breaker
+                    # reset windows too, so federation chaos runs (and
+                    # clock-skew nemeses) stay deterministic
+                    clock=self._time.monotonic,
+                ),
             )
             for name, addr in peers.items()
             if name != self_name

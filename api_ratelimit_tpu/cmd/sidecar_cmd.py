@@ -43,13 +43,17 @@ import threading
 from ..backends.sidecar import SlabSidecarServer
 from ..backends.tpu import SlabDeviceEngine, SlabHealthStats
 from ..runner import setup_logging
-from ..server.http_server import add_healthcheck, new_debug_server
+from ..server.http_server import (
+    add_chaos_admin,
+    add_healthcheck,
+    new_debug_server,
+)
 from ..settings import new_settings
 from ..stats.sinks import NullSink, StatsdSink
 from ..stats.store import Store
 from ..tracing import journeys as journeys_mod
 from ..tracing import set_global_tracer, tracer_from_env
-from ..utils.timeutil import RealTimeSource
+from ..utils.timeutil import process_time_source
 
 logger = logging.getLogger("ratelimit.sidecar.main")
 
@@ -192,15 +196,20 @@ def main(argv=None) -> None:
     # FAULT_INJECT chaos hook (sites sidecar.server.submit +
     # batcher.submit): lets staging rehearse slow-engine / error-reply /
     # dropped-connection / queue-full behavior on the device-owner side;
-    # junk specs fail the boot here.
-    fault_injector = None
-    fault_rules = settings.fault_rules()
-    if fault_rules:
-        from ..testing.faults import FaultInjector
+    # junk specs fail the boot here. Always constructed (empty = lock-free
+    # no-op) so the OP_FAULTS_SET admin op and POST /debug/faults can arm
+    # faults on the LIVE owner — chaos campaigns reconfigure at runtime.
+    from ..testing.faults import FaultInjector
 
-        fault_injector = FaultInjector(
-            fault_rules, seed=settings.fault_inject_seed
-        )
+    # One clock authority for the whole owner process: engine windows,
+    # lease expiry, fed share TTLs, repl lag and snapshot staleness all
+    # read it, so OP_CLOCK_SET / POST /debug/clock skew them coherently.
+    time_source = process_time_source()
+    fault_rules = settings.fault_rules()
+    fault_injector = FaultInjector(
+        fault_rules, seed=settings.fault_inject_seed
+    )
+    if fault_rules:
         logger.warning(
             "FAULT_INJECT active (%d rule(s)) — chaos mode", len(fault_rules)
         )
@@ -224,7 +233,7 @@ def main(argv=None) -> None:
     hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
     v_enabled, v_max_rows, v_watermark = settings.victim_config()
     engine = SlabDeviceEngine(
-        time_source=RealTimeSource(),
+        time_source=time_source,
         near_limit_ratio=settings.near_limit_ratio,
         n_slots=settings.tpu_slab_slots,
         ways=settings.slab_ways_count(),
@@ -331,7 +340,7 @@ def main(argv=None) -> None:
             max_lag_ms=repl_max_lag_ms,
             scope=scope.scope("repl"),
             fault_injector=fault_injector,
-            time_source=RealTimeSource(),
+            time_source=time_source,
             on_promote=lambda: [hook() for hook in on_promote_hooks],
         )
 
@@ -359,7 +368,7 @@ def main(argv=None) -> None:
         fed = FederationCoordinator(
             fed_self,
             fed_peers,
-            time_source=RealTimeSource(),
+            time_source=time_source,
             share_min=fed_min,
             share_max=fed_max,
             settle_interval_ms=fed_interval,
@@ -397,7 +406,7 @@ def main(argv=None) -> None:
             snap_dir,
             interval_ms=snap_interval_ms,
             stale_after_ms=snap_stale_ms,
-            time_source=RealTimeSource(),
+            time_source=time_source,
             scope=scope,
             fault_injector=fault_injector,
             # stamp this owner's keyspace slice into every shard header
@@ -445,6 +454,9 @@ def main(argv=None) -> None:
         profile_dir=settings.tpu_profile_dir,
     )
     add_healthcheck(debug, health)
+    # runtime fault/clock reconfiguration (chaos campaigns): the same
+    # verbs the sidecar wire protocol exposes as OP_FAULTS_SET/OP_CLOCK_SET
+    add_chaos_admin(debug, fault_injector, time_source)
     if cluster_node is not None:
         import json as _json
 
@@ -533,6 +545,7 @@ def main(argv=None) -> None:
         shm_control_path=shm_control,
         cluster=cluster_node,
         fed=fed,
+        time_source=time_source,
     )
     if fed is not None:
         # start the settle pump only once our own listener is up (a

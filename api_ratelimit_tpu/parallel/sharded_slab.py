@@ -576,7 +576,9 @@ class ShardedSlabEngine:
         the caller's clock authority (the backend's time_source); wall clock
         is only the fallback for direct/bench use."""
         if now is None:
-            now = int(time.time())
+            from ..utils.timeutil import process_time_source
+
+            now = process_time_source().unix_now()
         with self._state_lock:
             self._drain_health_locked()
             live = int(self._live_slots(self._state, now))

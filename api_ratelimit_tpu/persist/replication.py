@@ -434,7 +434,7 @@ class ReplicationCoordinator:
     def lag_ms(self) -> float:
         """Replication staleness in ms: time since the last successful
         ship (primary) or apply (standby); inf when nothing ever moved."""
-        now = time.monotonic()
+        now = self._time_source.monotonic()
         with self._lock:
             if self._role == ROLE_PRIMARY:
                 if not self._subscribers:
@@ -463,7 +463,7 @@ class ReplicationCoordinator:
                 # a fresh primary starts reporting degraded
                 if (
                     started is not None
-                    and time.monotonic() - started < grace
+                    and self._time_source.monotonic() - started < grace
                 ):
                     return None
                 return (
@@ -512,7 +512,7 @@ class ReplicationCoordinator:
         """Resolve the auto role and start the standby apply loop (the
         primary side is driven by subscriber connections — the sidecar
         server routes OP_REPL_SUBSCRIBE here)."""
-        self._started_monotonic = time.monotonic()
+        self._started_monotonic = self._time_source.monotonic()
         if self._configured_role == ROLE_AUTO:
             try:
                 conn = self._dial_and_subscribe()
@@ -572,7 +572,7 @@ class ReplicationCoordinator:
                 return
             sub_id = self._next_sub_id
             self._next_sub_id += 1
-            self._subscribers[sub_id] = time.monotonic()
+            self._subscribers[sub_id] = self._time_source.monotonic()
         seq = 0
         try:
             conn.sendall(b"\x00")  # subscribe ack
@@ -635,7 +635,7 @@ class ReplicationCoordinator:
             self._c_shipped.inc()
         with self._lock:
             if sub_id in self._subscribers:
-                self._subscribers[sub_id] = time.monotonic()
+                self._subscribers[sub_id] = self._time_source.monotonic()
             self._ever_shipped = True
 
     # -- standby: subscribe + apply loop --
@@ -776,7 +776,7 @@ class ReplicationCoordinator:
                 self._lease_rows = lease_rows
                 self._last_seq = seq
                 self._peer_epoch = max(self._peer_epoch, epoch)
-                self._last_apply_monotonic = time.monotonic()
+                self._last_apply_monotonic = self._time_source.monotonic()
         else:
             with self._lock:
                 if self._tables is None:
@@ -803,7 +803,7 @@ class ReplicationCoordinator:
                 self._lease_rows = lease_rows
                 self._last_seq = seq
                 self._peer_epoch = max(self._peer_epoch, epoch)
-                self._last_apply_monotonic = time.monotonic()
+                self._last_apply_monotonic = self._time_source.monotonic()
         self.frames_applied_total += 1
         if self._c_applied is not None:
             self._c_applied.inc()
@@ -833,7 +833,7 @@ class ReplicationCoordinator:
             self._epoch = new_epoch
             # restart the no-standby boot grace: a fresh primary deserves
             # the same dial-in window the original one got
-            self._started_monotonic = time.monotonic()
+            self._started_monotonic = self._time_source.monotonic()
         self._close_sub_conn()
         now = int(self._time_source.unix_now())
         if tables is None:

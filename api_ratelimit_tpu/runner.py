@@ -35,7 +35,7 @@ from .stats.sinks import NullSink, StatsdSink
 from .stats.store import Store
 from .tracing import journeys as journeys_mod
 from .tracing import set_global_tracer, tracer_from_env
-from .utils.timeutil import RealTimeSource
+from .utils.timeutil import process_time_source
 
 logger = logging.getLogger("ratelimit.runner")
 
@@ -211,6 +211,12 @@ class Runner:
         settings = self.settings
         setup_logging(settings)
 
+        # One clock authority per process (utils/timeutil.py): every
+        # time-semantic component below shares it, so the /debug/clock
+        # admin surface (and the chaos clock-skew nemesis behind it) skews
+        # the whole process coherently instead of one component at a time.
+        self.time_source = process_time_source()
+
         # Post-mortem muscle: faulthandler dumps every thread's stack on a
         # hard fault, and SIGUSR2 dumps them on demand — plus the journey
         # flight recorder's retained tail (tracing/journeys.py), so "the
@@ -315,7 +321,7 @@ class Runner:
             # pairs of ~100 bytes, so the byte knob maps onto an entry cap.
             local_cache = LocalCache(
                 max_entries=max(1, settings.local_cache_size_in_bytes // 100),
-                time_source=RealTimeSource(),
+                time_source=self.time_source,
             )
             self.stats_store.add_stat_generator(
                 LocalCacheStats(local_cache, self.scope.scope("localcache"))
@@ -324,7 +330,7 @@ class Runner:
         self.server = new_server(settings, self.stats_store)
 
         base = BaseRateLimiter(
-            time_source=RealTimeSource(),
+            time_source=self.time_source,
             jitter_rand=random.Random(),
             expiration_jitter_max_seconds=settings.expiration_jitter_max_seconds,
             local_cache=local_cache,
@@ -333,19 +339,26 @@ class Runner:
 
         # Fault injector (FAULT_INJECT) — chaos rehearsal for the
         # resilience ladder; a junk spec fails the boot here, like a junk
-        # bucket ladder.
-        self.fault_injector = None
-        fault_rules = settings.fault_rules()
-        if fault_rules:
-            from .testing.faults import FaultInjector
+        # bucket ladder. Always constructed (empty = a lock-free no-op on
+        # the hot path) so the /debug/faults admin surface can arm faults
+        # on a LIVE process — chaos campaigns reconfigure at runtime
+        # instead of rebooting per scenario.
+        from .testing.faults import FaultInjector
 
-            self.fault_injector = FaultInjector(
-                fault_rules, seed=settings.fault_inject_seed
-            )
+        fault_rules = settings.fault_rules()
+        self.fault_injector = FaultInjector(
+            fault_rules, seed=settings.fault_inject_seed
+        )
+        if fault_rules:
             logger.warning(
                 "FAULT_INJECT active (%d rule(s)) — chaos mode",
                 len(fault_rules),
             )
+        from .server.http_server import add_chaos_admin
+
+        add_chaos_admin(
+            self.server.debug, self.fault_injector, self.time_source
+        )
 
         # Overload admission control (backends/overload.py): always built —
         # the default knobs (no queue bound, no brownout) make it inert on
@@ -421,7 +434,7 @@ class Runner:
             self.federation = FederationCoordinator(
                 fed_self,
                 fed_peers,
-                time_source=RealTimeSource(),
+                time_source=self.time_source,
                 share_min=fed_min,
                 share_max=fed_max,
                 settle_interval_ms=fed_interval,
@@ -546,7 +559,7 @@ class Runner:
                 snap_dir,
                 interval_ms=snap_interval_ms,
                 stale_after_ms=snap_stale_ms,
-                time_source=RealTimeSource(),
+                time_source=self.time_source,
                 scope=self.scope,
                 fault_injector=self.fault_injector,
                 fed=self.federation,
@@ -603,7 +616,7 @@ class Runner:
             config_loader=lambda files: _load_config(
                 files, rl_scope, concurrency_ttl_s=concurrency_ttl
             ),
-            time_source=RealTimeSource(),
+            time_source=self.time_source,
             runtime_watch_root=settings.runtime_watch_root,
             max_sleeping_routines=settings.max_sleeping_routines,
             fallback=self.fallback,

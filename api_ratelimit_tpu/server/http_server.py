@@ -534,3 +534,93 @@ def new_debug_server(
     server.add_get("/debug/profile", handle_jax_profile)
     server.add_get("/", handle_index)
     return server
+
+
+def add_chaos_admin(server: HttpServer, fault_injector, time_source) -> None:
+    """Mount the chaos-campaign admin surface on a debug server:
+
+        GET  /debug/faults   live rule set + per-rule hit/fire state
+                             (FaultInjector.describe())
+        POST /debug/faults   replace the rule set at runtime — body is a
+                             FAULT_INJECT spec string, or JSON
+                             {"spec": str, "seed": int?}; a junk spec
+                             answers 400 and changes nothing (the same
+                             fail-loud contract as boot parsing)
+        GET  /debug/clock    the process clock: unix_now + current skew
+        POST /debug/clock    step/drift the process clock — JSON
+                             {"offset_s": float?, "drift_ppm": float?};
+                             {} resets the skew
+
+    This is what replaces boot-time-only FAULT_INJECT for chaos
+    campaigns: the nemesis flips faults and skews clocks on a LIVE
+    process (runner.py and cmd/sidecar_cmd.py both mount it; the sidecar
+    wire protocol exposes the same verbs as OP_FAULTS_SET/OP_CLOCK_SET)."""
+    from ..testing.faults import parse_fault_spec
+
+    def _read_body(h: _Handler) -> bytes:
+        length = int(h.headers.get("Content-Length", "0") or "0")
+        return h.rfile.read(length) if length > 0 else b""
+
+    def _json(h: _Handler, status: int, doc) -> None:
+        h._write(
+            status,
+            json.dumps(doc, indent=2).encode(),
+            content_type="application/json",
+        )
+
+    def handle_faults_get(h: _Handler) -> None:
+        _json(h, 200, fault_injector.describe())
+
+    def handle_faults_post(h: _Handler) -> None:
+        raw = _read_body(h).decode("utf-8", "replace").strip()
+        spec, seed = raw, None
+        if raw.startswith("{"):
+            try:
+                doc = json.loads(raw)
+                spec = str(doc.get("spec", ""))
+                seed = doc.get("seed")
+            except (ValueError, AttributeError) as e:
+                _json(h, 400, {"error": f"bad JSON body: {e}"})
+                return
+        try:
+            rules = parse_fault_spec(spec)
+            fault_injector.configure(
+                rules, seed=None if seed is None else int(seed)
+            )
+        except ValueError as e:
+            _json(h, 400, {"error": str(e)})
+            return
+        _json(h, 200, fault_injector.describe())
+
+    def handle_clock_get(h: _Handler) -> None:
+        skew = getattr(time_source, "skew", None)
+        _json(
+            h,
+            200,
+            {
+                "unix_now": time_source.unix_now(),
+                "skewable": skew is not None,
+                "skew": skew() if skew is not None else None,
+            },
+        )
+
+    def handle_clock_post(h: _Handler) -> None:
+        set_skew = getattr(time_source, "set_skew", None)
+        if set_skew is None:
+            _json(h, 400, {"error": "process time source is not skewable"})
+            return
+        raw = _read_body(h).decode("utf-8", "replace").strip() or "{}"
+        try:
+            doc = json.loads(raw)
+            offset_s = float(doc.get("offset_s", 0.0))
+            drift_ppm = float(doc.get("drift_ppm", 0.0))
+        except (ValueError, TypeError, AttributeError) as e:
+            _json(h, 400, {"error": f"bad clock body: {e}"})
+            return
+        set_skew(offset_s=offset_s, drift_ppm=drift_ppm)
+        handle_clock_get(h)
+
+    server.add_get("/debug/faults", handle_faults_get)
+    server.add_post("/debug/faults", handle_faults_post)
+    server.add_get("/debug/clock", handle_clock_get)
+    server.add_post("/debug/clock", handle_clock_post)

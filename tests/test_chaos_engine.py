@@ -1,0 +1,388 @@
+"""The chaos campaign engine (chaos/): determinism, the admission-bound
+checker, the shrinker, runtime fault/clock reconfiguration, and the two
+registry lints (tools/clock_lint.py, tools/fault_lint.py).
+
+The campaign acceptance (10 seeds x 120 steps, CHAOS_r19.json) runs via
+`make chaos_campaign`; here tier-1 pins the machinery:
+
+  * same seed => byte-identical timeline + ledger + verdict, twice
+  * a 2-seed composed-nemesis smoke renders ok (no false positives)
+  * weakening ONE checker term turns a crash timeline into a caught
+    violation blaming exactly that term, and ddmin shrinks the drawn
+    timeline to <= 3 actions whose emitted pytest repro still violates
+  * /debug/faults + /debug/clock and the sidecar OP_FAULTS_SET /
+    OP_CLOCK_SET admin ops reconfigure a live process end to end
+  * a clock stepped back into a still-resident window re-admits nothing
+  * the FAULT_INJECT after=/times= qualifiers and per-rule RNG streams
+    compose without cross-talk, and junk qualifiers fail boot
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import tempfile
+import urllib.request
+
+import pytest
+
+from api_ratelimit_tpu.testing.faults import (
+    UNLIMITED,
+    FaultInjector,
+    parse_fault_spec,
+    rules_to_spec,
+)
+from api_ratelimit_tpu.utils.timeutil import (
+    FakeTimeSource,
+    SkewableTimeSource,
+)
+from chaos.campaign import CampaignConfig, run_campaign
+from chaos.invariants import check_invariants
+from chaos.ledger import AdmissionLedger
+from chaos.nemesis import (
+    NEMESIS_CLASSES,
+    canonical_json,
+    draw_timeline,
+    timeline_crc,
+)
+from chaos.shrink import ddmin, emit_repro, shrink_timeline
+
+logging.disable(logging.CRITICAL)
+
+# the checker self-test config: kills only, one over-offered key, no
+# eviction/federation slack — the crash term carries the whole story
+KILL_ONLY = dict(
+    steps=40,
+    classes=("process_kill",),
+    tracked_keys=1,
+    lease_offers=8,
+    fillers=0,
+    fillers_per_step=0,
+    fed_offers=0,
+    snapshot_every=0,
+    victim_every=0,
+)
+
+
+class TestTimeline:
+    def test_same_seed_same_timeline_bytes(self):
+        a = draw_timeline(11, 120)
+        b = draw_timeline(11, 120)
+        assert canonical_json(a) == canonical_json(b)
+        assert timeline_crc(a) == timeline_crc(b)
+
+    def test_different_seeds_differ(self):
+        assert draw_timeline(1, 120) != draw_timeline(2, 120)
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError, match="unknown nemesis class"):
+            draw_timeline(1, 10, classes=("process_kill", "typo"))
+
+    def test_class_subset_only_draws_those(self):
+        timeline = draw_timeline(5, 200, classes=("partition",), rate=0.5)
+        assert timeline and all(a["cls"] == "partition" for a in timeline)
+
+
+class TestCampaignDeterminism:
+    def test_replay_is_byte_identical_and_ok(self):
+        cfg = CampaignConfig(steps=30)
+        first = run_campaign(1, config=cfg)
+        second = run_campaign(1, config=cfg)
+        assert canonical_json(first) == canonical_json(second)
+        assert first["verdict"] == "ok"
+
+    def test_two_seed_composed_smoke(self):
+        """The tier-1 arm of the campaign acceptance: two seeds, all
+        nemesis classes composed, zero violations."""
+        cfg = CampaignConfig(steps=30)
+        assert set(cfg.classes) == set(NEMESIS_CLASSES)
+        for seed in (5, 6):
+            result = run_campaign(seed, config=cfg)
+            assert result["verdict"] == "ok", result["violations"]
+            assert sum(result["coverage"].values()) > 0
+
+
+class TestWeakenedBoundAndShrink:
+    def test_weakened_crash_term_is_caught_blamed_and_shrunk(self):
+        cfg = CampaignConfig(**KILL_ONLY)
+        timeline = draw_timeline(3, cfg.steps, cfg.classes, cfg.nemesis_rate)
+        assert len(timeline) >= 2
+        # full bound: the crash term absorbs the kill overshoot
+        full = run_campaign(3, config=cfg, timeline=timeline)
+        assert full["verdict"] == "ok", full["violations"]
+        # weakened bound: the same run violates, blaming exactly "crash"
+        weak = run_campaign(3, config=cfg, timeline=timeline, weaken="crash")
+        assert weak["verdict"] == "violation"
+        assert all(v["blame"] == ["crash"] for v in weak["violations"])
+        # ddmin to a minimal repro
+        minimal = shrink_timeline(3, timeline, config=cfg, weaken="crash")
+        assert 1 <= len(minimal) <= 3
+        assert any(
+            a["cls"] == "process_kill" and a["role"] == "owner"
+            for a in minimal
+        )
+
+    def test_emitted_repro_still_violates(self, tmp_path):
+        cfg = CampaignConfig(**KILL_ONLY)
+        minimal = [{"step": 6, "cls": "process_kill", "role": "owner"}]
+        path = emit_repro(
+            str(tmp_path / "repro.py"), 3, minimal, config=cfg,
+            weaken="crash",
+        )
+        spec = importlib.util.spec_from_file_location("chaos_repro", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        module.test_chaos_repro()  # raises AssertionError if it drifted
+
+    def test_ddmin_minimizes_a_known_predicate(self):
+        items = list(range(16))
+        failing = lambda subset: {3, 11} <= set(subset)  # noqa: E731
+        assert sorted(ddmin(items, failing)) == [3, 11]
+        with pytest.raises(ValueError):
+            ddmin([1, 2], lambda subset: False)
+
+
+class TestClockSkew:
+    def test_skew_math(self):
+        wall = FakeTimeSource(1_000)
+        clock = SkewableTimeSource(wall)
+        assert clock.unix_now() == 1_000
+        clock.set_skew(offset_s=90)
+        assert clock.unix_now() == 1_090
+        clock.set_skew(offset_s=0, drift_ppm=500_000)
+        wall.advance(100)
+        assert clock.unix_now() == 1_150
+        assert clock.monotonic() == wall.monotonic()  # never bent
+
+    def test_skew_within_window_readmits_nothing(self):
+        """No double grant inside one window: exhaust the 100/min limit,
+        then step the owner clock around WITHIN the window (the skewed
+        standby/restore case) — the slab row is resident and its label
+        unchanged, so every further offer is denied. Then cross a window
+        boundary and return: the re-opened budget is real (the slab
+        holds one window per key), and the ledger's episode accounting
+        bounds it exactly — the invariant verdict stays ok."""
+        from chaos.harness import ChaosHarness
+
+        harness = ChaosHarness(77, tempfile.mkdtemp())
+        try:
+            for _ in range(130):  # limit 100 + lease slack, then dry
+                harness.offer_lease("k0")
+            label0 = harness.label("owner")
+            # window [999_960, 1_000_020): +-10s stays inside it
+            for offset in (10, -10, 0):
+                harness.skew("owner", offset_s=offset, drift_ppm=0)
+                assert harness.label("owner") == label0
+                before = harness.ledger.admits["lease/k0"]
+                granted = sum(
+                    harness.offer_lease("k0") for _ in range(30)
+                )
+                assert granted == 0
+                assert harness.ledger.admits["lease/k0"] == before
+            # cross the boundary and come back: bounded re-admission,
+            # absorbed by the episode term — never a violation
+            harness.skew("owner", offset_s=90, drift_ppm=0)
+            assert harness.offer_lease("k0")
+            harness.skew("owner", offset_s=0, drift_ppm=0)
+            for _ in range(140):
+                harness.offer_lease("k0")
+            final = harness.finalize()
+            violations = check_invariants(
+                final["ledger"],
+                final["key_limits"],
+                final["key_kinds"],
+                ("clock_skew",),
+                lease_outstanding=final["lease_outstanding"],
+                fed_reclaimed=final["fed_reclaimed"],
+            )
+            assert violations == []
+        finally:
+            harness.close()
+
+
+class TestRuntimeReconfig:
+    def test_http_faults_and_clock_round_trip(self):
+        from api_ratelimit_tpu.server.http_server import (
+            add_chaos_admin,
+            new_debug_server,
+        )
+
+        from api_ratelimit_tpu.stats import Store, TestSink
+
+        injector = FaultInjector([], seed=9)
+        clock = SkewableTimeSource(FakeTimeSource(2_000))
+        server = new_debug_server("127.0.0.1", 0, Store(TestSink()))
+        add_chaos_admin(server, injector, clock)
+        server.serve_background()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            spec = "fed.exchange:drop:0.9:after=2:times=1"
+            req = urllib.request.Request(
+                f"{base}/debug/faults", data=spec.encode(), method="POST"
+            )
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["rules"][0]["spec"] == spec
+            assert injector.enabled()
+            with urllib.request.urlopen(f"{base}/debug/faults") as resp:
+                doc = json.loads(resp.read())
+            assert doc["rules"][0]["after"] == 2
+            assert doc["rules"][0]["times"] == 1
+            # junk spec -> 400, active rules untouched
+            bad = urllib.request.Request(
+                f"{base}/debug/faults",
+                data=b"fed.exchange:drop:1.0:bogus=2",
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(bad)
+            assert err.value.code == 400
+            assert injector.enabled()
+            # clock: skew forward 90s, read it back
+            req = urllib.request.Request(
+                f"{base}/debug/clock",
+                data=json.dumps({"offset_s": 90}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["unix_now"] == 2_090
+            assert doc["skew"]["offset_s"] == 90
+        finally:
+            server.shutdown()
+
+    def test_sidecar_admin_ops_round_trip(self):
+        from api_ratelimit_tpu.backends.sidecar import (
+            SlabSidecarServer,
+            admin_set_clock,
+            admin_set_faults,
+        )
+        from api_ratelimit_tpu.backends.tpu import SlabDeviceEngine
+
+        clock = SkewableTimeSource(FakeTimeSource(3_000))
+        injector = FaultInjector([], seed=4)
+        engine = SlabDeviceEngine(
+            clock,
+            n_slots=1 << 8,
+            use_pallas=False,
+            buckets=(16,),
+            batch_window_seconds=0.0,
+        )
+        server = SlabSidecarServer(
+            "tcp://127.0.0.1:0",
+            engine,
+            fault_injector=injector,
+            time_source=clock,
+        )
+        address = f"tcp://127.0.0.1:{server.port}"
+        try:
+            doc = admin_set_faults(
+                address, "sidecar.server.submit:delay_ms:1:times=2", seed=4
+            )
+            assert doc["rules"][0]["times"] == 2
+            assert injector.enabled()
+            doc = admin_set_clock(address, offset_s=120)
+            assert doc["unix_now"] == 3_120
+            assert doc["skew"]["offset_s"] == 120
+        finally:
+            server.close()
+            engine.close()
+
+
+class TestFaultQualifiers:
+    def test_after_and_times_gate_firing(self):
+        injector = FaultInjector.from_spec(
+            "fed.exchange:drop:1.0:after=5:times=1", seed=1
+        )
+        fires = [
+            injector.fire("fed.exchange") for _ in range(10)
+        ]
+        assert fires == [None] * 5 + ["drop"] + [None] * 4
+
+    def test_two_token_qualifier_form(self):
+        rules = parse_fault_spec("repl.ship:drop:1.0:after:2:times:1")
+        assert rules[0].after == 2 and rules[0].times == 1
+
+    def test_spec_round_trip(self):
+        spec = "a.b:error:0.5:after=3:times=2,c.d:delay_ms:10"
+        assert rules_to_spec(parse_fault_spec(spec)) == spec
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            "a.b:drop:1.0:bogus=1",
+            "a.b:drop:1.0:after=-1",
+            "a.b:drop:1.0:times=0",
+            "a.b:drop:1.0:after=x",
+            "a.b:drop:1.0:after=1:after=2",
+        ],
+    )
+    def test_junk_qualifiers_fail_boot(self, spec):
+        with pytest.raises(ValueError):
+            parse_fault_spec(spec)
+
+    def test_unqualified_rule_defaults(self):
+        rule = parse_fault_spec("a.b:drop:0.5")[0]
+        assert rule.after == 0 and rule.times == UNLIMITED
+
+    def test_per_rule_streams_compose_without_crosstalk(self):
+        """Adding a rule at site B must not shift site A's draw
+        sequence — each rule owns a seeded stream."""
+
+        def sequence(spec):
+            injector = FaultInjector.from_spec(spec, seed=42)
+            return [injector.fire("a.b") for _ in range(20)]
+
+        solo = sequence("a.b:drop:0.3")
+        with_b = sequence("a.b:drop:0.3,c.d:error:0.7")
+        assert solo == with_b
+
+    def test_dial_site_fires(self):
+        # the sidecar.dial arm of the registry (tools/fault_lint.py
+        # demands every documented site has an exercising test)
+        injector = FaultInjector.from_spec("sidecar.dial:error:1.0")
+        assert injector.fire("sidecar.dial") == "error"
+
+
+class TestLedgerAndInvariants:
+    def test_episode_counting_absorbs_label_revisits(self):
+        ledger = AdmissionLedger()
+        for label in (0, 0, 60, 60, 0):  # skew oscillation
+            ledger.record_admit("k", label, 1, "owner")
+        doc = ledger.finalize()
+        assert doc["labels"]["k"] == [0, 60]
+        assert doc["episodes"]["k"] == 3
+
+    def test_term_active_without_nemesis_is_flagged(self):
+        ledger = AdmissionLedger()
+        ledger.record_admit("k", 0, 10, "owner")
+        ledger.note_owner_kill(restored=False, keys=["k"])
+        doc = ledger.finalize()
+        violations = check_invariants(
+            doc, {"k": 100}, {"k": "lease"}, classes=("partition",)
+        )
+        assert any(
+            v["kind"] == "term_active_without_nemesis"
+            and v["term"] == "crash"
+            for v in violations
+        )
+
+    def test_unknown_weaken_term_rejected(self):
+        with pytest.raises(ValueError, match="unknown term"):
+            check_invariants(
+                AdmissionLedger().finalize(), {}, {}, NEMESIS_CLASSES,
+                weaken="typo",
+            )
+
+
+class TestRegistryLints:
+    def test_clock_lint_clean(self):
+        from tools import clock_lint
+
+        assert clock_lint.run() == []
+
+    def test_fault_lint_clean(self):
+        from tools import fault_lint
+
+        assert fault_lint.run() == []

@@ -527,7 +527,7 @@ class TestPartitionChaos:
         # phase C: heal -> ledgers reconverge, degradation clears
         net.heal()
         for _ in range(4):
-            time.sleep(0.06)  # let the dial breaker half-open
+            ts.advance(1)  # let the dial breaker half-open (virtual clock)
             east.pump()
             west.pump()
         assert not east.degraded and not west.degraded

@@ -15,7 +15,12 @@ renders. It fails on:
     stage histogram block with a positive request count;
   * arming drift: when the artifact carries a tier-arming matrix, every
     un-armed tier that appears in configs must actually be skip- or
-    error-marked, not carry numbers a disarmed tier cannot have earned.
+    error-marked, not carry numbers a disarmed tier cannot have earned;
+  * chaos-claim drift: a CHAOS_rNN.json campaign artifact (kind
+    "chaos", tools/chaos_campaign.py) must pin every seed's
+    timeline_crc, cover every composed nemesis class (or skip it with
+    a reason), and carry the FULL violation reports in agreement with
+    its verdict.
 
 ``--legacy`` relaxes the provenance requirement for pre-round-16
 artifacts (BENCH_r01..r15 predate the stamp); everything else still
@@ -197,6 +202,73 @@ def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
                         f"false_admits claimed without the bound_ok "
                         f"verdict"
                     )
+
+    # claim honesty for chaos campaigns (CHAOS_rNN.json, chaos/): a
+    # campaign artifact is a "zero violations under composed nemeses"
+    # claim, so it must carry the replay pins and the full evidence:
+    #   * every composed nemesis class appears in coverage with a
+    #     positive action count or an explicit skip reason — a class
+    #     that silently drew nothing reads as a class that was tested;
+    #   * every seed row pins its timeline_crc (the replay fingerprint)
+    #     and its verdict;
+    #   * the violations list is always present, carries every
+    #     violating seed's full report, and agrees with the verdict —
+    #     a violation must never be summarized away.
+    if doc.get("kind") == "chaos":
+        seeds = doc.get("seeds")
+        if not isinstance(seeds, list) or not seeds:
+            findings.append("chaos: missing or empty seeds block")
+            seeds = []
+        seed_verdicts = []
+        for i, srow in enumerate(seeds):
+            if not isinstance(srow, dict):
+                findings.append(f"chaos: seeds[{i}] malformed")
+                continue
+            if not isinstance(srow.get("timeline_crc"), int):
+                findings.append(
+                    f"chaos: seeds[{i}] has no timeline_crc — the run "
+                    f"cannot be replayed"
+                )
+            if srow.get("verdict") not in ("ok", "violation"):
+                findings.append(
+                    f"chaos: seeds[{i}] verdict must be ok|violation, "
+                    f"got {srow.get('verdict')!r}"
+                )
+            seed_verdicts.append(srow.get("verdict"))
+        composed = set()
+        for cfg in doc.get("configs") or []:
+            if isinstance(cfg, dict):
+                composed.update(cfg.get("classes") or [])
+        cov = doc.get("coverage")
+        if not isinstance(cov, dict):
+            findings.append("chaos: missing coverage block")
+        else:
+            for cls in sorted(composed):
+                entry = cov.get(cls)
+                if isinstance(entry, int) and entry > 0:
+                    continue
+                if isinstance(entry, dict) and "skipped" in entry:
+                    continue  # reason quality enforced by _iter_skips
+                findings.append(
+                    f"chaos: coverage.{cls}: composed class has neither "
+                    f"a positive action count nor a skip reason"
+                )
+        violations = doc.get("violations")
+        if not isinstance(violations, list):
+            findings.append("chaos: violations list missing")
+        else:
+            n_violating = sum(1 for v in seed_verdicts if v == "violation")
+            if n_violating and not violations:
+                findings.append(
+                    "chaos: seed rows report violations but the "
+                    "violations list is empty — reports were dropped"
+                )
+            want = "violation" if violations else "ok"
+            if doc.get("verdict") != want:
+                findings.append(
+                    f"chaos: verdict {doc.get('verdict')!r} disagrees "
+                    f"with the violations list ({len(violations)} entries)"
+                )
 
     # arming drift: a disarmed tier must not carry numbers
     tiers = doc.get("tiers")
