@@ -286,6 +286,52 @@ class DispatchStats:
             self._p_hwm.set(hwm)
 
 
+class ShardRoutingStats:
+    """StatGenerator for the routed-batching dispatch owner
+    (parallel/sharded_slab.py; SHARD_ROUTED_BATCHING / HOT_TIER_ENABLED):
+
+        <scope>.padding_waste_pct  integer percent of launched lanes that
+                                   were padding since boot — the
+                                   hot-shard-pathology dial (flat under
+                                   routing, spikes when one shard's
+                                   bucket pads every other)
+        <scope>.launches           mesh launches dispatched
+        <scope>.rows               real (non-padding) rows routed
+        <scope>.rows.shard_<d>     the same, per owner shard — the skew
+                                   picture the flat counter hides
+        <scope>.hot_keys           keys currently salted across shards
+        <scope>.hot_epoch          hot-set membership epoch (bumps on
+                                   every promote/demote; a stuck epoch
+                                   under churn means drains stopped)
+
+    Takes the engine's shard_routing_snapshot callable rather than the
+    engine so the generator works against any object with the snapshot
+    contract (the mesh engine today, a fake in tests)."""
+
+    def __init__(self, snapshot, scope, shards: int):
+        self._snapshot = snapshot
+        self._waste = scope.gauge("padding_waste_pct")
+        self._launches = scope.gauge("launches")
+        self._rows = scope.gauge("rows")
+        self._hot_keys = scope.gauge("hot_keys")
+        self._hot_epoch = scope.gauge("hot_epoch")
+        self._shard_rows = [
+            scope.gauge(f"rows.shard_{d}") for d in range(int(shards))
+        ]
+
+    def generate_stats(self) -> None:
+        snap = self._snapshot()
+        self._waste.set(int(round(snap.get("padding_waste_pct", 0.0))))
+        self._launches.set(int(snap.get("launches", 0)))
+        self._rows.set(int(snap.get("rows", 0)))
+        hot = snap.get("hot_tier") or {}
+        self._hot_keys.set(int(hot.get("keys", 0)))
+        self._hot_epoch.set(int(hot.get("epoch", 0)))
+        per_shard = snap.get("shard_rows") or []
+        for gauge, rows in zip(self._shard_rows, per_shard):
+            gauge.set(int(rows))
+
+
 class DispatchLoop:
     """The device-owner thread plus its submit rings. `launch` and
     `collect` are the engine's block executors (_execute_blocks_launch /

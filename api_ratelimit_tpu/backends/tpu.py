@@ -153,6 +153,9 @@ class SlabDeviceEngine:
         hotkey_k: int = 16,
         victim_max_rows: int = 0,
         victim_watermark: float = 0.85,
+        shard_routed_batching: bool = True,
+        hot_tier_enabled: bool = True,
+        hot_tier_salt_ways: int = 0,
     ):
         """hotkey_lanes: lanes of the in-kernel heavy-hitter sketch
         (ops/sketch.py; HOTKEY_LANES). 0 disables — the HOTKEYS_ENABLED=
@@ -257,11 +260,20 @@ class SlabDeviceEngine:
         if mesh is not None:
             from ..parallel.sharded_slab import ShardedSlabEngine
 
+            # mesh engines route per shard by default
+            # (SHARD_ROUTED_BATCHING; the false arm is the byte-identical
+            # global-bucket rollback) and take the hot-key tier + the
+            # host-side top-K fallback in place of the device sketch
             self._engine = ShardedSlabEngine(
                 mesh=mesh,
                 n_slots_global=n_slots,
                 ways=ways,
                 use_pallas=self._use_pallas,
+                routed=bool(shard_routed_batching),
+                hot_tier=bool(hot_tier_enabled),
+                hot_salt_ways=int(hot_tier_salt_ways),
+                hotkey_lanes=int(hotkey_lanes),
+                hotkey_k=int(hotkey_k),
             )
             self._state = None
             self._ways = self._engine.ways
@@ -285,10 +297,12 @@ class SlabDeviceEngine:
         self._hotkey_listeners: list = []
         if int(hotkey_lanes) > 0:
             if self._engine is not None:
-                _log.warning(
-                    "hotkeys sketch is single-device only; disabled on the "
-                    "mesh-sharded engine"
-                )
+                # mesh path: the device sketch stays single-device, but
+                # the sharded engine carries its own host-side top-K
+                # fallback (ops/sketch.py HostTopK) fed from the routed
+                # batches — this backend just delegates the hotkeys
+                # surface to it (drain_hotkeys & co below)
+                pass
             else:
                 from ..ops.sketch import make_sketch, sketch_ways
 
@@ -1012,6 +1026,8 @@ class SlabDeviceEngine:
 
     @property
     def hotkeys_enabled(self) -> bool:
+        if self._engine is not None:
+            return self._engine.hotkeys_enabled
         return self._sketch is not None
 
     @property
@@ -1019,12 +1035,17 @@ class SlabDeviceEngine:
         """Combined 64-bit fingerprints of the keys the LAST drain ranked
         hot — the request path's journey-flag probe (a frozenset read, no
         lock: rebound atomically by drain_hotkeys)."""
+        if self._engine is not None:
+            return self._engine.hot_fps
         return self._hot_fps
 
     def add_hotkey_listener(self, fn) -> None:
         """fn(top, fps) called after every drain with the fresh top-K
         [(fp_lo, fp_hi, count)] and its combined-fp frozenset — the
         adaptive-lease pre-seeding hook (backends/lease.py note_hot_fps)."""
+        if self._engine is not None:
+            self._engine.add_hotkey_listener(fn)
+            return
         self._hotkey_listeners.append(fn)
 
     def drain_hotkeys(self) -> list[tuple[int, int, int]]:
@@ -1033,7 +1054,16 @@ class SlabDeviceEngine:
         current traffic, and the halving keeps counts below the kernels'
         int32-ordering contract). Called on the stats-flush cadence by
         HotkeyStats, never per launch: the D2H+H2D pair under the state
-        lock costs what a health_snapshot's live_slots reduction does."""
+        lock costs what a health_snapshot's live_slots reduction does.
+
+        Mesh path: delegates to the sharded engine's host-side top-K
+        fallback (same return shape; the drain also feeds its hot tier).
+        The local drain counter mirrors the engine's so HotkeyStats'
+        counter stays monotone whichever engine serves it."""
+        if self._engine is not None:
+            top = self._engine.drain_hotkeys()
+            self._hotkey_drains = self._engine._hotkey_drains
+            return top
         if self._sketch is None:
             return []
         from ..ops.sketch import sketch_decay, sketch_topk
@@ -1059,6 +1089,8 @@ class SlabDeviceEngine:
     def hotkeys_snapshot(self) -> dict:
         """The last drained top-K as a debug document — /debug/hotkeys
         without key resolution (the cache layer adds witness keys)."""
+        if self._engine is not None:
+            return self._engine.hotkeys_snapshot()
         return {
             "enabled": self._sketch is not None,
             "k": self._hotkey_k,
@@ -1069,6 +1101,17 @@ class SlabDeviceEngine:
                 for lo, hi, cnt in self._last_topk
             ],
         }
+
+    # -- per-shard routing telemetry (mesh engines only) --
+
+    def shard_routing_snapshot(self) -> dict:
+        """The mesh engine's cumulative routing mix — bucket/pad/launch
+        stage split, per-shard row counts, padding waste, hot-tier state
+        (parallel/sharded_slab.py shard_routing_snapshot). Single-device
+        engines report disabled so the runner skips the gauges."""
+        if self._engine is None:
+            return {"enabled": False}
+        return self._engine.shard_routing_snapshot()
 
     # -- victim tier: demote drain + promote injection (backends/victim.py) --
 
@@ -1600,6 +1643,9 @@ class TpuRateLimitCache:
         hotkey_k: int = 16,
         victim_max_rows: int = 0,
         victim_watermark: float = 0.85,
+        shard_routed_batching: bool = True,
+        hot_tier_enabled: bool = True,
+        hot_tier_salt_ways: int = 0,
     ):
         """engine: anything with submit(items)->afters / flush / close —
         defaults to an in-process SlabDeviceEngine; the sidecar frontend
@@ -1658,6 +1704,9 @@ class TpuRateLimitCache:
                 hotkey_k=hotkey_k,
                 victim_max_rows=victim_max_rows,
                 victim_watermark=victim_watermark,
+                shard_routed_batching=shard_routed_batching,
+                hot_tier_enabled=hot_tier_enabled,
+                hot_tier_salt_ways=hot_tier_salt_ways,
             )
         self._engine_core = engine
         # per-algorithm decision stats (ratelimit.algo.<name>.{decisions,

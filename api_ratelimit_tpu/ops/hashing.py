@@ -96,3 +96,43 @@ def set_index(fp_lo, n_sets: int):
     # a bare python-int mask stays weak-typed under numpy and jax alike,
     # so the result keeps fp_lo's uint32 dtype in both worlds
     return fp_lo & (n_sets - 1)
+
+
+# golden-ratio mixer for the salt's upper bits (same constant family as
+# the murmur/fmix finalizers used elsewhere) — slot j's salt must differ
+# in the way-rotation bit field for every j, or every slice of a hot key
+# would fight over the same way within its set
+HOT_SALT_GOLDEN = 0x9E3779B1
+
+
+def hot_slice_fp(fp_lo, fp_hi, slot: int, n_shards: int):
+    """Salted fingerprint of slice `slot` of a replicated hot key
+    (parallel/sharded_slab.py hot tier): slice s of a hot key lives on
+    shard (home + s) mod n_shards under fingerprint (fp_lo, fp_hi ^ salt).
+
+    Only fp_hi is salted. fp_lo carries the set index (set_index above),
+    so every slice lands at the SAME set position on its shard — demotion
+    settlement scans exactly one set per shard — and the disjoint-bit-
+    source contract of the three selectors survives: the salt's low
+    log2(n_shards) bits steer the owner hash from the home shard to the
+    target shard, and its golden-multiplied upper bits re-randomize the
+    way-preference rotation so the K slices don't pile onto one way.
+
+    slot 0 is the identity (salt = 0): the home row IS slice 0, which is
+    what lets promotion carry the home counter into the tier without a
+    read-modify-write — the current window's count is never split or
+    lost, it just starts being enforced against the slice quota.
+    """
+    if n_shards <= 0 or n_shards & (n_shards - 1):
+        raise ValueError(f"n_shards must be a power of two, got {n_shards}")
+    slot = int(slot) % n_shards
+    lo = int(fp_lo) & 0xFFFFFFFF
+    hi = int(fp_hi) & 0xFFFFFFFF
+    if slot == 0:
+        return np.uint32(lo), np.uint32(hi)
+    mask = n_shards - 1
+    home = (lo ^ hi) & mask
+    target = (home + slot) % n_shards
+    salt = (slot * HOT_SALT_GOLDEN) & 0xFFFFFFFF & ~mask
+    salt |= home ^ target
+    return np.uint32(lo), np.uint32(hi ^ salt)

@@ -316,3 +316,73 @@ def sketch_decay(planes: np.ndarray) -> np.ndarray:
     planes[PLANE_FP_LO][dead] = 0
     planes[PLANE_FP_HI][dead] = 0
     return planes
+
+
+class HostTopK:
+    """Space-saving top-K on the HOST — the mesh engine's sketch fallback.
+
+    The device sketch (planes above) rides a single chip's launch; the
+    mesh engine's per-shard launches would each see only their shard's
+    slice of the stream, and merging K per-shard sketches coherently is
+    exactly the associativity fight the planes were built to avoid. So
+    ShardedSlabEngine feeds THIS summary from the one place that still
+    sees the whole stream — the host routing pass that buckets rows by
+    shard — closing PR 15's "mesh engines decline the sketch" gap.
+
+    Same algorithm family as the device planes (space-saving: a full
+    summary evicts its min-count entry and the newcomer INHERITS that
+    count, so estimates only ever over-count — a true heavy hitter can
+    never be displaced by noise), same drain contract (sketch_topk
+    ordering: count desc, fp as the deterministic tiebreak) and the same
+    halve-on-drain decay. Pure dict + numpy; the cost rides the host
+    routing pass, not the device."""
+
+    def __init__(self, lanes: int):
+        self.lanes = validate_lanes(lanes)
+        self._counts: dict[int, int] = {}
+
+    def update(self, fp_lo, fp_hi, hits) -> None:
+        """Fold a batch in: fp halves + per-row hit weights (uint32
+        arrays, padding already stripped). Batches pre-aggregate by key
+        before touching the dict — hot batches repeat keys heavily."""
+        fp_lo = np.asarray(fp_lo, dtype=np.uint64)
+        fp_hi = np.asarray(fp_hi, dtype=np.uint64)
+        combined = fp_lo | (fp_hi << np.uint64(32))
+        keys, inv = np.unique(combined, return_inverse=True)
+        sums = np.bincount(
+            inv, weights=np.asarray(hits, dtype=np.float64)
+        ).astype(np.int64)
+        counts = self._counts
+        for key, add in zip(keys.tolist(), sums.tolist()):
+            cur = counts.get(key)
+            if cur is not None:
+                counts[key] = cur + add
+            elif len(counts) < self.lanes:
+                counts[key] = add
+            else:
+                # space-saving eviction: newcomer inherits the floor
+                victim = min(counts, key=counts.get)
+                floor = counts.pop(victim)
+                counts[key] = floor + add
+
+    def topk(self, k: int) -> list:
+        """[(fp_lo, fp_hi, count)] — sketch_topk's exact ordering: count
+        desc, then (fp_hi, fp_lo) desc so equal counts stay stable."""
+        if k <= 0 or not self._counts:
+            return []
+        order = sorted(
+            self._counts.items(),
+            key=lambda kv: (kv[1], kv[0] >> 32, kv[0] & 0xFFFFFFFF),
+            reverse=True,
+        )[:k]
+        return [
+            (int(fp & 0xFFFFFFFF), int(fp >> 32), int(cnt))
+            for fp, cnt in order
+        ]
+
+    def decay(self) -> None:
+        """sketch_decay's halve-and-drop, dict-shaped: two cadences of
+        silence fade any entry below a steady key."""
+        self._counts = {
+            fp: cnt >> 1 for fp, cnt in self._counts.items() if cnt >> 1
+        }

@@ -1328,6 +1328,34 @@ def slab_export_copy(state: SlabState) -> jnp.ndarray:
     return jnp.array(state.table, copy=True)
 
 
+def find_row_host(table, fp_lo: int, fp_hi: int, ways: int) -> int:
+    """Host-side mirror of the device way-scan's fingerprint match: the
+    row index of (fp_lo, fp_hi) in a HOST copy of a slab table, or -1.
+
+    Used by the hot-tier demotion settlement
+    (parallel/sharded_slab.py), which must locate a salted slice row in
+    a pulled shard table at EXACTLY the placement the device used — so
+    the set split is the one ops/hashing.py set_index definition, same
+    as _gather_sets. Only live rows match: a reclaimed row is all-zero
+    and carries no expiry, and a dead row's counter must not settle."""
+    import numpy as np
+
+    from .hashing import set_index
+
+    table = np.asarray(table)
+    n_slots = table.shape[0]
+    ways = min(int(ways), n_slots)
+    n_sets = n_slots // ways
+    base = int(set_index(np.uint32(fp_lo), n_sets)) * ways
+    rows = table[base : base + ways]
+    hit = np.flatnonzero(
+        (rows[:, COL_FP_LO] == np.uint32(fp_lo))
+        & (rows[:, COL_FP_HI] == np.uint32(fp_hi))
+        & (rows[:, COL_EXPIRE] != 0)
+    )
+    return base + int(hit[0]) if hit.size else -1
+
+
 def slab_import_rows(rows, device=None) -> SlabState:
     """Upload a reconciled (n_slots, ROW_WIDTH) uint32 host table as fresh
     slab state; validates the shape so a wrong-topology snapshot can never

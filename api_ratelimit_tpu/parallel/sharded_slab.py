@@ -34,7 +34,9 @@ parity tests certify the sharded path as well.
 
 from __future__ import annotations
 
+import collections
 import functools
+import logging
 import threading
 import time
 
@@ -67,9 +69,15 @@ def _require_shard_map():
         )
     return shard_map
 
+from ..ops.hashing import hot_slice_fp
 from ..ops.slab import (
     ALGO_SHIFT,
+    COL_COUNT,
     COL_DIVIDER,
+    COL_EXPIRE,
+    COL_FP_HI,
+    COL_FP_LO,
+    COL_WINDOW,
     DEFAULT_WAYS,
     HEALTH_ALGO_RESETS,
     HEALTH_DROPS,
@@ -82,6 +90,7 @@ from ..ops.slab import (
     ROW_FP_HI,
     ROW_FP_LO,
     ROW_HITS,
+    ROW_LIMIT,
     ROW_SCALARS,
     ROW_WIDTH,
     SlabState,
@@ -90,9 +99,12 @@ from ..ops.slab import (
     _unpack,
     _unsort,
     default_ways,
+    find_row_host,
     live_slot_count,
     validate_ways,
 )
+
+_log = logging.getLogger(__name__)
 
 SHARD_AXIS = "shard"
 
@@ -238,13 +250,29 @@ def sharded_slab_step_after(
 # field and tests/test_sharded_slab.py::TestPerDeviceCostScaling): with
 # balanced routing the per-chip compiled cost is ~1/N of the
 # single-device program (0.1241 flops / 0.1303 bytes at N=8, ideal
-# 0.125). Under single-key skew the hot shard sets the bucket for ALL
-# shards (SPMD: one program shape), so per-chip compute does not shrink
-# — the bench's Zipf(1.1) stream puts ~54% of a batch on one shard.
-# That is the hot-shard property the reference inherits from Redis
-# Cluster (one key lives on one node). A mitigation (salting hot keys
-# across shards) would need psum'd partial counts and trades away the
-# single-owner counter model; it is deliberately not attempted.
+# 0.125). Under single-key skew the hot shard used to set the bucket
+# for ALL shards (SPMD: one program shape) — the bench's Zipf(1.1)
+# stream puts ~54% of a batch on one shard, the hot-shard property the
+# reference inherits from Redis Cluster (one key lives on one node).
+# Two cures now ship, both host-side and both spy-pinned byte-identical
+# to this arm when disabled:
+#
+#   * ROUTED PER-SHARD BATCHING (routed=True, SHARD_ROUTED_BATCHING):
+#     each shard gets its OWN power-of-two bucket sized to its own row
+#     count instead of one global bucket sized to the hottest shard,
+#     dispatched as independent per-device launches (no shard_map, no
+#     psum — jax's async dispatch overlaps the shards). A cold shard
+#     pads to 128 lanes while the hot shard pads to its real load, so
+#     Zipf padding waste collapses (the sharded_zipf bench prices it;
+#     the ratelimit.shard.* gauges export it).
+#   * REPLICATED HOT-KEY TIER (hot_tier=True, HOT_TIER_ENABLED): keys
+#     the top-K summary flags as hot are salted across shards
+#     (ops/hashing.py hot_slice_fp) so each shard holds a split-quota
+#     slice (ceil(limit/K)); demotion settles the slices back into the
+#     home row with the keep-the-newest merge. The single-owner counter
+#     model is preserved for every non-hot key; a hot key trades a
+#     provably bounded per-window false_over (<= K*ceil(limit/K) -
+#     limit) for no longer pinning one shard.
 
 
 def _sharded_body_after_compact(
@@ -289,12 +317,80 @@ def sharded_slab_step_after_compact(
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+def _routed_body(table, block, *, ways: int, cap: int, use_pallas: bool):
+    """Single-shard body of the ROUTED arm: identical math to
+    _sharded_body_after_compact minus the mesh — no shard_map, no psum,
+    no [1, ...] leading axis. block: uint32[7, bucket_d], this shard's
+    own rows only. The health vector comes back per-shard; the host sums
+    shards (the compact arm's psum, moved off the interconnect).
+
+    Keeping this a twin of the compact body (same _slab_update_sorted
+    call with the same defaults, same jnp.minimum(cap) then narrow) is
+    what makes SHARD_ROUTED_BATCHING=false a byte-identical rollback
+    arm: tests pin slab bytes, wire rows, and verdicts across the two."""
+    batch, now, _near, burst_ratio = _unpack(block)
+    state, _before, s_after, _inputs, order, health, _ = _slab_update_sorted(
+        SlabState(table=table), batch, now, ways, use_pallas=use_pallas,
+        burst_ratio=burst_ratio,
+    )
+    after = jnp.minimum(_unsort(s_after, order), jnp.uint32(cap))
+    if cap <= 0xFF:
+        after = after.astype(jnp.uint8)
+    elif cap <= 0xFFFF:
+        after = after.astype(jnp.uint16)
+    return state.table, after, health
+
+
+def _pcts(samples) -> dict:
+    """p50/p99 of a timing deque (ns); zeros when empty."""
+    if not samples:
+        return {"p50": 0, "p99": 0}
+    arr = np.fromiter(samples, dtype=np.int64)
+    return {
+        "p50": int(np.percentile(arr, 50)),
+        "p99": int(np.percentile(arr, 99)),
+    }
+
+
+class _HotKey:
+    """Hot-set entry: the key's fp halves, its promotion epoch, and the
+    round-robin cursor that deals its rows across the K salted slices."""
+
+    __slots__ = ("lo", "hi", "epoch", "rr")
+
+    def __init__(self, lo: int, hi: int, epoch: int):
+        self.lo = int(lo)
+        self.hi = int(hi)
+        self.epoch = int(epoch)
+        self.rr = 0
+
+
 class ShardedSlabEngine:
     """Drop-in device engine for TpuRateLimitCache: same packed block protocol
     as ops/slab.py's slab_step_packed, but state spans every device of a mesh.
 
     n_slots_global must split into a power-of-two number of rows per device.
-    """
+
+    Two dispatch arms share the compact launch/collect API (the tokens
+    are opaque to callers):
+
+      * routed=False — the original shard_map SPMD arm: one global
+        bucket sized to the hottest shard, state one P(axis, None) array.
+      * routed=True — per-shard batching: state is one committed table
+        per device, each launch pads each shard only to its OWN row
+        count and dispatches independent jitted programs (jax async
+        dispatch overlaps them). Byte-identical results by construction
+        (_routed_body); the win is padding waste, which the
+        shard_routing_snapshot() telemetry and the sharded_zipf bench
+        price.
+
+    hot_tier=True (routed arm only, power-of-two shard counts) arms the
+    replicated hot-key tier: promote_hot/demote_hot salt a key across
+    hot_salt_ways slices with split quotas ceil(limit/K); the readback
+    remaps slice counters so callers' `after > limit` compare still
+    yields the decision. hotkey_lanes > 0 arms the host-side top-K
+    fallback (ops/sketch.py HostTopK) that feeds the tier and the
+    ratelimit.hotkeys.* gauges on the mesh path."""
 
     def __init__(
         self,
@@ -302,6 +398,12 @@ class ShardedSlabEngine:
         n_slots_global: int = 1 << 22,
         ways: int = 0,
         use_pallas: bool = False,
+        routed: bool = False,
+        hot_tier: bool = False,
+        hot_salt_ways: int = 0,
+        hotkey_lanes: int = 0,
+        hotkey_k: int = 16,
+        hot_min_count: int = 4096,
     ):
         if mesh is None:
             mesh = make_mesh()
@@ -324,12 +426,10 @@ class ShardedSlabEngine:
             ways = default_ways(next(iter(mesh.devices.flat)).platform)
         self.ways = validate_ways(n_local, ways)
         axis = mesh.axis_names[0]
+        self._devices = list(mesh.devices.flat)
+        self._routed = bool(routed)
         self._state_sharding = NamedSharding(mesh, P(axis, None))
         self._batch_sharding = NamedSharding(mesh, P(None, None))
-        self._state = jax.device_put(
-            jnp.zeros((n_slots_global, ROW_WIDTH), dtype=jnp.uint32),
-            self._state_sharding,
-        )
         self._use_pallas = use_pallas
         # Sticky algorithms guard, mesh edition (the single-device twin is
         # backends/tpu.py _algos_seen): the Mosaic kernels implement
@@ -338,28 +438,104 @@ class ShardedSlabEngine:
         # rebuilds every cached step function on the XLA twin permanently.
         # An all-fixed config never flips, keeping the pallas arm intact.
         self._algos_seen = False
-        self._step = sharded_slab_step(mesh, ways=self.ways, use_pallas=use_pallas)
         self._after_steps: dict[int, object] = {}
         self._compact_steps: dict[int, object] = {}
+        self._routed_steps: dict[int, object] = {}
         self._blocks_sharding = NamedSharding(mesh, P(axis, None, None))
+        if self._routed:
+            # per-shard batching: one committed table per device instead
+            # of a shard_map'd global array — routed launches are plain
+            # per-device jitted programs, so this arm works even on a
+            # toolchain without shard_map
+            self._state = None
+            self._tables = [
+                jax.device_put(
+                    jnp.zeros((n_local, ROW_WIDTH), dtype=jnp.uint32), d
+                )
+                for d in self._devices
+            ]
+            self._step = None
+            self._live_slots = None
+            self._live_one = jax.jit(live_slot_count)
+        else:
+            self._state = jax.device_put(
+                jnp.zeros((n_slots_global, ROW_WIDTH), dtype=jnp.uint32),
+                self._state_sharding,
+            )
+            self._tables = None
+            self._step = sharded_slab_step(
+                mesh, ways=self.ways, use_pallas=use_pallas
+            )
+            axis_name = axis
+            self._live_slots = jax.jit(
+                _require_shard_map()(
+                    lambda table, now: jax.lax.psum(
+                        live_slot_count(table, now), axis_name
+                    ),
+                    mesh=mesh,
+                    in_specs=(P(axis_name, None), P()),
+                    out_specs=P(),
+                )
+            )
         # cumulative mesh-wide health: the eviction mix + contention drops
         # (ops/slab.py HEALTH_* layout)
         self.health_totals = [0] * HEALTH_WIDTH
-        axis_name = axis
-        self._live_slots = jax.jit(
-            _require_shard_map()(
-                lambda table, now: jax.lax.psum(
-                    live_slot_count(table, now), axis_name
-                ),
-                mesh=mesh,
-                in_specs=(P(axis_name, None), P()),
-                out_specs=P(),
-            )
-        )
         # Serializes state rebinds (donating steps) against the occupancy
         # read — without it the stats thread can hit a donated buffer.
         self._state_lock = threading.Lock()
         self._pending_health: list = []
+
+        # -- routing telemetry (both arms; shard_routing_snapshot) --
+        self._launches = 0
+        self._rows_routed = 0  # valid rows dispatched
+        self._padded_lanes = 0  # lanes launched incl. padding
+        self._shard_rows = [0] * n_dev
+        self._t_bucket_ns: collections.deque = collections.deque(maxlen=4096)
+        self._t_pad_ns: collections.deque = collections.deque(maxlen=4096)
+        self._t_launch_ns: collections.deque = collections.deque(maxlen=4096)
+
+        # -- replicated hot-key tier (routed arm only) --
+        hot_tier = bool(hot_tier)
+        if hot_tier and not self._routed:
+            _log.warning(
+                "hot-key tier needs routed per-shard batching; disabled "
+                "(SHARD_ROUTED_BATCHING is off)"
+            )
+            hot_tier = False
+        if hot_tier and n_dev & (n_dev - 1):
+            # the salt redirects the owner hash by XOR on its low bits,
+            # which is only a clean bijection when n_dev is a power of two
+            _log.warning(
+                "hot-key tier needs a power-of-two shard count, got %d; "
+                "disabled",
+                n_dev,
+            )
+            hot_tier = False
+        self._hot_tier = hot_tier
+        salt_ways = int(hot_salt_ways) or n_dev
+        self._salt_ways = max(1, min(salt_ways, n_dev))
+        self._hot_lock = threading.Lock()
+        self._hot: dict[int, _HotKey] = {}  # combined uint64 fp -> entry
+        self._hot_combined = np.empty(0, dtype=np.uint64)
+        self._hot_epoch = 0
+        self._hot_promotions = 0
+        self._hot_demotions = 0
+        self._hot_settle_drops = 0
+        self._hot_min_count = max(0, int(hot_min_count))
+
+        # -- host-side top-K fallback (the mesh path's sketch) --
+        self._hotkey_k = max(1, int(hotkey_k))
+        self._hotkey_lanes = int(hotkey_lanes)
+        self._hostkeys = None
+        if self._hotkey_lanes > 0:
+            from ..ops.sketch import HostTopK
+
+            self._hostkeys = HostTopK(self._hotkey_lanes)
+        self._hotkeys_lock = threading.Lock()
+        self._hot_fps: frozenset = frozenset()
+        self._hotkey_drains = 0
+        self._hotkey_listeners: list = []
+        self._last_topk: list = []
 
     @property
     def algos_seen(self) -> bool:
@@ -377,11 +553,13 @@ class ShardedSlabEngine:
             self._use_pallas = False
             # rebuild the cached jitted steps on the XLA twin; jit is
             # lazy, so the one-time cost is the recompile at next launch
-            self._step = sharded_slab_step(
-                self.mesh, ways=self.ways, use_pallas=False
-            )
+            if not self._routed:
+                self._step = sharded_slab_step(
+                    self.mesh, ways=self.ways, use_pallas=False
+                )
             self._after_steps.clear()
             self._compact_steps.clear()
+            self._routed_steps.clear()
 
     def _guard_algos(self, packed: np.ndarray) -> None:
         """Per-launch check for direct engine callers (the backend has
@@ -396,9 +574,18 @@ class ShardedSlabEngine:
         ) >= (1 << ALGO_SHIFT):
             self.note_algos_seen()
 
+    def _require_replicated(self, what: str) -> None:
+        if self._routed:
+            raise RuntimeError(
+                f"{what} is a replicated-arm (shard_map) path; the routed "
+                f"engine serves launches through launch_after_compact/"
+                f"collect_after_compact only"
+            )
+
     def step_packed(self, packed: np.ndarray) -> np.ndarray:
         """One mesh-wide launch. packed: uint32[7, b] -> uint32[8, b] results
         in arrival order (no permutation row: unsorted on device pre-psum)."""
+        self._require_replicated("step_packed")
         self._guard_algos(packed)
         packed_dev = jax.device_put(packed, self._batch_sharding)
         with self._state_lock:
@@ -410,6 +597,7 @@ class ShardedSlabEngine:
         """Production readback path: stateful update only, one saturated
         post-increment counter row back (caller guarantees cap > limit+hits;
         see ops/slab.py compact modes)."""
+        self._require_replicated("step_after")
         self._guard_algos(packed)
         step = self._after_steps.get(cap)
         if step is None:
@@ -445,10 +633,28 @@ class ShardedSlabEngine:
         self._guard_algos(packed)
         n_dev = int(self.mesh.devices.size)
         b = packed.shape[1]
+        t0 = time.perf_counter_ns()
         hits = packed[ROW_HITS]
         valid_idx = np.flatnonzero(hits > 0)
         if valid_idx.size == 0:
-            return (None, None, None, None, b)
+            if self._routed:
+                return {"mode": "routed", "afters": None, "b": b}
+            return (None, None, None, None, b, None)
+
+        # feed the host top-K fallback BEFORE any hot-tier salting —
+        # detection must see home fingerprints, not slice aliases
+        if self._hostkeys is not None:
+            with self._hotkeys_lock:
+                self._hostkeys.update(
+                    packed[ROW_FP_LO, valid_idx],
+                    packed[ROW_FP_HI, valid_idx],
+                    packed[ROW_HITS, valid_idx],
+                )
+
+        hot_remap = None
+        hot_epoch = 0
+        if self._hot_tier:
+            packed, hot_remap, hot_epoch = self._salt_hot(packed, valid_idx)
 
         # MUST mirror _owner_mask's device-side formula ((fp_lo ^ fp_hi) mod
         # n_dev) exactly — a mismatch silently routes keys to shards that
@@ -458,16 +664,23 @@ class ShardedSlabEngine:
             % np.uint32(n_dev)
         ).astype(np.int64)
         counts = np.bincount(owner, minlength=n_dev)
-        # power-of-two bucket >= the fullest shard (>=128 for lane alignment)
-        bucket = 128
-        while bucket < max(int(min_bucket), counts.max()):
-            bucket <<= 1
-
         route = np.argsort(owner, kind="stable")
         routed_idx = valid_idx[route]  # original positions, shard-grouped
         routed_owner = owner[route]
         starts = np.zeros(n_dev + 1, dtype=np.int64)
         starts[1:] = np.cumsum(counts)
+        t1 = time.perf_counter_ns()
+
+        if self._routed:
+            return self._launch_routed(
+                packed, cap, min_bucket, b, counts, routed_idx, starts,
+                hot_remap, hot_epoch, t0, t1,
+            )
+
+        # power-of-two bucket >= the fullest shard (>=128 for lane alignment)
+        bucket = 128
+        while bucket < max(int(min_bucket), counts.max()):
+            bucket <<= 1
         within = np.arange(routed_idx.size, dtype=np.int64) - starts[routed_owner]
 
         blocks = np.zeros((n_dev, 7, bucket), dtype=np.uint32)
@@ -476,6 +689,7 @@ class ShardedSlabEngine:
         blocks[:, ROW_SCALARS, 0] = packed[ROW_SCALARS, 0]
         blocks[:, ROW_SCALARS, 1] = packed[ROW_SCALARS, 1]
         blocks[:, ROW_SCALARS, 2] = packed[ROW_SCALARS, 2]
+        t2 = time.perf_counter_ns()
 
         # one jit wrapper per cap; jax.jit itself retraces per bucket shape
         step = self._compact_steps.get(cap)
@@ -491,18 +705,437 @@ class ShardedSlabEngine:
         with self._state_lock:
             self._state, after_blocks, health = step(self._state, blocks_dev)
             self._note_health(health)
-        return (after_blocks, routed_idx, routed_owner, within, b)
+            self._note_routing_locked(
+                counts, n_dev * bucket, t0, t1, t2, time.perf_counter_ns()
+            )
+        return (after_blocks, routed_idx, routed_owner, within, b, hot_remap)
+
+    def _launch_routed(
+        self, packed, cap, min_bucket, b, counts, routed_idx, starts,
+        hot_remap, hot_epoch, t0, t1,
+    ):
+        """Routed-arm launch: one block per NON-EMPTY shard, each padded
+        only to its own power-of-two rung, dispatched as independent
+        per-device jitted calls. jax's async dispatch returns before any
+        program finishes, so the K launches overlap on device exactly
+        like the compact arm's single SPMD launch — minus the dead lanes.
+
+        min_bucket keeps its compile-pinning meaning per shard, but the
+        FLOOR stays 128 even when callers pass more: the whole point of
+        this arm is that a cold shard must not inherit a hot shard's
+        rung."""
+        n_dev = len(self._devices)
+        blocks: dict[int, np.ndarray] = {}
+        for d in range(n_dev):
+            c = int(counts[d])
+            if not c:
+                continue
+            bucket = 128
+            while bucket < max(int(min_bucket), c):
+                bucket <<= 1
+            blk = np.zeros((7, bucket), dtype=np.uint32)
+            sel = routed_idx[starts[d] : starts[d] + c]
+            blk[:, :c] = packed[:, sel]
+            blk[ROW_SCALARS, 0] = packed[ROW_SCALARS, 0]
+            blk[ROW_SCALARS, 1] = packed[ROW_SCALARS, 1]
+            blk[ROW_SCALARS, 2] = packed[ROW_SCALARS, 2]
+            # hot-set epoch rides the launch scalars (free col 3): the
+            # device ignores it, but any captured operand pins which
+            # hot-set version routed this batch
+            blk[ROW_SCALARS, 3] = np.uint32(hot_epoch)
+            blocks[d] = blk
+        t2 = time.perf_counter_ns()
+
+        step = self._routed_steps.get(cap)
+        if step is None:
+            step = jax.jit(
+                functools.partial(
+                    _routed_body,
+                    ways=self.ways,
+                    cap=cap,
+                    use_pallas=self._use_pallas,
+                ),
+                donate_argnums=(0,),
+            )
+            self._routed_steps[cap] = step
+        afters: dict[int, object] = {}
+        with self._state_lock:
+            for d, blk in blocks.items():
+                table, after, health = step(self._tables[d], blk)
+                self._tables[d] = table
+                afters[d] = after
+                self._note_health(health)
+            self._note_routing_locked(
+                counts,
+                sum(blk.shape[1] for blk in blocks.values()),
+                t0, t1, t2, time.perf_counter_ns(),
+            )
+        return {
+            "mode": "routed",
+            "afters": afters,
+            "routed_idx": routed_idx,
+            "starts": starts,
+            "counts": counts,
+            "b": b,
+            "hot_remap": hot_remap,
+        }
 
     def collect_after_compact(self, token) -> np.ndarray:
         """Blocking half: drain the sharded result and unscatter it back to
         arrival order using the routing permutation built at launch."""
-        after_blocks, routed_idx, routed_owner, within, b = token
+        if isinstance(token, dict):  # routed-arm token
+            return self._collect_routed(token)
+        after_blocks, routed_idx, routed_owner, within, b, hot_remap = token
         out = np.zeros(b, dtype=np.uint32)
         if after_blocks is None:  # launch saw no valid lanes
             return out
         after_np = np.asarray(after_blocks)
         out[routed_idx] = after_np[routed_owner, within].astype(np.uint32)
+        self._remap_hot(out, hot_remap)
         return out
+
+    def _collect_routed(self, token) -> np.ndarray:
+        out = np.zeros(token["b"], dtype=np.uint32)
+        afters = token["afters"]
+        if afters is None:  # launch saw no valid lanes
+            return out
+        routed_idx = token["routed_idx"]
+        starts = token["starts"]
+        counts = token["counts"]
+        for d, after in afters.items():
+            c = int(counts[d])
+            after_np = np.asarray(after)[:c].astype(np.uint32)
+            out[routed_idx[starts[d] : starts[d] + c]] = after_np
+        self._remap_hot(out, token["hot_remap"])
+        return out
+
+    @staticmethod
+    def _remap_hot(out: np.ndarray, hot_remap) -> None:
+        """Rewrite hot rows' slice counters so the caller's unchanged
+        `after > limit` compare yields the slice's own verdict: an
+        under-quota slice reports its raw count (<= quota <= limit), an
+        over-quota slice reports limit + overshoot (> limit). In-place
+        on the arrival-order result row."""
+        if hot_remap is None:
+            return
+        sel, limits, quotas = hot_remap
+        vals = out[sel]
+        out[sel] = np.where(vals <= quotas, vals, limits + (vals - quotas))
+
+    # -- replicated hot-key tier --------------------------------------
+
+    def _salt_hot(self, packed: np.ndarray, valid_idx: np.ndarray):
+        """Rewrite hot-key rows to their salted slice fingerprints and
+        split quotas. Returns (packed', hot_remap, epoch); packed is
+        copied only when a hot row is actually present, so the cold path
+        (and the HOT_TIER_ENABLED=false arm) never touches the operand.
+
+        Slice selection is a per-key round-robin over the K salt ways —
+        deterministic, and it deals a batch's duplicate rows across
+        DIFFERENT slices, which is the in-batch load spreading the tier
+        exists for. Only fixed-window rows salt: a sliding/GCRA row's
+        auxiliary state has no split-quota combine rule, so those ride
+        their home shard untouched."""
+        with self._hot_lock:
+            if not self._hot_combined.size:
+                return packed, None, self._hot_epoch
+            lo = packed[ROW_FP_LO, valid_idx].astype(np.uint64)
+            hi = packed[ROW_FP_HI, valid_idx].astype(np.uint64)
+            combined = lo | (hi << np.uint64(32))
+            mask = np.isin(combined, self._hot_combined)
+            # fixed-window rows only (algorithm id bits 28-30 == 0)
+            mask &= packed[ROW_DIVIDER, valid_idx] < np.uint32(1 << ALGO_SHIFT)
+            if not mask.any():
+                return packed, None, self._hot_epoch
+            packed = packed.copy()
+            K = self._salt_ways
+            n_dev = len(self._devices)
+            sel = valid_idx[mask]
+            limits = packed[ROW_LIMIT, sel].copy()
+            quotas = np.empty_like(limits)
+            for i, (pos, comb) in enumerate(
+                zip(sel.tolist(), combined[mask].tolist())
+            ):
+                entry = self._hot[comb]
+                slot = entry.rr % K
+                entry.rr += 1
+                lo2, hi2 = hot_slice_fp(
+                    packed[ROW_FP_LO, pos], packed[ROW_FP_HI, pos],
+                    slot, n_dev,
+                )
+                packed[ROW_FP_LO, pos] = lo2
+                packed[ROW_FP_HI, pos] = hi2
+                q = -(-int(packed[ROW_LIMIT, pos]) // K)  # ceil(limit/K)
+                packed[ROW_LIMIT, pos] = np.uint32(q)
+                quotas[i] = q
+            return packed, (sel, limits, quotas), self._hot_epoch
+
+    @property
+    def hot_tier_enabled(self) -> bool:
+        return self._hot_tier
+
+    def promote_hot(self, fp_lo: int, fp_hi: int) -> bool:
+        """Admit a key into the replicated hot tier. Promotion is pure
+        membership — no device traffic: slot 0's salt is the identity
+        (ops/hashing.py hot_slice_fp), so the home row IS slice 0 and
+        the current window's count carries into the tier intact; it just
+        starts being enforced against the slice quota ceil(limit/K)
+        (conservative — promotion can only tighten, never over-admit).
+        Epoch-bumped so in-flight launches are attributable."""
+        if not self._hot_tier:
+            return False
+        comb = (int(fp_lo) & 0xFFFFFFFF) | ((int(fp_hi) & 0xFFFFFFFF) << 32)
+        with self._hot_lock:
+            if comb in self._hot:
+                return False
+            self._hot_epoch += 1
+            self._hot[comb] = _HotKey(fp_lo, fp_hi, self._hot_epoch)
+            self._hot_combined = np.fromiter(
+                self._hot.keys(), dtype=np.uint64, count=len(self._hot)
+            )
+            self._hot_promotions += 1
+        return True
+
+    def demote_hot(self, fp_lo: int, fp_hi: int, now: int | None = None) -> dict:
+        """Remove a key from the hot tier and SETTLE: fold every salted
+        slice's counter back into the home row so the key's next window
+        — and any non-routed reader of the exported tables — sees one
+        exact counter. Returns the settlement report."""
+        comb = (int(fp_lo) & 0xFFFFFFFF) | ((int(fp_hi) & 0xFFFFFFFF) << 32)
+        with self._hot_lock:
+            entry = self._hot.pop(comb, None)
+            if entry is None:
+                return {"demoted": False}
+            self._hot_epoch += 1
+            self._hot_combined = np.fromiter(
+                self._hot.keys(), dtype=np.uint64, count=len(self._hot)
+            )
+            self._hot_demotions += 1
+        return self._settle_slices(int(fp_lo), int(fp_hi), now)
+
+    def _settle_slices(self, fp_lo: int, fp_hi: int, now: int | None) -> dict:
+        """Demotion settlement: pull each slice row host-side, merge with
+        the keep-the-newest rule (the reshard/promote merge,
+        ops/slab.py slab_promote_rows: greatest window wins; counts
+        WITHIN the winning window sum, because each slice counted a
+        disjoint share of that window's hits), zero the slice rows, and
+        land the merged row at the home placement. Runs under the state
+        lock — a few sets of host traffic per demotion, demotion-cadence
+        only."""
+        if now is None:
+            from ..utils.timeutil import process_time_source
+
+            now = process_time_source().unix_now()
+        n_dev = len(self._devices)
+        K = self._salt_ways
+        report = {"demoted": True, "settled": 0, "count": 0, "landed": False}
+        with self._state_lock:
+            tables: dict[int, np.ndarray] = {}
+            found: list[tuple[int, int, int]] = []  # (slot, shard, row)
+            for slot in range(K):
+                lo2, hi2 = hot_slice_fp(fp_lo, fp_hi, slot, n_dev)
+                shard = int((int(lo2) ^ int(hi2)) % n_dev)
+                tab = tables.get(shard)
+                if tab is None:
+                    tab = tables[shard] = np.asarray(self._tables[shard]).copy()
+                ridx = find_row_host(tab, int(lo2), int(hi2), self.ways)
+                if ridx >= 0:
+                    found.append((slot, shard, ridx))
+            if not found:
+                return report
+            rows = [tables[s][r].copy() for (_slot, s, r) in found]
+            win = max(int(r[COL_WINDOW]) for r in rows)
+            total = sum(
+                int(r[COL_COUNT]) for r in rows if int(r[COL_WINDOW]) == win
+            )
+            # slot 0 (when live) carries the key's real metadata; any
+            # slice works as the template otherwise — divider/expire are
+            # identical across slices of one window
+            template = next(
+                (
+                    tables[s][r].copy()
+                    for (slot, s, r) in found
+                    if slot == 0
+                ),
+                rows[0],
+            )
+            merged = template
+            merged[COL_FP_LO] = np.uint32(fp_lo)
+            merged[COL_FP_HI] = np.uint32(fp_hi)
+            merged[COL_COUNT] = np.uint32(min(total, 0xFFFFFFFF))
+            merged[COL_WINDOW] = np.uint32(win)
+            merged[COL_EXPIRE] = np.uint32(
+                max(int(r[COL_EXPIRE]) for r in rows)
+            )
+            for (_slot, s, r) in found:
+                tables[s][r] = 0
+            home_shard = int((fp_lo ^ fp_hi) % n_dev)
+            htab = tables.get(home_shard)
+            if htab is None:
+                htab = tables[home_shard] = np.asarray(
+                    self._tables[home_shard]
+                ).copy()
+            place = self._find_landing(htab, fp_lo, int(now))
+            if place >= 0:
+                htab[place] = merged
+                report["landed"] = True
+            else:
+                # home set is full of other live keys: the merged counter
+                # is dropped (fail-open at the key's next touch) — same
+                # accounting class as a slab contention drop, counted so
+                # the fuzz bound can price it
+                self._hot_settle_drops += 1
+            for shard, tab in tables.items():
+                self._tables[shard] = jax.device_put(
+                    jnp.asarray(tab), self._devices[shard]
+                )
+            report["settled"] = len(found)
+            report["count"] = total
+        return report
+
+    def _find_landing(self, table: np.ndarray, fp_lo: int, now: int) -> int:
+        """First free way of the key's home set: never-used/reclaimed
+        first (expire == 0), then expired rows. -1 when every way holds
+        another live key (the settle-drop case)."""
+        from ..ops.hashing import set_index
+
+        n_sets = table.shape[0] // self.ways
+        base = int(set_index(np.uint32(fp_lo), n_sets)) * self.ways
+        rows = table[base : base + self.ways]
+        expire = rows[:, COL_EXPIRE]
+        free = np.flatnonzero(expire == 0)
+        if free.size:
+            return base + int(free[0])
+        dead = np.flatnonzero(expire.astype(np.int64) <= int(now))
+        if dead.size:
+            return base + int(dead[0])
+        return -1
+
+    # -- host-side top-K fallback (the mesh path's hotkeys surface) ----
+    # Mirrors SlabDeviceEngine's sketch surface (backends/tpu.py) so
+    # HotkeyStats, the journeys listener, and the lease pre-seed work
+    # unchanged against a mesh engine.
+
+    @property
+    def hotkeys_enabled(self) -> bool:
+        return self._hostkeys is not None
+
+    @property
+    def hot_fps(self) -> frozenset:
+        """Most recent drain's head keys as combined (hi<<32|lo) ints."""
+        return self._hot_fps
+
+    def add_hotkey_listener(self, fn) -> None:
+        """fn(top, fps) after every drain — same contract as the
+        single-device sketch listeners."""
+        self._hotkey_listeners.append(fn)
+
+    def drain_hotkeys(self) -> list:
+        """Drain the host top-K: read the head, decay, and — when the
+        hot tier is armed — feed it: promote drained keys at or above
+        hot_min_count, demote hot keys that decayed below half of it
+        (hysteresis so a key flapping around the threshold doesn't churn
+        settlement traffic)."""
+        if self._hostkeys is None:
+            return []
+        with self._hotkeys_lock:
+            top = self._hostkeys.topk(self._hotkey_k)
+            self._hostkeys.decay()
+            self._last_topk = top
+            self._hot_fps = frozenset(
+                (hi << 32) | lo for lo, hi, _cnt in top
+            )
+            self._hotkey_drains += 1
+        if self._hot_tier and self._hot_min_count > 0:
+            keep = set()
+            for lo, hi, cnt in top:
+                comb = (hi << 32) | lo
+                if cnt >= self._hot_min_count:
+                    keep.add(comb)
+                    self.promote_hot(lo, hi)
+                elif cnt >= self._hot_min_count // 2:
+                    keep.add(comb)  # hysteresis band: keep, don't promote
+            with self._hot_lock:
+                cold = [c for c in self._hot if c not in keep]
+            for comb in cold:
+                self.demote_hot(comb & 0xFFFFFFFF, comb >> 32)
+        for fn in list(self._hotkey_listeners):
+            try:
+                fn(top, self._hot_fps)
+            except Exception:  # pragma: no cover - listener bugs stay local
+                _log.exception("hotkey listener failed")
+        return top
+
+    def hotkeys_snapshot(self) -> dict:
+        """Same debug shape as the single-device sketch snapshot."""
+        with self._hotkeys_lock:
+            top = list(self._last_topk)
+            drains = self._hotkey_drains
+        return {
+            "enabled": self._hostkeys is not None,
+            "k": self._hotkey_k,
+            "lanes": self._hotkey_lanes,
+            "drains": drains,
+            "top": [
+                {"fp": f"{(hi << 32) | lo:016x}", "count": cnt}
+                for lo, hi, cnt in top
+            ],
+        }
+
+    # -- routing telemetry ---------------------------------------------
+
+    def _note_routing_locked(self, counts, padded_lanes, t0, t1, t2, t3):
+        """Accumulate the per-launch routing mix (state lock held): the
+        bucket stage is host owner-hash + argsort, pad is the block
+        fill + H2D staging, launch is the device dispatch call(s)."""
+        self._launches += 1
+        n_rows = int(counts.sum())
+        self._rows_routed += n_rows
+        self._padded_lanes += int(padded_lanes)
+        for d, c in enumerate(counts):
+            self._shard_rows[d] += int(c)
+        self._t_bucket_ns.append(t1 - t0)
+        self._t_pad_ns.append(t2 - t1)
+        self._t_launch_ns.append(t3 - t2)
+
+    def shard_routing_snapshot(self) -> dict:
+        """Cumulative routing mix + stage-split percentiles — the source
+        for the ratelimit.shard.* gauges (backends/dispatch.py
+        ShardRoutingStats) and hotpath_profile --shard-split.
+        padding_waste_pct is dead lanes as a share of all launched
+        lanes: the compact arm's number is the pathology, the routed
+        arm's is the cure, and both arms report through this one
+        surface so a rollback's before/after lives in the same scrape."""
+        with self._state_lock:
+            padded = self._padded_lanes
+            rows = self._rows_routed
+            waste = 100.0 * (padded - rows) / padded if padded else 0.0
+            with self._hot_lock:
+                hot = {
+                    "enabled": self._hot_tier,
+                    "salt_ways": self._salt_ways,
+                    "keys": len(self._hot),
+                    "epoch": self._hot_epoch,
+                    "promotions": self._hot_promotions,
+                    "demotions": self._hot_demotions,
+                    "settle_drops": self._hot_settle_drops,
+                }
+            return {
+                "enabled": True,
+                "routed": self._routed,
+                "shards": len(self._shard_rows),
+                "launches": self._launches,
+                "rows": rows,
+                "padded_lanes": padded,
+                "padding_waste_pct": round(waste, 3),
+                "shard_rows": list(self._shard_rows),
+                "hot_tier": hot,
+                "stage_ns": {
+                    "bucket_ns": _pcts(self._t_bucket_ns),
+                    "pad_ns": _pcts(self._t_pad_ns),
+                    "launch_ns": _pcts(self._t_launch_ns),
+                },
+            }
 
     # -- warm restart (persist/): per-shard slab export/import --
 
@@ -520,6 +1153,9 @@ class ShardedSlabEngine:
         in-flight donating steps); the cross-device gather + D2H drain run
         against the detached copy outside the lock."""
         with self._state_lock:
+            if self._routed:
+                copies = [jnp.array(t, copy=True) for t in self._tables]
+                return [np.asarray(c) for c in copies]
             copy = jnp.array(self._state, copy=True)
         full = np.asarray(copy)
         n_local = self.shard_slots
@@ -554,7 +1190,17 @@ class ShardedSlabEngine:
             # same rule the single-device import applies)
             self.note_algos_seen()
         with self._state_lock:
-            self._state = jax.device_put(full, self._state_sharding)
+            if self._routed:
+                n_local = self.shard_slots
+                self._tables = [
+                    jax.device_put(
+                        jnp.asarray(full[i * n_local : (i + 1) * n_local]),
+                        self._devices[i],
+                    )
+                    for i in range(self.shard_count)
+                ]
+            else:
+                self._state = jax.device_put(full, self._state_sharding)
 
     def _note_health(self, health) -> None:
         """Defer the tiny health readback off the hot path: park the device
@@ -581,7 +1227,12 @@ class ShardedSlabEngine:
             now = process_time_source().unix_now()
         with self._state_lock:
             self._drain_health_locked()
-            live = int(self._live_slots(self._state, now))
+            if self._routed:
+                live = sum(
+                    int(self._live_one(t, now)) for t in self._tables
+                )
+            else:
+                live = int(self._live_slots(self._state, now))
             return {
                 "evictions_expired": self.health_totals[HEALTH_EVICT_EXPIRED],
                 "evictions_window": self.health_totals[HEALTH_EVICT_WINDOW],

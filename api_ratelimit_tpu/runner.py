@@ -120,6 +120,7 @@ def create_limiter(
             kwargs["buckets"] = ladder
         hk_enabled, hk_k, hk_lanes = settings.hotkey_config()
         v_enabled, v_max_rows, v_watermark = settings.victim_config()
+        sr_routed, sr_hot, sr_salt = settings.shard_config()
         return TpuRateLimitCache(
             base,
             n_slots=settings.tpu_slab_slots,
@@ -143,6 +144,9 @@ def create_limiter(
             hotkey_k=hk_k,
             victim_max_rows=v_max_rows if v_enabled else 0,
             victim_watermark=v_watermark,
+            shard_routed_batching=sr_routed,
+            hot_tier_enabled=sr_hot,
+            hot_tier_salt_ways=sr_salt,
             **kwargs,
         )
     if backend == "tpu-sidecar":
@@ -499,6 +503,23 @@ class Runner:
                 "/debug/hotkeys",
                 lambda: json.dumps(cache.hotkeys_debug(), indent=2),
             )
+        # Sharded-dispatch telemetry (SHARD_ROUTED_BATCHING /
+        # HOT_TIER_ENABLED; parallel/sharded_slab.py): padding waste,
+        # per-shard routed rows and hot-tier population under
+        # ratelimit.shard.* — the gauges that make the hot-shard
+        # pathology (and its cure) visible on a dashboard.
+        if engine is not None and hasattr(engine, "shard_routing_snapshot"):
+            _snap = engine.shard_routing_snapshot()
+            if _snap.get("enabled"):
+                from .backends.dispatch import ShardRoutingStats
+
+                self.stats_store.add_stat_generator(
+                    ShardRoutingStats(
+                        engine.shard_routing_snapshot,
+                        self.scope.scope("shard"),
+                        int(_snap.get("shards", 0)),
+                    )
+                )
         # Victim-tier telemetry (VICTIM_TIER_ENABLED; backends/victim.py):
         # the VictimStats generator IS the tier's TTL/window reclamation
         # cadence — each stats flush reclaims dead rows, publishes

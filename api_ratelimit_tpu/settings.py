@@ -378,6 +378,29 @@ class Settings:
     # degraded health probe raises (observability only; serving is never
     # touched).
     victim_watermark: float = 0.85
+    # --- sharded dispatch: routed batching + hot-key tier ---
+    # SHARD_ROUTED_BATCHING: on a multi-device mesh, bucket rows by owner
+    # shard on the host and launch one right-sized batch per shard instead
+    # of one global bucket padded to the hottest shard — padding waste
+    # stops scaling with the skew of the hottest shard. false is the
+    # byte-identical rollback arm: the engine runs the original replicated
+    # SPMD launch, same wire rows, same slab bytes, same verdicts (pinned
+    # by test, same discipline as HOST_FAST_PATH / DISPATCH_LOOP).
+    shard_routed_batching: bool = True
+    # HOT_TIER_ENABLED: salt sketch-flagged hot keys across all shards
+    # (ops/hashing.py hot_slice_fp) with a split-quota slice of
+    # ceil(limit/K) per shard; the flagged key stops concentrating on its
+    # home shard so routed buckets stay flat under single-key skew.
+    # Requires SHARD_ROUTED_BATCHING and a power-of-two shard count (the
+    # salt steers the low owner-hash bits); otherwise the engine
+    # downgrades to routed-only with a warning. false is the
+    # byte-identical rollback arm (no key is ever salted).
+    hot_tier_enabled: bool = True
+    # HOT_TIER_SALT_WAYS: how many shards each hot key is spread over
+    # (K). 0 = all shards. Steady-state over-admission is 0 when K
+    # divides the limit; the promotion window is bounded by
+    # limit + (K-1)*ceil(limit/K) (see parallel/sharded_slab.py).
+    hot_tier_salt_ways: int = 0
     # --- global quota federation (cluster/federation.py) ---
     # FED_ENABLED turns on multi-cluster quota federation: each key's
     # home cluster (deterministic over the sorted FED_PEERS membership)
@@ -633,6 +656,23 @@ class Settings:
                 f"VICTIM_WATERMARK must be in (0, 1], got {watermark}"
             )
         return bool(self.victim_tier_enabled), max_rows, watermark
+
+    def shard_config(self) -> tuple[bool, bool, int]:
+        """Validated (routed, hot_tier, salt_ways) for sharded dispatch.
+        Junk fails the boot like every other knob. Hot tier without
+        routed batching is NOT an error here — the engine downgrades
+        with a warning (it also depends on the runtime shard count being
+        a power of two, which only the engine knows)."""
+        salt = int(self.hot_tier_salt_ways)
+        if salt < 0:
+            raise ValueError(
+                f"HOT_TIER_SALT_WAYS must be >= 0, got {salt}"
+            )
+        return (
+            bool(self.shard_routed_batching),
+            bool(self.hot_tier_enabled),
+            salt,
+        )
 
     def sidecar_addresses(self) -> list[str]:
         """The frontend's device-owner failover list: parsed SIDECAR_ADDRS
@@ -1068,6 +1108,9 @@ _FIELD_ENV: list[tuple[str, str, Callable]] = [
     ("victim_tier_enabled", "VICTIM_TIER_ENABLED", _parse_bool),
     ("victim_max_rows", "VICTIM_MAX_ROWS", int),
     ("victim_watermark", "VICTIM_WATERMARK", float),
+    ("shard_routed_batching", "SHARD_ROUTED_BATCHING", _parse_bool),
+    ("hot_tier_enabled", "HOT_TIER_ENABLED", _parse_bool),
+    ("hot_tier_salt_ways", "HOT_TIER_SALT_WAYS", int),
     ("fed_enabled", "FED_ENABLED", _parse_bool),
     ("fed_self", "FED_SELF", str),
     ("fed_peers", "FED_PEERS", str),

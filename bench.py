@@ -2382,6 +2382,221 @@ def bench_engine_sharded(n_devices: int, on_tpu: bool) -> dict:
     return result
 
 
+def bench_engine_sharded_zipf(n_devices: int, on_tpu: bool) -> dict:
+    """sharded_zipf tier: the hot-shard pathology and its two cures,
+    measured (SHARD_ROUTED_BATCHING / HOT_TIER_ENABLED,
+    parallel/sharded_slab.py).
+
+    Three interleaved arms over the SAME Zipf(1.1) block stream — the
+    compact global-bucket arm (the rollback), routed per-shard batching,
+    and routed + the replicated hot-key tier (sketch-fed, auto-promoted
+    from the warmup drain) — reporting dec/s, padding-waste %, and dead
+    (padding) lanes per arm, plus a uniform-stream control where routing
+    can't win. The hot arm's claim-honesty companion is a short
+    differential fuzz vs testing/oracle.py VictimOracle on a single-hot-
+    key stream: false_over (admissions beyond the documented split-quota
+    bound) must be 0, and tools/bench_lint.py flags any hot-tier speedup
+    claim whose artifact lacks that verdict. On a 1-core virtual CPU mesh
+    the rates are smoke numbers (host_cpus recorded); the waste/dead-lane
+    and false_over columns are exact on any box."""
+    import jax
+
+    from api_ratelimit_tpu.ops.slab import (
+        ROW_DIVIDER,
+        ROW_FP_HI,
+        ROW_FP_LO,
+        ROW_HITS,
+        ROW_LIMIT,
+        ROW_SCALARS,
+    )
+    from api_ratelimit_tpu.parallel.sharded_slab import (
+        ShardedSlabEngine,
+        make_mesh,
+    )
+    from api_ratelimit_tpu.testing.oracle import VictimOracle
+
+    devices = jax.devices()[:n_devices]
+    n_dev = len(devices)
+    batch = 30_000
+    n_batches = 4  # timed; batch 0 is the warmup/sketch-feed block
+    n_slots = n_dev * (1 << 14)
+    now = int(time.time())
+
+    def pack(ids: np.ndarray, limit: int = 100, div: int = 60) -> np.ndarray:
+        p = np.zeros((7, ids.size), dtype=np.uint32)
+        x = ids.astype(np.uint32)
+        p[ROW_FP_LO] = fmix32_np(x)
+        p[ROW_FP_HI] = fmix32_np(x ^ np.uint32(0xA5A5A5A5))
+        p[ROW_HITS] = 1
+        p[ROW_LIMIT] = limit
+        p[ROW_DIVIDER] = div
+        p[ROW_SCALARS, 0] = np.uint32(now)
+        p[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+        return p
+
+    def mk(**kw) -> ShardedSlabEngine:
+        return ShardedSlabEngine(
+            mesh=make_mesh(devices),
+            n_slots_global=n_slots,
+            use_pallas=engine_use_pallas(on_tpu),
+            **kw,
+        )
+
+    arms = {
+        "compact": mk(),
+        "routed": mk(routed=True),
+        "routed_hot": mk(
+            routed=True,
+            hot_tier=True,
+            hotkey_lanes=128,
+            hotkey_k=16,
+            hot_min_count=300,
+        ),
+    }
+
+    zipf_blocks = [pack(b) for b in zipf_ids(100_000, batch, n_batches + 1, seed=0)]
+    rng = np.random.RandomState(7)
+    uni_blocks = [
+        pack(rng.randint(0, 100_000, size=batch).astype(np.uint32))
+        for _ in range(3)
+    ]
+
+    # warmup block 0 on every arm (compiles + feeds the hot arm's host
+    # top-K), then the sketch drain auto-promotes the Zipf head into the
+    # tier — the sketch-fed promotion path, not a hand-picked key list
+    for eng in arms.values():
+        eng.step_after_compact(zipf_blocks[0].copy(), 0xFFFF)
+    arms["routed_hot"].drain_hotkeys()
+    base = {name: eng.shard_routing_snapshot() for name, eng in arms.items()}
+
+    # interleaved A/B: each timed block runs on every arm back to back, so
+    # no arm gets a cooler cache or a different phase of the machine
+    elapsed = {name: 0.0 for name in arms}
+    for blk in zipf_blocks[1:]:
+        for name, eng in arms.items():
+            op = blk.copy()
+            t0 = time.perf_counter()
+            eng.step_after_compact(op, 0xFFFF)
+            elapsed[name] += time.perf_counter() - t0
+
+    zipf: dict = {"hot_promoted": int(
+        arms["routed_hot"].shard_routing_snapshot()["hot_tier"]["keys"]
+    )}
+    dead = {}
+    for name, eng in arms.items():
+        snap = eng.shard_routing_snapshot()
+        rows = snap["rows"] - base[name]["rows"]
+        padded = snap["padded_lanes"] - base[name]["padded_lanes"]
+        dead[name] = padded - rows
+        zipf[f"rate_{name}"] = round(n_batches * batch / elapsed[name])
+        zipf[f"waste_pct_{name}"] = round(100.0 * (padded - rows) / padded, 1)
+        zipf[f"dead_lanes_{name}"] = int(padded - rows)
+    zipf["dead_lane_ratio"] = (
+        round(dead["compact"] / dead["routed_hot"], 2)
+        if dead["routed_hot"]
+        else float(dead["compact"])
+    )
+
+    uniform: dict = {}
+    for name in ("compact", "routed"):
+        eng = arms[name]
+        t0 = time.perf_counter()
+        for blk in uni_blocks:
+            eng.step_after_compact(blk.copy(), 0xFFFF)
+        uniform[f"rate_{name}"] = round(len(uni_blocks) * batch / (time.perf_counter() - t0))
+
+    # claim-honesty fuzz: single hot key at 50% of the stream, tier armed,
+    # promotion landing mid-window — per-window admissions beyond the
+    # documented split-quota bound are false_over and must total 0.
+    # Bound semantics (parallel/sharded_slab.py): a window fully covered
+    # by hot membership admits <= K*ceil(limit/K); the window where the
+    # promotion landed admits <= limit + (K-1)*ceil(limit/K).
+    LIMIT, DIV = 40, 50
+    fuzz_eng = mk(routed=True, hot_tier=True)
+    routed_only = mk(routed=True)  # the single-hot-key A/B twin
+    K = fuzz_eng._salt_ways
+    q = -(-LIMIT // K)
+    oracle = VictimOracle()
+    frng = np.random.RandomState(11)
+    hot_id = np.array([3], dtype=np.uint32)
+    hot_lo = int(fmix32_np(hot_id)[0])
+    hot_hi = int(fmix32_np(hot_id ^ np.uint32(0xA5A5A5A5))[0])
+    hot_id = hot_id[0]
+    admitted: dict = {}
+    events: set = set()
+    is_hot = False
+    fnow0 = (now // DIV) * DIV + 10  # promotion lands mid-window by design
+    for step in range(8):
+        fnow = fnow0 + 7 * step
+        window = (fnow // DIV) * DIV
+        ids = frng.randint(10, 2010, size=2000).astype(np.uint32)
+        ids[frng.rand(2000) < 0.5] = hot_id
+        p = pack(ids, limit=LIMIT, div=DIV)
+        p[ROW_SCALARS, 0] = np.uint32(fnow)
+        items = [
+            (int(p[ROW_FP_LO, i]), int(p[ROW_FP_HI, i]), 1, LIMIT, DIV, 0)
+            for i in range(ids.size)
+        ]
+        after = fuzz_eng.step_after_compact(p.copy(), 0xFFFF)
+        routed_only.step_after_compact(p.copy(), 0xFFFF)
+        want = oracle.step_batch(items, fnow)
+        for i, kid in enumerate(ids):
+            got = 2 if int(after[i]) > LIMIT else 1
+            if kid != hot_id or not is_hot:
+                if got != want[i]:
+                    return {"error": f"fuzz diverged from oracle at step {step}"}
+            elif got == 1:
+                admitted[window] = admitted.get(window, 0) + 1
+        if step == 1:
+            fuzz_eng.promote_hot(hot_lo, hot_hi)
+            is_hot = True
+            events.add(window)
+    false_over = sum(
+        max(0, n - (LIMIT + (K - 1) * q if w in events else K * q))
+        for w, n in admitted.items()
+    )
+    # single-hot-key A/B on the structural metric a serialized virtual
+    # mesh can measure honestly: with half the stream on one key,
+    # routed-only still pads every launch to the hot shard's rung; the
+    # tier flattens it. On real parallel chips fewer dead lanes IS the
+    # throughput win (each lane is compute).
+    hot_dead = {}
+    for name, eng in (("routed", routed_only), ("hot", fuzz_eng)):
+        s = eng.shard_routing_snapshot()
+        hot_dead[name] = int(s["padded_lanes"] - s["rows"])
+
+    result = {
+        "devices": n_dev,
+        "batch": batch,
+        "host_cpus": os.cpu_count(),
+        "zipf": zipf,
+        "uniform": uniform,
+        "hot": {
+            "hot_rate": zipf["rate_routed_hot"],
+            "speedup": round(
+                zipf["rate_routed_hot"] / max(zipf["rate_compact"], 1), 3
+            ),
+            "false_over": int(false_over),
+            "false_over_bound": K * q,
+            "bound_ok": false_over == 0,
+            "salt_ways": K,
+            "single_key_dead_lanes_routed": hot_dead["routed"],
+            "single_key_dead_lanes_hot": hot_dead["hot"],
+            "hot_beats_routed": hot_dead["hot"] < hot_dead["routed"],
+        },
+    }
+    if on_tpu and n_dev >= 2:
+        result["multichip"] = {"ran": True, "devices": n_dev}
+    else:
+        result["multichip"] = {
+            "skipped": f"needs tpu with >=2 devices "
+            f"(platform={'tpu' if on_tpu else 'cpu'}, devices={n_dev}); "
+            "virtual CPU-mesh smoke arm recorded above"
+        }
+    print(f"[engine-sharded-zipf x{n_dev}] {result}", file=sys.stderr)
+    return result
+
+
 def _sidecar_worker() -> None:
     """BENCH_SIDECAR_WORKER mode: one frontend process driving the shared
     sidecar through the full service path (trie -> fingerprints -> socket).
@@ -3409,6 +3624,37 @@ def _sharded_in_subprocess(n_mesh: int) -> dict:
         return {"error": "sharded subprocess timed out"}
 
 
+def _sharded_zipf_in_subprocess(n_mesh: int) -> dict:
+    """Virtual CPU-mesh arm of the sharded_zipf tier, isolated in a
+    subprocess for the same reason as _sharded_in_subprocess: the forced
+    device split must never leak into this process's backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_PLATFORM"] = "cpu"
+    env["BENCH_SHARDED_ZIPF_ONLY"] = str(n_mesh)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_mesh}"
+    ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            timeout=180,
+            text=True,
+            env=env,
+        )
+        sys.stderr.write(proc.stderr or "")
+        lines = [l for l in (proc.stdout or "").strip().splitlines() if l.startswith("{")]
+        if proc.returncode == 0 and lines:
+            out = json.loads(lines[-1])
+            out["mesh"] = "virtual-cpu"
+            return out
+        return {"error": f"rc={proc.returncode}", "stderr_tail": (proc.stderr or "")[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"error": "sharded_zipf subprocess timed out"}
+
+
 def _start_watchdog(
     deadline_s: float, result: dict, emit, _exit=os._exit
 ) -> threading.Thread:
@@ -3512,6 +3758,14 @@ def main() -> None:
         # child mode for _sharded_in_subprocess: print one JSON line and exit
         print(json.dumps(bench_engine_sharded(
             min(sharded_only, len(jax.devices())), on_tpu
+        )))
+        return
+
+    sharded_zipf_only = int(os.environ.get("BENCH_SHARDED_ZIPF_ONLY", "0") or 0)
+    if sharded_zipf_only > 1:
+        # child mode for _sharded_zipf_in_subprocess
+        print(json.dumps(bench_engine_sharded_zipf(
+            min(sharded_zipf_only, len(jax.devices())), on_tpu
         )))
         return
 
@@ -3855,6 +4109,29 @@ def main() -> None:
             engine["sharded"] = {"skipped": "budget"}
     except Exception as e:
         engine["sharded"] = {"error": str(e)[-300:]}
+    emit()
+
+    # sharded_zipf: the hot-shard pathology A/B (routed batching + hot-key
+    # tier vs the compact rollback arm). Always-armed in the tier matrix
+    # (tools/bench_driver.py): on tpu+>=2 devices it runs in-process as
+    # the multichip arm; everywhere else the virtual CPU-mesh smoke arm
+    # runs in a subprocess — waste/dead-lane and false_over columns are
+    # exact on any box, only the rates need real parallel hardware.
+    try:
+        if not tier_selected("sharded_zipf"):
+            configs["sharded_zipf"] = skip_not_selected()
+        elif left() < 60:
+            configs["sharded_zipf"] = {"skipped": "budget"}
+        elif max(n_mesh, len(jax.devices())) > 1:
+            configs["sharded_zipf"] = bench_engine_sharded_zipf(
+                min(n_mesh or len(jax.devices()), len(jax.devices())), on_tpu
+            )
+        elif left() > 200:
+            configs["sharded_zipf"] = _sharded_zipf_in_subprocess(8)
+        else:
+            configs["sharded_zipf"] = {"skipped": "budget"}
+    except Exception as e:
+        configs["sharded_zipf"] = {"error": str(e)[-300:]}
     emit()
 
 

@@ -167,6 +167,42 @@ class TestBenchLint:
         doc["configs"]["keyspace_overload"] = {"skipped": "budget"}
         assert bench_lint.lint_artifact(doc) == []
 
+    def _sz_hot(self):
+        return {
+            "hot_rate": 150_000,
+            "speedup": 1.3,
+            "false_over": 0,
+            "false_over_bound": 40,
+            "bound_ok": True,
+            "salt_ways": 8,
+        }
+
+    def test_sharded_zipf_good_hot_arm_is_clean(self):
+        doc = _good_doc()
+        doc["configs"]["sharded_zipf"] = {"hot": self._sz_hot()}
+        assert bench_lint.lint_artifact(doc) == []
+        # skipped tier / skipped hot arm claim nothing
+        doc["configs"]["sharded_zipf"] = {"skipped": "budget"}
+        assert bench_lint.lint_artifact(doc) == []
+        doc["configs"]["sharded_zipf"] = {"hot": {"skipped": "budget"}}
+        assert bench_lint.lint_artifact(doc) == []
+
+    def test_sharded_zipf_speedup_without_fuzz_verdict_is_a_finding(self):
+        """A hot-tier rate/speedup without the differential-fuzz verdict
+        reads as 'faster by over-admitting' — the lint demands the
+        false_over count, its bound, and the bound_ok verdict."""
+        doc = _good_doc()
+        hot = self._sz_hot()
+        del hot["false_over"]
+        del hot["bound_ok"]
+        doc["configs"]["sharded_zipf"] = {"hot": hot}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("false_over fuzz verdict" in f for f in findings)
+        assert any("bound_ok" in f for f in findings)
+        doc["configs"]["sharded_zipf"] = {"zipf": {"rate_routed": 1}}
+        findings = bench_lint.lint_artifact(doc)
+        assert any("no hot-tier arm" in f for f in findings)
+
     def test_checked_in_r16_lints_clean(self):
         path = os.path.join(REPO, "BENCH_r16.json")
         assert bench_lint.lint_file(path) == []

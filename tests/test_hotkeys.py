@@ -142,9 +142,11 @@ class TestRollbackArm:
         assert doc["enabled"] is False and doc["top"] == []
         assert cache.engine.drain_hotkeys() == []
 
-    def test_mesh_disables_sketch(self):
-        # multi-device slabs shard rows across devices; the sketch scan is
-        # single-device — the engine must disable it loudly, not crash
+    def test_mesh_uses_host_fallback_not_device_sketch(self):
+        # multi-device slabs shard rows across devices and the device
+        # sketch scan is single-device, so the mesh arm swaps in the
+        # sharded engine's host-side top-K (ops/sketch.py HostTopK) —
+        # same hotkeys surface, no device sketch, no crash
         import jax
 
         from api_ratelimit_tpu.parallel import make_mesh
@@ -158,9 +160,10 @@ class TestRollbackArm:
             mesh=make_mesh(),
             hotkey_lanes=32,
         )
-        assert not engine.hotkeys_enabled
-        assert engine._sketch is None
-        assert engine.drain_hotkeys() == []
+        assert engine.hotkeys_enabled  # host fallback, delegated
+        assert engine._sketch is None  # the DEVICE sketch stays off
+        assert engine.drain_hotkeys() == []  # unfed: empty, not a crash
+        assert engine.hotkeys_snapshot()["enabled"] is True
 
 
 class TestDrainAndDebug:

@@ -158,6 +158,25 @@ class TestHotpathProfile:
             assert rows, (stage, proc.stdout[-300:])
             assert "p50=" in rows[0] and "p99=" in rows[0]
 
+    def test_shard_split_stage_table(self):
+        """--shard-split forces its own virtual mesh (the harness strips
+        XLA_FLAGS, so the tool must set the device split itself before
+        jax initializes) and prints the routed owner's stage table."""
+        proc = _run_tool(
+            "tools.hotpath_profile", ("--shard-split", "--shards", "2")
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        lines = proc.stdout.splitlines()
+        summary = [ln for ln in lines if ln.startswith("[shard_split] shards=")]
+        assert summary, proc.stdout[-300:]
+        assert "shards=2" in summary[0] and "launches=" in summary[0]
+        for stage in ("bucket_ns", "pad_ns", "launch_ns"):
+            rows = [ln for ln in lines if ln.strip().startswith(stage)]
+            assert rows, (stage, proc.stdout[-300:])
+            assert "p50=" in rows[0] and "p99=" in rows[0]
+        assert any(ln.strip().startswith("shard_rows") for ln in lines)
+        assert any("padding_waste_pct=" in ln for ln in lines)
+
     def test_dispatch_arm_profiles_owner_thread(self):
         proc = _run_tool(
             "tools.hotpath_profile", ("-n", "120", "--top", "8", "--dispatch")

@@ -143,6 +143,13 @@ TIER_REQUIREMENTS: dict = {
     # arms — it is in the matrix so the artifact records that it RAN
     # (bench_lint's claim-honesty rules key off configs.keyspace_overload)
     "keyspace_overload": {},
+    # routed-batching / hot-tier A/B: the padding-waste and false_over
+    # columns are exact on any box (host-side routing + differential
+    # fuzz), so the tier always arms — the rate columns only mean
+    # parallel throughput on tpu+>=2 devices, where the tier's multichip
+    # sub-key records that it ran on real chips (it rides the same
+    # hardware gate as multichip_mesh)
+    "sharded_zipf": {},
     "pallas_slab": {"platform": "tpu"},
     "device_sketch": {"platform": "tpu"},
     "multichip_mesh": {"platform": "tpu", "min_devices": 2},

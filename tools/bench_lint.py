@@ -203,6 +203,38 @@ def lint_artifact(doc: dict, require_provenance: bool = True) -> list:
                         f"verdict"
                     )
 
+    # claim honesty for the hot-key tier (sharded_zipf): a hot-tier arm
+    # that claims a rate or a speedup is a "split quotas don't over-admit"
+    # claim, so the artifact must carry the differential-fuzz verdict —
+    # false_over (int), the documented bound it was checked against, and
+    # bound_ok. A speedup without the false_over verdict reads as "we
+    # went faster by admitting traffic the limit forbids".
+    sz = configs.get("sharded_zipf") if isinstance(configs, dict) else None
+    if isinstance(sz, dict) and "skipped" not in sz and "error" not in sz:
+        hot = sz.get("hot")
+        if not isinstance(hot, dict):
+            findings.append(
+                "configs.sharded_zipf: ran but carries no hot-tier arm"
+            )
+        elif "skipped" not in hot and "error" not in hot and (
+            hot.get("hot_rate") is not None or hot.get("speedup") is not None
+        ):
+            if not isinstance(hot.get("false_over"), int):
+                findings.append(
+                    "configs.sharded_zipf.hot: speedup claimed without "
+                    "an integer false_over fuzz verdict"
+                )
+            if not isinstance(hot.get("false_over_bound"), (int, float)):
+                findings.append(
+                    "configs.sharded_zipf.hot: false_over without the "
+                    "bound it was checked against (false_over_bound)"
+                )
+            if not isinstance(hot.get("bound_ok"), bool):
+                findings.append(
+                    "configs.sharded_zipf.hot: speedup claimed without "
+                    "the bound_ok verdict"
+                )
+
     # claim honesty for chaos campaigns (CHAOS_rNN.json, chaos/): a
     # campaign artifact is a "zero violations under composed nemeses"
     # claim, so it must carry the replay pins and the full evidence:

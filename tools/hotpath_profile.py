@@ -75,6 +75,20 @@ def main(argv=None) -> int:
         help="wall-clock sampling profile instead of cProfile",
     )
     parser.add_argument(
+        "--shard-split",
+        action="store_true",
+        help="print the ROUTED mesh dispatch owner's stage split "
+        "(host bucket / pad+H2D / launch ns per mesh launch, "
+        "parallel/sharded_slab.py shard_routing_snapshot) on a virtual "
+        "CPU mesh, plus the per-shard row mix and padding waste",
+    )
+    parser.add_argument(
+        "--shards",
+        type=int,
+        default=4,
+        help="virtual mesh size for --shard-split (default 4)",
+    )
+    parser.add_argument(
         "--slab-split",
         action="store_true",
         help="print the slab stage-split baseline (set-gather / scan / "
@@ -84,6 +98,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     sys.path.insert(0, REPO)
+    if args.shard_split:
+        # must run before anything imports jax: the forced device split
+        # only takes effect at backend init
+        return _run_shard_split(args)
     if args.frontend:
         return _run_frontend_profile(args)
     if args.dispatch:
@@ -163,6 +181,95 @@ def _run_slab_split(cache, store) -> int:
         return 0
     finally:
         cache.close()
+
+
+def _run_shard_split(args) -> int:
+    """The routed dispatch owner's stage split on a virtual CPU mesh
+    (SHARD_ROUTED_BATCHING, parallel/sharded_slab.py): host owner-hash +
+    argsort (bucket), per-shard block fill + H2D (pad), and device
+    dispatch (launch), per mesh launch, driven by a Zipf-skewed stream
+    with the hot-key tier armed so the printout shows the shipped
+    default's flattened shard mix.
+
+    Output contract (pinned by tests/test_tools_platform.py): one
+    `[shard_split] shards=<N> launches=<M>` line, a `<stage>_ns
+    p50=<N> p99=<N>` row per stage, the per-shard routed row counts,
+    and the cumulative `padding_waste_pct=`."""
+    n_shards = max(2, int(args.shards))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_shards}"
+    ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    import bench
+    from api_ratelimit_tpu.parallel.sharded_slab import (
+        ShardedSlabEngine,
+        make_mesh,
+    )
+    from api_ratelimit_tpu.ops.slab import (
+        ROW_DIVIDER,
+        ROW_FP_HI,
+        ROW_FP_LO,
+        ROW_HITS,
+        ROW_LIMIT,
+        ROW_SCALARS,
+    )
+
+    devices = jax.devices()[:n_shards]
+    if len(devices) < 2:
+        print(
+            f"[shard_split] needs >=2 devices, got {len(devices)} "
+            "(is another jax backend already initialized?)",
+            file=sys.stderr,
+        )
+        return 1
+    engine = ShardedSlabEngine(
+        mesh=make_mesh(devices),
+        n_slots_global=len(devices) * (1 << 13),
+        routed=True,
+        hot_tier=True,
+        hotkey_lanes=128,
+        hotkey_k=16,
+        hot_min_count=200,
+    )
+    batch = 8192
+    now = int(time.time())
+    ids = bench.zipf_ids(50_000, batch, 6, seed=1)
+
+    def pack(block_ids: np.ndarray) -> np.ndarray:
+        p = np.zeros((7, block_ids.size), dtype=np.uint32)
+        x = block_ids.astype(np.uint32)
+        p[ROW_FP_LO] = bench.fmix32_np(x)
+        p[ROW_FP_HI] = bench.fmix32_np(x ^ np.uint32(0xA5A5A5A5))
+        p[ROW_HITS] = 1
+        p[ROW_LIMIT] = 100
+        p[ROW_DIVIDER] = 60
+        p[ROW_SCALARS, 0] = np.uint32(now)
+        p[ROW_SCALARS, 1] = np.float32(0.8).view(np.uint32)
+        return p
+
+    # block 0 warms the compile and feeds the sketch; the drain promotes
+    # the Zipf head so the timed launches run the shipped default
+    engine.step_after_compact(pack(ids[0]), 0xFFFF)
+    engine.drain_hotkeys()
+    for i in range(1, 6):
+        engine.step_after_compact(pack(ids[i]), 0xFFFF)
+
+    snap = engine.shard_routing_snapshot()
+    print(f"[shard_split] shards={snap['shards']} launches={snap['launches']}")
+    for stage in ("bucket_ns", "pad_ns", "launch_ns"):
+        h = snap["stage_ns"][stage]
+        print(f"  {stage:<10} p50={h.get('p50', 0)} p99={h.get('p99', 0)}")
+    print(f"  shard_rows {snap['shard_rows']}")
+    print(
+        f"  padding_waste_pct={snap['padding_waste_pct']} "
+        f"hot_keys={snap['hot_tier']['keys']}"
+    )
+    return 0
 
 
 def _run_dispatch_profile(service, cache, reqs, args) -> int:
